@@ -1,0 +1,55 @@
+"""ant_ray_tpu — a TPU-native distributed computing framework.
+
+Tasks, actors, and a distributed object plane (the capability surface of
+antgroup/ant-ray) re-designed for TPU clusters: XLA collectives over ICI/DCN,
+HBM as a first-class object-store tier, slice/topology-aware gang scheduling,
+and parallelism strategies (DP/FSDP/TP/PP/EP + ring-attention / Ulysses
+sequence parallelism) expressed as JAX/pjit/Pallas sharding programs.
+"""
+
+from ant_ray_tpu.api import (
+    ClientContext,
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    method,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    wait,
+)
+from ant_ray_tpu.object_ref import ObjectRef
+from ant_ray_tpu.remote_function import RemoteFunction
+from ant_ray_tpu.actor import ActorClass, ActorHandle
+from ant_ray_tpu import exceptions
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "ActorClass",
+    "ActorHandle",
+    "ClientContext",
+    "ObjectRef",
+    "RemoteFunction",
+    "available_resources",
+    "cancel",
+    "cluster_resources",
+    "exceptions",
+    "get",
+    "get_actor",
+    "init",
+    "is_initialized",
+    "kill",
+    "method",
+    "nodes",
+    "put",
+    "remote",
+    "shutdown",
+    "wait",
+]

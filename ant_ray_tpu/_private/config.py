@@ -1,0 +1,106 @@
+"""Global configuration flag table.
+
+Equivalent in spirit to the reference's RAY_CONFIG macro table
+(ref: src/ray/common/ray_config_def.h — 239 flags, env-overridable via
+RAY_<name>), redesigned as a typed dataclass: every field is overridable with
+an ``ART_<NAME>`` environment variable and with the ``_system_config`` dict
+passed to :func:`ant_ray_tpu.init`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any
+
+
+def _env_override(name: str, default: Any) -> Any:
+    raw = os.environ.get(f"ART_{name.upper()}")
+    if raw is None:
+        return default
+    ty = type(default)
+    if ty is bool:
+        return raw.lower() in ("1", "true", "yes")
+    if ty is int:
+        return int(raw)
+    if ty is float:
+        return float(raw)
+    if ty in (dict, list):
+        return json.loads(raw)
+    return raw
+
+
+@dataclasses.dataclass
+class Config:
+    # ---- object store ----
+    # Objects smaller than this are returned inline in RPC replies and live in
+    # the owner's in-process memory store; larger ones go to the node's shared
+    # memory store (ref: max_direct_call_object_size).
+    max_inline_object_size: int = 100 * 1024
+    # Per-node shared-memory store capacity (bytes). 0 = auto (30% of RAM).
+    object_store_memory: int = 0
+    # Chunk size for node-to-node object transfer.
+    object_transfer_chunk_size: int = 8 * 1024 * 1024
+    # LRU-evict unpinned objects when the store is this full.
+    object_store_high_watermark: float = 0.8
+
+    # ---- scheduling ----
+    # Workers pre-started per node at boot.
+    num_prestart_workers: int = 0
+    # Upper bound on workers a node will fork (0 = num_cpus).
+    max_workers_per_node: int = 0
+    # Seconds an idle leased worker is kept before release.
+    worker_lease_timeout_s: float = 0.5
+    # Spill a queued task to another node if it has waited this long locally.
+    spillback_timeout_s: float = 0.2
+
+    # ---- fault tolerance ----
+    task_max_retries_default: int = 3
+    actor_max_restarts_default: int = 0
+    # Node heartbeat period and the number of missed beats before death.
+    heartbeat_period_s: float = 0.5
+    num_heartbeats_timeout: int = 10
+
+    # ---- rpc ----
+    rpc_connect_timeout_s: float = 10.0
+    rpc_call_timeout_s: float = 60.0
+    # Deterministic RPC fault injection: "method:prob,method:prob" (chaos
+    # testing — ref: src/ray/rpc/rpc_chaos.h).
+    testing_rpc_failure: str = ""
+
+    # ---- accelerators ----
+    # Override detected TPU chip count (testing).
+    tpu_chips_override: int = -1
+
+    # ---- logging ----
+    log_level: str = "INFO"
+
+    def apply_env_overrides(self) -> "Config":
+        for f in dataclasses.fields(self):
+            setattr(self, f.name, _env_override(f.name, getattr(self, f.name)))
+        return self
+
+    def apply_dict(self, overrides: dict | None) -> "Config":
+        if not overrides:
+            return self
+        for key, value in overrides.items():
+            if not hasattr(self, key):
+                raise ValueError(f"Unknown config flag: {key}")
+            setattr(self, key, value)
+        return self
+
+
+_global_config: Config | None = None
+
+
+def global_config() -> Config:
+    global _global_config
+    if _global_config is None:
+        _global_config = Config().apply_env_overrides()
+    return _global_config
+
+
+def set_global_config(config: Config) -> None:
+    global _global_config
+    _global_config = config

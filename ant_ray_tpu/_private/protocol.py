@@ -1,0 +1,349 @@
+"""Asyncio RPC substrate for the control plane.
+
+Role-equivalent to the reference's gRPC wrappers
+(ref: src/ray/rpc/grpc_server.h, retryable_grpc_client.h, rpc_chaos.h) with a
+lighter transport: length-prefixed pickle frames over TCP, one shared
+background IO thread per process (the analogue of the instrumented asio
+io_context, ref: src/ray/common/asio/).  The public surface — ``RpcServer``
+with async method handlers, ``RpcClient.call`` with retries and deadline, and
+deterministic chaos fault injection — is transport-agnostic so it can be
+re-hosted on gRPC without touching callers.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+import logging
+import pickle
+import random
+import socket
+import threading
+import time
+from typing import Any, Awaitable, Callable
+
+from ant_ray_tpu._private.config import global_config
+
+logger = logging.getLogger(__name__)
+
+_REQ, _REP, _ERR, _ONEWAY = 0, 1, 2, 3
+
+_HEADER = 8  # u64 big-endian frame length
+
+
+class RpcError(Exception):
+    pass
+
+
+class RpcConnectionError(RpcError):
+    pass
+
+
+class RpcTimeoutError(RpcError):
+    pass
+
+
+class _ChaosInjector:
+    """Deterministic RPC fault injection (ref: src/ray/rpc/rpc_chaos.h:24).
+
+    Config string: ``"method:prob,method2:prob"``; seeded RNG so failures are
+    reproducible across runs with the same seed.
+    """
+
+    def __init__(self, spec: str, seed: int = 0):
+        self._probs: dict[str, float] = {}
+        for part in filter(None, (spec or "").split(",")):
+            method, prob = part.split(":")
+            self._probs[method] = float(prob)
+        self._rng = random.Random(seed)
+
+    def should_fail(self, method: str) -> bool:
+        prob = self._probs.get(method, 0.0)
+        return prob > 0 and self._rng.random() < prob
+
+
+# ------------------------------------------------------------------- io loop
+
+class IoThread:
+    """One background asyncio loop per process; all servers/clients share it."""
+
+    _instance: "IoThread | None" = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self.loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._run, name="art-io", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self):
+        asyncio.set_event_loop(self.loop)
+        self.loop.run_forever()
+
+    @classmethod
+    def get(cls) -> "IoThread":
+        with cls._lock:
+            if cls._instance is None or not cls._instance._thread.is_alive():
+                cls._instance = cls()
+            return cls._instance
+
+    def run_coro(self, coro: Awaitable, timeout: float | None = None) -> Any:
+        """Run a coroutine on the io loop from a foreign thread, blocking."""
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        return fut.result(timeout)
+
+    def call_soon(self, fn: Callable, *args) -> None:
+        self.loop.call_soon_threadsafe(fn, *args)
+
+    @classmethod
+    def reset(cls) -> None:
+        with cls._lock:
+            inst, cls._instance = cls._instance, None
+        if inst is not None:
+            inst.loop.call_soon_threadsafe(inst.loop.stop)
+
+
+# asyncio's loop keeps only weak refs to tasks; hold strong refs here so
+# fire-and-forget dispatch/read-loop tasks are never GC'd mid-flight.
+_background_tasks: set = set()
+
+
+def _spawn(coro) -> None:
+    task = asyncio.ensure_future(coro)
+    _background_tasks.add(task)
+    task.add_done_callback(_background_tasks.discard)
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    header = await reader.readexactly(_HEADER)
+    length = int.from_bytes(header, "big")
+    data = await reader.readexactly(length)
+    return pickle.loads(data)
+
+
+def _encode_frame(msg: Any) -> bytes:
+    data = pickle.dumps(msg, protocol=5)
+    return len(data).to_bytes(_HEADER, "big") + data
+
+
+# -------------------------------------------------------------------- server
+
+class RpcServer:
+    """Async RPC server. Handlers: ``async def h(payload) -> reply``.
+
+    Register with :meth:`route`; a handler raising propagates the exception to
+    the caller (pickled, re-raised client-side as its original type).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._host = host
+        self._port = port
+        self._routes: dict[str, Callable[[Any], Awaitable[Any]]] = {}
+        self._server: asyncio.AbstractServer | None = None
+        self._io = IoThread.get()
+        self.address: str = ""
+
+    def route(self, method: str, handler: Callable[[Any], Awaitable[Any]]):
+        self._routes[method] = handler
+
+    def routes(self, handlers: dict[str, Callable]):
+        self._routes.update(handlers)
+
+    def start(self) -> str:
+        self._io.run_coro(self._start())
+        return self.address
+
+    async def _start(self):
+        self._server = await asyncio.start_server(
+            self._handle_conn, self._host, self._port
+        )
+        port = self._server.sockets[0].getsockname()[1]
+        self.address = f"{self._host}:{port}"
+
+    async def _handle_conn(self, reader, writer):
+        try:
+            while True:
+                try:
+                    kind, msg_id, method, payload = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    return
+                _spawn(
+                    self._dispatch(writer, kind, msg_id, method, payload)
+                )
+        finally:
+            writer.close()
+
+    async def _dispatch(self, writer, kind, msg_id, method, payload):
+        handler = self._routes.get(method)
+        try:
+            if handler is None:
+                raise RpcError(f"no route for method {method!r}")
+            result = await handler(payload)
+            if kind == _ONEWAY:
+                return
+            frame = _encode_frame((_REP, msg_id, method, result))
+        except Exception as e:  # noqa: BLE001 — forwarded to caller
+            if kind == _ONEWAY:
+                logger.exception("oneway handler %s failed", method)
+                return
+            try:
+                frame = _encode_frame((_ERR, msg_id, method, e))
+            except Exception:
+                frame = _encode_frame((_ERR, msg_id, method, RpcError(repr(e))))
+        try:
+            writer.write(frame)
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+    def stop(self):
+        if self._server is not None:
+            async def _close(server):
+                server.close()
+                await server.wait_closed()
+
+            try:
+                self._io.run_coro(_close(self._server), timeout=5)
+            except Exception:
+                pass
+            self._server = None
+
+
+# -------------------------------------------------------------------- client
+
+class RpcClient:
+    """Connection to one RpcServer; safe to call from any thread."""
+
+    _counter = itertools.count()
+
+    def __init__(self, address: str):
+        self.address = address
+        self._io = IoThread.get()
+        self._writer: asyncio.StreamWriter | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._conn_lock: asyncio.Lock | None = None
+        self._chaos = _ChaosInjector(global_config().testing_rpc_failure)
+        self._closed = False
+
+    async def _ensure_connected(self):
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            host, port = self.address.rsplit(":", 1)
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(host, int(port)),
+                    global_config().rpc_connect_timeout_s,
+                )
+            except (OSError, asyncio.TimeoutError) as e:
+                raise RpcConnectionError(
+                    f"cannot connect to {self.address}: {e}"
+                ) from e
+            self._writer = writer
+            _spawn(self._read_loop(reader))
+
+    async def _read_loop(self, reader):
+        try:
+            while True:
+                kind, msg_id, _method, payload = await _read_frame(reader)
+                fut = self._pending.pop(msg_id, None)
+                if fut is None or fut.done():
+                    continue
+                if kind == _ERR:
+                    fut.set_exception(
+                        payload if isinstance(payload, BaseException)
+                        else RpcError(str(payload))
+                    )
+                else:
+                    fut.set_result(payload)
+        except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
+            pass
+        finally:
+            self._writer = None
+            err = RpcConnectionError(f"connection to {self.address} lost")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
+
+    async def call_async(
+        self, method: str, payload: Any = None, timeout: float | None = None
+    ) -> Any:
+        if self._chaos.should_fail(method):
+            raise RpcConnectionError(f"[chaos] injected failure for {method}")
+        await self._ensure_connected()
+        msg_id = next(self._counter)
+        fut = asyncio.get_running_loop().create_future()
+        self._pending[msg_id] = fut
+        self._writer.write(_encode_frame((_REQ, msg_id, method, payload)))
+        await self._writer.drain()
+        timeout = timeout if timeout is not None else global_config().rpc_call_timeout_s
+        try:
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError as e:
+            self._pending.pop(msg_id, None)
+            raise RpcTimeoutError(f"{method} to {self.address} timed out") from e
+
+    async def oneway_async(self, method: str, payload: Any = None) -> None:
+        await self._ensure_connected()
+        self._writer.write(_encode_frame((_ONEWAY, -1, method, payload)))
+        await self._writer.drain()
+
+    def call(self, method: str, payload: Any = None,
+             timeout: float | None = None, retries: int = 0) -> Any:
+        """Blocking call from any non-io thread, with connection retries."""
+        attempt = 0
+        while True:
+            try:
+                return self._io.run_coro(
+                    self.call_async(method, payload, timeout)
+                )
+            except RpcConnectionError:
+                attempt += 1
+                if attempt > retries:
+                    raise
+                time.sleep(min(0.1 * 2 ** attempt, 2.0))
+
+    def close(self):
+        self._closed = True
+        writer = self._writer
+        if writer is not None:
+            self._io.call_soon(writer.close)
+            self._writer = None
+
+
+class ClientPool:
+    """Shared RpcClients keyed by address (ref: rpc client pools)."""
+
+    def __init__(self):
+        self._clients: dict[str, RpcClient] = {}
+        self._lock = threading.Lock()
+
+    def get(self, address: str) -> RpcClient:
+        with self._lock:
+            client = self._clients.get(address)
+            if client is None or client._closed:
+                client = RpcClient(address)
+                self._clients[address] = client
+            return client
+
+    def invalidate(self, address: str) -> None:
+        with self._lock:
+            client = self._clients.pop(address, None)
+        if client is not None:
+            client.close()
+
+    def close_all(self):
+        with self._lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            c.close()
+
+
+def find_free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

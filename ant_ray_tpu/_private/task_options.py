@@ -1,0 +1,61 @@
+"""Per-task / per-actor submission options (ref: @ray.remote(**opts) surface,
+python/ray/_private/ray_option_utils.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+
+@dataclasses.dataclass
+class TaskOptions:
+    num_cpus: float | None = None
+    num_tpus: float | None = None          # TPU chips (ref uses resources={"TPU": n})
+    num_gpus: float | None = None          # accepted for API parity; maps to resources
+    resources: dict[str, float] = dataclasses.field(default_factory=dict)
+    num_returns: int = 1
+    max_retries: int | None = None
+    retry_exceptions: bool = False
+    name: str = ""
+    runtime_env: dict | None = None
+    scheduling_strategy: Any = None        # "DEFAULT" | "SPREAD" | PG strategy
+    placement_group: Any = None
+    placement_group_bundle_index: int = -1
+    _metadata: dict = dataclasses.field(default_factory=dict)
+
+    def resource_demand(self, default_num_cpus: float = 1.0) -> dict[str, float]:
+        demand = dict(self.resources)
+        cpus = self.num_cpus if self.num_cpus is not None else default_num_cpus
+        if cpus:
+            demand["CPU"] = demand.get("CPU", 0.0) + cpus
+        if self.num_tpus:
+            demand["TPU"] = demand.get("TPU", 0.0) + self.num_tpus
+        if self.num_gpus:
+            demand["GPU"] = demand.get("GPU", 0.0) + self.num_gpus
+        return demand
+
+    def merged_with(self, **overrides) -> "TaskOptions":
+        new = dataclasses.replace(self)
+        for key, value in overrides.items():
+            if value is None and key != "scheduling_strategy":
+                continue
+            if not hasattr(new, key):
+                raise ValueError(f"Unknown option {key!r}")
+            setattr(new, key, value)
+        return new
+
+
+@dataclasses.dataclass
+class ActorOptions(TaskOptions):
+    max_restarts: int | None = None
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    max_pending_calls: int = -1
+    lifetime: str | None = None            # None | "detached"
+    namespace: str | None = None
+    get_if_exists: bool = False
+
+    def resource_demand(self, default_num_cpus: float = 1.0) -> dict[str, float]:
+        # Actors default to 1 CPU for placement but 0 for running
+        # (ref semantics); round 1 keeps the reservation for its lifetime.
+        return super().resource_demand(default_num_cpus)

@@ -1,0 +1,185 @@
+"""Public API: init / shutdown / remote / get / put / wait / actors.
+
+Parity surface with the reference's top-level API
+(ref: python/ray/_private/worker.py:1431 ray.init, :2885 ray.get, :3032
+ray.put, :3487 ray.remote).
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Sequence
+
+from ant_ray_tpu._private import worker as worker_mod
+from ant_ray_tpu._private.config import Config, global_config, set_global_config
+from ant_ray_tpu._private.ids import JobID
+from ant_ray_tpu._private.task_options import ActorOptions, TaskOptions
+from ant_ray_tpu._private.worker import CLUSTER_MODE, LOCAL_MODE, global_worker
+from ant_ray_tpu.actor import ActorClass, ActorHandle
+from ant_ray_tpu.object_ref import ObjectRef
+from ant_ray_tpu.remote_function import RemoteFunction
+
+
+def init(
+    address: str | None = None,
+    *,
+    local_mode: bool = False,
+    num_cpus: int | None = None,
+    num_tpus: int | None = None,
+    resources: dict | None = None,
+    object_store_memory: int | None = None,
+    namespace: str | None = None,
+    _system_config: dict | None = None,
+    ignore_reinit_error: bool = False,
+) -> "ClientContext":
+    """Start (or connect to) a cluster and bind this process as a driver.
+
+    - ``address=None``: start a fresh single-node cluster in subprocesses
+      (head control store + node daemon + workers), like ``ray.init()``.
+    - ``address="art://host:port"`` or ``"host:port"``: connect to an
+      existing head.
+    - ``local_mode=True``: synchronous in-process execution, no daemons.
+    """
+    if global_worker.connected:
+        if ignore_reinit_error:
+            return ClientContext(global_worker.mode or "")
+        raise RuntimeError("ant_ray_tpu.init() called twice; "
+                           "pass ignore_reinit_error=True to allow")
+
+    config = Config().apply_env_overrides().apply_dict(_system_config)
+    if object_store_memory:
+        config.object_store_memory = object_store_memory
+    set_global_config(config)
+
+    job_id = JobID.from_random()
+    global_worker.job_id = job_id
+
+    if local_mode:
+        global_worker.runtime = worker_mod.LocalModeRuntime(job_id)
+        global_worker.mode = LOCAL_MODE
+        return ClientContext(LOCAL_MODE)
+
+    try:
+        from ant_ray_tpu._private.core import ClusterRuntime  # noqa: PLC0415
+    except ImportError as e:
+        raise RuntimeError(
+            "Cluster mode is not available in this build; "
+            "use init(local_mode=True)"
+        ) from e
+
+    global_worker.runtime = ClusterRuntime.create(
+        address=address,
+        job_id=job_id,
+        num_cpus=num_cpus,
+        num_tpus=num_tpus,
+        resources=resources,
+        namespace=namespace or "default",
+        config=config,
+    )
+    global_worker.mode = CLUSTER_MODE
+    return ClientContext(CLUSTER_MODE)
+
+
+class ClientContext:
+    def __init__(self, mode: str):
+        self.mode = mode
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        shutdown()
+
+    def disconnect(self):
+        shutdown()
+
+
+def shutdown() -> None:
+    global_worker.shutdown()
+
+
+def is_initialized() -> bool:
+    return global_worker.connected
+
+
+def remote(*args, **options):
+    """``@remote`` decorator for functions and classes
+    (ref: worker.py:3487)."""
+    if len(args) == 1 and not options and (
+            inspect.isfunction(args[0]) or inspect.isclass(args[0])):
+        return _make_remote(args[0], {})
+    if args:
+        raise TypeError("@remote with arguments must be used as "
+                        "@remote(num_cpus=..., ...)")
+
+    def decorator(fn_or_cls):
+        return _make_remote(fn_or_cls, options)
+
+    return decorator
+
+
+def _make_remote(fn_or_cls, options: dict):
+    if inspect.isclass(fn_or_cls):
+        opts = ActorOptions().merged_with(**options)
+        return ActorClass(fn_or_cls, opts)
+    opts = TaskOptions().merged_with(**options)
+    return RemoteFunction(fn_or_cls, opts)
+
+
+def method(num_returns: int = 1):
+    """Per-method options on actor classes (ref: ray.method)."""
+
+    def decorator(fn):
+        fn.__art_num_returns__ = num_returns
+        return fn
+
+    return decorator
+
+
+def get(refs, *, timeout: float | None = None):
+    return global_worker.get(refs, timeout)
+
+
+def put(value: Any) -> ObjectRef:
+    if isinstance(value, ObjectRef):
+        raise TypeError("Calling put() on an ObjectRef is not allowed")
+    global_worker._check_connected()
+    return global_worker.put(value)
+
+
+def wait(refs: Sequence[ObjectRef], *, num_returns: int = 1,
+         timeout: float | None = None, fetch_local: bool = True):
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return global_worker.wait(refs, num_returns, timeout, fetch_local)
+
+
+def get_actor(name: str, namespace: str | None = None) -> ActorHandle:
+    global_worker._check_connected()
+    return global_worker.runtime.get_actor(name, namespace)
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True) -> None:
+    global_worker._check_connected()
+    global_worker.runtime.kill_actor(actor, no_restart)
+
+
+def cancel(ref: ObjectRef, *, force: bool = False,
+           recursive: bool = True) -> None:
+    global_worker._check_connected()
+    global_worker.runtime.cancel(ref, force, recursive)
+
+
+def cluster_resources() -> dict:
+    global_worker._check_connected()
+    return global_worker.runtime.cluster_resources()
+
+
+def available_resources() -> dict:
+    global_worker._check_connected()
+    return global_worker.runtime.available_resources()
+
+
+def nodes() -> list[dict]:
+    global_worker._check_connected()
+    return global_worker.runtime.nodes()

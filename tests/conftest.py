@@ -1,0 +1,37 @@
+"""Test bootstrap: force JAX onto a virtual 8-device CPU mesh before any jax
+import, so sharding/collective tests run without TPU hardware."""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("ART_WORKER_JAX_CPU", "1")
+
+import pytest  # noqa: E402
+
+import ant_ray_tpu as art  # noqa: E402
+
+
+@pytest.fixture
+def shutdown_only():
+    """Ensure the cluster from the test is torn down (ref: conftest.py:513)."""
+    yield None
+    art.shutdown()
+
+
+@pytest.fixture
+def local_mode():
+    art.init(local_mode=True)
+    yield None
+    art.shutdown()
+
+
+@pytest.fixture
+def start_cluster():
+    art.init(num_cpus=4)
+    yield None
+    art.shutdown()

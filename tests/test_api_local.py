@@ -1,0 +1,188 @@
+"""Local-mode API semantics (ref test model: python/ray/tests/test_basic.py)."""
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu.exceptions import TaskError
+
+
+def test_task_roundtrip(local_mode):
+    @art.remote
+    def add(a, b):
+        return a + b
+
+    assert art.get(add.remote(1, 2)) == 3
+
+
+def test_task_with_ref_args(local_mode):
+    @art.remote
+    def double(x):
+        return 2 * x
+
+    ref = art.put(21)
+    assert art.get(double.remote(ref)) == 42
+
+
+def test_chained_tasks(local_mode):
+    @art.remote
+    def inc(x):
+        return x + 1
+
+    ref = inc.remote(0)
+    for _ in range(9):
+        ref = inc.remote(ref)
+    assert art.get(ref) == 10
+
+
+def test_num_returns(local_mode):
+    @art.remote(num_returns=2)
+    def pair():
+        return 1, 2
+
+    r1, r2 = pair.remote()
+    assert art.get(r1) == 1
+    assert art.get(r2) == 2
+
+
+def test_task_error_propagates(local_mode):
+    @art.remote
+    def boom():
+        raise ValueError("boom")
+
+    ref = boom.remote()
+    with pytest.raises(TaskError, match="boom"):
+        art.get(ref)
+
+
+def test_error_lineage(local_mode):
+    @art.remote
+    def boom():
+        raise ValueError("boom")
+
+    @art.remote
+    def passthrough(x):
+        return x
+
+    # Errors propagate through dependent tasks.
+    ref = passthrough.remote(boom.remote())
+    with pytest.raises(TaskError):
+        art.get(ref)
+
+
+def test_actor_basics(local_mode):
+    @art.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def incr(self, k=1):
+            self.n += k
+            return self.n
+
+    c = Counter.remote(10)
+    assert art.get(c.incr.remote()) == 11
+    assert art.get(c.incr.remote(5)) == 16
+
+
+def test_named_actor(local_mode):
+    @art.remote
+    class Holder:
+        def value(self):
+            return "hi"
+
+    Holder.options(name="h1").remote()
+    h = art.get_actor("h1")
+    assert art.get(h.value.remote()) == "hi"
+    with pytest.raises(ValueError):
+        art.get_actor("missing")
+
+
+def test_kill_actor(local_mode):
+    @art.remote
+    class A:
+        def ping(self):
+            return "pong"
+
+    a = A.remote()
+    assert art.get(a.ping.remote()) == "pong"
+    art.kill(a)
+    with pytest.raises(Exception):
+        art.get(a.ping.remote())
+
+
+def test_wait(local_mode):
+    @art.remote
+    def f(i):
+        return i
+
+    refs = [f.remote(i) for i in range(4)]
+    ready, not_ready = art.wait(refs, num_returns=2)
+    assert len(ready) == 2
+
+
+def test_put_get_many_types(local_mode):
+    import numpy as np
+
+    for value in [1, "s", {"a": [1, 2]}, np.arange(10)]:
+        out = art.get(art.put(value))
+        if isinstance(value, np.ndarray):
+            assert (out == value).all()
+        else:
+            assert out == value
+
+
+def test_options_override(local_mode):
+    @art.remote
+    def f():
+        return 1
+
+    assert art.get(f.options(num_cpus=2).remote()) == 1
+
+
+def test_reinit_error(local_mode):
+    with pytest.raises(RuntimeError):
+        art.init(local_mode=True)
+    art.init(local_mode=True, ignore_reinit_error=True)
+
+
+def test_direct_call_raises(local_mode):
+    @art.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_get_type_check(local_mode):
+    with pytest.raises(TypeError):
+        art.get([1, 2, 3])
+
+
+def test_method_num_returns(local_mode):
+    @art.remote
+    class A:
+        @art.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    a = A.remote()
+    r1, r2 = a.pair.remote()
+    assert art.get([r1, r2]) == [1, 2]
+
+
+def test_wait_empty_list(local_mode):
+    assert art.wait([]) == ([], [])
+
+
+def test_mixed_jax_numpy_serialization():
+    # Regression: jax buffers must not corrupt pickle-5 buffer stream order.
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ant_ray_tpu._private import serialization
+
+    value = (jnp.arange(4, dtype=jnp.float32), np.arange(1000))
+    out = serialization.deserialize(serialization.serialize(value))
+    np.testing.assert_array_equal(np.asarray(out[0]), np.arange(4))
+    np.testing.assert_array_equal(out[1], np.arange(1000))

@@ -1,0 +1,99 @@
+"""Unit tests for ids and serialization (ref test model: id_test.cc,
+python/ray/tests/test_serialization.py)."""
+
+import numpy as np
+import pytest
+
+from ant_ray_tpu._private import serialization
+from ant_ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    NodeID,
+    ObjectID,
+    TaskID,
+)
+from ant_ray_tpu.object_ref import ObjectRef
+
+
+def test_id_sizes_and_lineage():
+    job = JobID.from_random()
+    actor = ActorID.of(job)
+    task = TaskID.for_actor_task(actor)
+    obj = ObjectID.for_task_return(task, 3)
+
+    assert actor.job_id() == job
+    assert task.actor_id() == actor
+    assert obj.task_id() == task
+    assert obj.return_index() == 3
+    assert obj.job_id() == job
+
+
+def test_normal_task_has_nil_actor():
+    job = JobID.from_random()
+    task = TaskID.for_normal_task(job)
+    assert task.actor_id().is_nil() is False  # prefix is job-scoped nil-actor
+    assert task.actor_id() == ActorID.nil_of_job(job)
+
+
+def test_id_hex_roundtrip_and_eq():
+    n = NodeID.from_random()
+    assert NodeID.from_hex(n.hex()) == n
+    assert NodeID.nil().is_nil()
+    assert len({NodeID.from_random() for _ in range(100)}) == 100
+
+
+def test_id_wrong_size():
+    with pytest.raises(ValueError):
+        JobID(b"toolongtoolong")
+
+
+def test_serialize_roundtrip_basic():
+    for value in [1, None, "x", [1, {"a": (2, 3)}], b"bytes"]:
+        out = serialization.deserialize(serialization.serialize(value))
+        assert out == value
+
+
+def test_serialize_numpy_out_of_band():
+    arr = np.random.rand(1000)
+    ser = serialization.serialize(arr)
+    # The array payload must ride out-of-band, not in the pickle stream.
+    assert len(ser.inband) < 1000
+    assert sum(len(b) for b in ser.buffers) >= arr.nbytes
+    out = serialization.deserialize(ser)
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_serialize_payload_flatten_roundtrip():
+    arr = np.arange(100, dtype=np.int64)
+    payload = serialization.serialize({"x": arr, "y": 1}).to_payload()
+    out = serialization.deserialize(
+        serialization.SerializedObject.from_payload(payload))
+    np.testing.assert_array_equal(out["x"], arr)
+    assert out["y"] == 1
+
+
+def test_serialize_records_contained_refs():
+    ref = ObjectRef(ObjectID.from_random(), _skip_refcount=True)
+    ser = serialization.serialize({"nested": [ref]})
+    assert len(ser.contained_refs) == 1
+    assert ser.contained_refs[0] == ref
+    out = serialization.deserialize(ser)
+    assert out["nested"][0] == ref
+
+
+def test_serialize_closure():
+    k = 17
+
+    def f(x):
+        return x + k
+
+    out = serialization.loads_code(serialization.dumps_code(f))
+    assert out(1) == 18
+
+
+def test_serialize_jax_array():
+    import jax.numpy as jnp
+
+    arr = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
+    out = serialization.deserialize(serialization.serialize({"w": arr}))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(arr))
