@@ -1,0 +1,3 @@
+from ant_ray_tpu._private.accelerators import tpu
+
+__all__ = ["tpu"]

@@ -46,8 +46,8 @@ class Config:
     object_store_high_watermark: float = 0.8
 
     # ---- scheduling ----
-    # Workers pre-started per node at boot.
-    num_prestart_workers: int = 0
+    # Workers pre-started per node at boot (-1 = auto: min(2, num_cpus)).
+    num_prestart_workers: int = -1
     # Upper bound on workers a node will fork (0 = num_cpus).
     max_workers_per_node: int = 0
     # Seconds an idle leased worker is kept before release.

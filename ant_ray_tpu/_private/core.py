@@ -1,0 +1,762 @@
+"""ClusterRuntime — the in-process runtime for drivers and workers.
+
+Role of the reference's CoreWorker (ref: src/ray/core_worker/core_worker.h:167):
+task/actor submission with leases and per-actor ordered pipelining, the
+owner-side memory store, the put/get object paths (inline, shm plasma, remote
+pull), borrower registration, and reference counting that frees objects
+cluster-wide when the last handle dies.
+
+Every driver/worker process runs one "core service" RPC server so borrowers
+can fetch owned objects directly from their owner (ownership-based object
+resolution — ref: OwnershipObjectDirectory).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import os
+import threading
+import uuid
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import asyncio
+
+from ant_ray_tpu import exceptions
+from ant_ray_tpu._private import serialization
+from ant_ray_tpu._private.config import Config, global_config
+from ant_ray_tpu._private.ids import (
+    ActorID,
+    JobID,
+    ObjectID,
+    TaskID,
+    WorkerID,
+)
+from ant_ray_tpu._private.memory_store import MemoryStore
+from ant_ray_tpu._private.object_store import open_object
+from ant_ray_tpu._private.protocol import (
+    ClientPool,
+    IoThread,
+    RpcConnectionError,
+    RpcServer,
+)
+from ant_ray_tpu._private.specs import (
+    ACTOR_ALIVE,
+    ACTOR_DEAD,
+    ActorSpec,
+    TaskSpec,
+)
+from ant_ray_tpu._private.task_options import ActorOptions, TaskOptions
+from ant_ray_tpu._private.worker import CoreRuntime
+from ant_ray_tpu.object_ref import ObjectRef, set_refcount_hook
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class _ActorSubmitState:
+    """Per-actor ordered submission queue
+    (ref: ActorTaskSubmitter, task_submission/actor_task_submitter.h:68)."""
+
+    actor_id: ActorID
+    address: str = ""
+    next_seq: int = 0
+    queue: deque = field(default_factory=deque)
+    sender_running: bool = False
+    dead_reason: str | None = None
+
+
+class ClusterRuntime(CoreRuntime):
+    def __init__(self, *, role: str, job_id: JobID, gcs_address: str,
+                 node_address: str, store_dir: str,
+                 worker_id: WorkerID | None = None,
+                 owned_processes: list | None = None,
+                 session_dir: str = ""):
+        self.role = role
+        self.job_id = job_id
+        self._io = IoThread.get()
+        self._clients = ClientPool()
+        self._gcs = self._clients.get(gcs_address)
+        self._node = self._clients.get(node_address)
+        self.gcs_address = gcs_address
+        self.node_address = node_address
+        self.store_dir = store_dir
+        self.worker_id = worker_id
+        self._owned_processes = owned_processes or []
+        self.session_dir = session_dir
+
+        self.memory = MemoryStore(self._io.loop)
+        self.server = RpcServer()
+        self.server.routes({
+            "GetObject": self._handle_get_object,
+            "GetObjectStatus": self._handle_get_object_status,
+            "BorrowAdd": self._handle_borrow_add,
+            "BorrowRemove": self._handle_borrow_remove,
+        })
+        self.address = self.server.start()
+
+        self._driver_task_id = TaskID.for_driver_task(job_id)
+        self._put_index = 0
+        self._put_lock = threading.Lock()
+
+        # ---- reference counting state (owner side)
+        self._local_refs: dict[ObjectID, int] = {}
+        self._borrows: dict[ObjectID, int] = {}       # borrows of objects I own
+        self._pins: dict[ObjectID, int] = {}          # in-flight task args
+        self._borrowed_from: dict[ObjectID, str] = {} # owner addr of my borrows
+        self._ref_lock = threading.Lock()
+        set_refcount_hook(self._refcount_event)
+
+        # ---- function/class export
+        self._fetch_cache: dict[str, Any] = {}        # kv key -> callable/class
+
+        self._actor_states: dict[ActorID, _ActorSubmitState] = {}
+        self._actor_meta_cache: dict[ActorID, dict] = {}
+        self._blocked_depth = 0
+        self._blocked_lock = threading.Lock()
+        self._shutdown = False
+
+    # ------------------------------------------------------------ bootstrap
+
+    @classmethod
+    def create(cls, *, address: str | None, job_id: JobID,
+               num_cpus: int | None, num_tpus: int | None,
+               resources: dict | None, namespace: str,
+               config: Config) -> "ClusterRuntime":
+        from ant_ray_tpu._private import services  # noqa: PLC0415
+
+        if address is None:
+            boot = services.start_cluster(
+                num_cpus=num_cpus, num_tpus=num_tpus, resources=resources)
+            gcs_address = boot["gcs_address"]
+            node_address = boot["node_address"]
+            store_dir = boot["store_dir"]
+            owned = boot["processes"]
+            session_dir = boot["session_dir"]
+        else:
+            gcs_address = address.removeprefix("art://")
+            node_address, store_dir = services.find_local_node(gcs_address)
+            owned = []
+            session_dir = ""
+
+        runtime = cls(role="driver", job_id=job_id, gcs_address=gcs_address,
+                      node_address=node_address, store_dir=store_dir,
+                      owned_processes=owned, session_dir=session_dir)
+        runtime._gcs.call(
+            "RegisterJob",
+            {"job_id": job_id, "driver_address": runtime.address},
+            retries=3)
+        return runtime
+
+    def shutdown(self):
+        if self._shutdown:
+            return
+        self._shutdown = True
+        set_refcount_hook(None)
+        from ant_ray_tpu._private import services  # noqa: PLC0415
+
+        if self._owned_processes:
+            try:
+                self._gcs.call("Shutdown", timeout=2)
+            except Exception:  # noqa: BLE001
+                pass
+            services.stop_processes(self._owned_processes)
+        self.server.stop()
+        self._clients.close_all()
+
+    # ------------------------------------------------------------ refcount
+
+    def _refcount_event(self, event: str, ref: ObjectRef):
+        if self._shutdown:
+            return
+        oid = ref.id
+        with self._ref_lock:
+            if event in ("add", "deserialized"):
+                self._local_refs[oid] = self._local_refs.get(oid, 0) + 1
+                if event == "deserialized" and not self.memory.is_owned(oid):
+                    self._borrowed_from[oid] = ref.owner_address
+                    self._send_oneway(ref.owner_address, "BorrowAdd",
+                                      {"object_id": oid})
+            elif event == "remove":
+                count = self._local_refs.get(oid, 0) - 1
+                if count > 0:
+                    self._local_refs[oid] = count
+                    return
+                self._local_refs.pop(oid, None)
+                owner = self._borrowed_from.pop(oid, None)
+                if owner is not None:
+                    self._send_oneway(owner, "BorrowRemove",
+                                      {"object_id": oid})
+                elif self.memory.is_owned(oid):
+                    self._maybe_free_locked(oid)
+
+    def _maybe_free_locked(self, oid: ObjectID):
+        """Free an owned object once local refs, borrows and pins are gone."""
+        if (self._local_refs.get(oid, 0) == 0
+                and self._borrows.get(oid, 0) == 0
+                and self._pins.get(oid, 0) == 0):
+            entry = self.memory.get_entry(oid)
+            self.memory.delete(oid)
+            if entry is not None and entry[0] == "plasma":
+                self._send_oneway(self.gcs_address, "FreeObject",
+                                  {"object_id": oid})
+
+    def _send_oneway(self, address: str, method: str, payload):
+        if not address or address == "local":
+            return
+        client = self._clients.get(address)
+
+        async def _send():
+            try:
+                await client.oneway_async(method, payload)
+            except Exception:  # noqa: BLE001 — refcount msgs are best-effort
+                pass
+
+        asyncio.run_coroutine_threadsafe(_send(), self._io.loop)
+
+    async def _handle_borrow_add(self, payload):
+        with self._ref_lock:
+            oid = payload["object_id"]
+            self._borrows[oid] = self._borrows.get(oid, 0) + 1
+        return True
+
+    async def _handle_borrow_remove(self, payload):
+        with self._ref_lock:
+            oid = payload["object_id"]
+            count = self._borrows.get(oid, 0) - 1
+            if count <= 0:
+                self._borrows.pop(oid, None)
+                self._maybe_free_locked(oid)
+            else:
+                self._borrows[oid] = count
+        return True
+
+    def _pin(self, refs: Sequence[ObjectRef]):
+        with self._ref_lock:
+            for ref in refs:
+                self._pins[ref.id] = self._pins.get(ref.id, 0) + 1
+
+    def _unpin(self, refs: Sequence[ObjectRef]):
+        with self._ref_lock:
+            for ref in refs:
+                count = self._pins.get(ref.id, 0) - 1
+                if count <= 0:
+                    self._pins.pop(ref.id, None)
+                    if self.memory.is_owned(ref.id):
+                        self._maybe_free_locked(ref.id)
+                else:
+                    self._pins[ref.id] = count
+
+    # ------------------------------------------------------------ export
+
+    def export(self, obj: Any, kind: str) -> str:
+        """Export a function/class definition to GCS KV, content-addressed.
+
+        The memo lives on the object itself (never key a cache by id():
+        CPython reuses addresses of collected objects, which would hand a
+        new function a dead function's export key).
+        """
+        key = getattr(obj, "__art_export_key__", None)
+        if key is not None:
+            return key
+        blob = serialization.dumps_code(obj)
+        key = f"{kind}:{hashlib.sha256(blob).hexdigest()[:24]}"
+        self._gcs.call("KVPut", {"key": key, "value": blob,
+                                 "overwrite": False}, retries=3)
+        try:
+            obj.__art_export_key__ = key
+        except (AttributeError, TypeError):
+            pass  # unmemoizable (e.g. builtin): re-pickle next time
+        return key
+
+    def fetch_code(self, key: str) -> Any:
+        obj = self._fetch_cache.get(key)
+        if obj is None:
+            blob = self._gcs.call("KVGet", {"key": key}, retries=3)
+            if blob is None:
+                raise RuntimeError(f"definition {key} not found in GCS KV")
+            obj = serialization.loads_code(blob)
+            self._fetch_cache[key] = obj
+        return obj
+
+    # ------------------------------------------------------------ put/get
+
+    def _next_put_id(self) -> ObjectID:
+        with self._put_lock:
+            self._put_index += 1
+            idx = self._put_index
+        return ObjectID.for_task_return(self._driver_task_id,
+                                        0x8000_0000 + idx)
+
+    def put_serialized(self, ser: serialization.SerializedObject,
+                       object_id: ObjectID | None = None) -> ObjectRef:
+        oid = object_id or self._next_put_id()
+        payload = ser.to_payload()
+        if ser.contained_refs:
+            self._pin(ser.contained_refs)  # nested refs live while object does
+        if len(payload) <= global_config().max_inline_object_size:
+            self.memory.put(oid, "inline", payload)
+        else:
+            self._write_plasma(oid, payload)
+            self.memory.put(oid, "plasma", len(payload))
+        return ObjectRef(oid, owner_address=self.address)
+
+    def put(self, value: Any) -> ObjectRef:
+        return self.put_serialized(serialization.serialize(value))
+
+    def _write_plasma(self, oid: ObjectID, payload: bytes):
+        tmp = os.path.join(self.store_dir,
+                           f"{oid.hex()}.tmp.{uuid.uuid4().hex[:8]}")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        self._node.call("SealObject", {"object_id": oid, "tmp_path": tmp},
+                        timeout=60)
+
+    async def _handle_get_object(self, payload):
+        """Owner-side object serving for borrowers."""
+        oid = payload["object_id"]
+        timeout = payload.get("timeout")
+        if not self.memory.is_owned(oid):
+            return ("unknown", None)
+        try:
+            kind, value = await self.memory.wait_async(oid, timeout)
+        except asyncio.TimeoutError:
+            return ("pending", None)
+        return (kind, value)
+
+    async def _handle_get_object_status(self, payload):
+        entry = self.memory.get_entry(payload["object_id"])
+        if entry is None:
+            return "unknown"
+        return "ready" if entry[0] != "pending" else "pending"
+
+    def _deserialize_payload(self, payload) -> Any:
+        ser = serialization.SerializedObject.from_payload(payload)
+        return serialization.deserialize(ser)
+
+    async def _fetch_plasma(self, oid: ObjectID, timeout: float | None):
+        reply = await self._node.call_async(
+            "EnsureLocal",
+            {"object_id": oid, "timeout": timeout if timeout else 60.0},
+            timeout=-1)
+        if reply.get("timeout"):
+            raise exceptions.GetTimeoutError(
+                f"object {oid.hex()[:12]} not available in time")
+        return reply["path"]
+
+    async def _get_one(self, ref: ObjectRef, timeout: float | None):
+        """Resolve one ref to (kind, data): kind ∈ value|error."""
+        oid = ref.id
+        if self.memory.is_owned(oid):
+            try:
+                kind, value = await self.memory.wait_async(oid, timeout)
+            except asyncio.TimeoutError as e:
+                raise exceptions.GetTimeoutError(
+                    f"get() timed out on {oid.hex()[:12]}") from e
+        else:
+            owner = self._clients.get(ref.owner_address)
+            kind, value = await owner.call_async(
+                "GetObject", {"object_id": oid, "timeout": timeout},
+                timeout=-1 if timeout is None else timeout + 5)
+            if kind == "pending":
+                raise exceptions.GetTimeoutError(
+                    f"get() timed out on {oid.hex()[:12]}")
+            if kind == "unknown":
+                raise exceptions.ObjectLostError(
+                    oid, f"owner {ref.owner_address} does not know this object")
+        if kind == "plasma":
+            path = await self._fetch_plasma(oid, timeout)
+            view = open_object(path)
+            return ("value", self._deserialize_payload(view))
+        if kind == "inline":
+            return ("value", self._deserialize_payload(value))
+        if kind == "error":
+            return ("error", self._deserialize_payload(value))
+        raise AssertionError(f"unexpected entry kind {kind}")
+
+    def get(self, refs: Sequence[ObjectRef], timeout: float | None) -> list:
+        async def _gather():
+            return await asyncio.gather(
+                *[self._get_one(r, timeout) for r in refs])
+
+        with self._blocked():
+            results = self._io.run_coro(_gather())
+        out = []
+        for kind, data in results:
+            if kind == "error":
+                raise data
+            out.append(data)
+        return out
+
+    def wait(self, refs, num_returns, timeout, fetch_local):
+        async def _status(ref: ObjectRef):
+            if self.memory.is_owned(ref.id):
+                entry = self.memory.get_entry(ref.id)
+                return entry is not None and entry[0] != "pending"
+            owner = self._clients.get(ref.owner_address)
+            try:
+                status = await owner.call_async(
+                    "GetObjectStatus", {"object_id": ref.id}, timeout=5)
+            except Exception:  # noqa: BLE001 — owner gone counts as ready(err)
+                return True
+            return status == "ready"
+
+        async def _gather():
+            return await asyncio.gather(*[_status(r) for r in refs])
+
+        with self._blocked():
+            statuses = self._io.run_coro(_gather())
+        ready = [r for r, s in zip(refs, statuses) if s]
+        not_ready = [r for r, s in zip(refs, statuses) if not s]
+        return ready, not_ready
+
+    def _blocked(self):
+        """Tell the node daemon this worker is blocked so its cpu can be
+        re-used (deadlock avoidance for nested tasks)."""
+        runtime = self
+
+        class _Ctx:
+            def __enter__(self):
+                if runtime.role == "worker" and runtime.worker_id is not None:
+                    with runtime._blocked_lock:
+                        runtime._blocked_depth += 1
+                        if runtime._blocked_depth == 1:
+                            runtime._send_oneway(
+                                runtime.node_address, "WorkerBlocked",
+                                {"worker_id": runtime.worker_id})
+                return self
+
+            def __exit__(self, *exc):
+                if runtime.role == "worker" and runtime.worker_id is not None:
+                    with runtime._blocked_lock:
+                        runtime._blocked_depth -= 1
+                        if runtime._blocked_depth == 0:
+                            runtime._send_oneway(
+                                runtime.node_address, "WorkerUnblocked",
+                                {"worker_id": runtime.worker_id})
+
+        return _Ctx()
+
+    # ------------------------------------------------------------ tasks
+
+    def submit_task(self, remote_function, args, kwargs, options: TaskOptions):
+        fn_key = self.export(remote_function.function, "fn")
+        task_id = TaskID.for_normal_task(self.job_id)
+        num_returns = options.num_returns
+        return_refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(task_id, i)
+            self.memory.mark_pending(oid)
+            return_refs.append(ObjectRef(oid, owner_address=self.address))
+
+        ser = serialization.serialize((args, kwargs))
+        if ser.contained_refs:
+            self._pin(ser.contained_refs)
+        cfg = global_config()
+        spec = TaskSpec(
+            task_id=task_id,
+            function_id=fn_key,
+            function_name=remote_function.function_name,
+            args_payload=ser.to_payload(),
+            num_returns=num_returns,
+            owner_address=self.address,
+            resources=options.resource_demand(),
+            max_retries=(options.max_retries
+                         if options.max_retries is not None
+                         else cfg.task_max_retries_default),
+            retry_exceptions=options.retry_exceptions,
+        )
+        pinned = list(ser.contained_refs)
+        asyncio.run_coroutine_threadsafe(
+            self._run_normal_task(spec, pinned), self._io.loop)
+        return return_refs[0] if num_returns == 1 else return_refs
+
+    async def _run_normal_task(self, spec: TaskSpec, pinned_args):
+        try:
+            attempts = spec.max_retries + 1
+            last_error: Exception | None = None
+            for attempt in range(attempts):
+                try:
+                    reply = await self._lease_and_push(spec)
+                    self._store_returns(spec, reply["returns"])
+                    return
+                except (RpcConnectionError, exceptions.WorkerCrashedError) as e:
+                    last_error = e
+                    logger.warning("task %s attempt %d/%d failed: %s",
+                                   spec.function_name, attempt + 1,
+                                   attempts, e)
+            err = exceptions.WorkerCrashedError(
+                f"task {spec.function_name} failed after {attempts} "
+                f"attempts: {last_error}")
+            self._store_error(spec, err)
+        except exceptions.ArtError as e:
+            self._store_error(spec, e)
+        except Exception as e:  # noqa: BLE001 — never lose a task silently
+            logger.exception("internal error running task %s",
+                             spec.function_name)
+            self._store_error(spec, exceptions.ArtError(repr(e)))
+        finally:
+            if pinned_args:
+                self._unpin(pinned_args)
+
+    async def _lease_and_push(self, spec: TaskSpec) -> dict:
+        """Lease a worker (following spillback redirects), push the task,
+        return the worker reply (ref: NormalTaskSubmitter::SubmitTask)."""
+        node = self._node
+        for _hop in range(16):
+            reply = await node.call_async(
+                "LeaseWorker", {"resources": spec.resources}, timeout=-1)
+            if "granted" in reply:
+                worker_addr = reply["granted"]
+                worker_id = reply["worker_id"]
+                worker = self._clients.get(worker_addr)
+                try:
+                    return await worker.call_async("PushTask", spec,
+                                                   timeout=-1)
+                finally:
+                    try:
+                        await node.call_async(
+                            "ReturnWorker", {"worker_id": worker_id},
+                            timeout=10)
+                    except Exception:  # noqa: BLE001
+                        pass
+            elif "spill" in reply:
+                node = self._clients.get(reply["spill"])
+            elif "infeasible" in reply:
+                raise exceptions.ArtError(
+                    f"task {spec.function_name} requests resources "
+                    f"{spec.resources} that no node can ever satisfy")
+            else:
+                raise exceptions.ArtError(f"bad lease reply {reply}")
+        raise exceptions.ArtError("too many scheduling spillbacks")
+
+    def _store_returns(self, spec: TaskSpec, returns: list):
+        for i, (kind, data) in enumerate(returns):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            self.memory.put(oid, kind, data)
+
+    def _store_error(self, spec: TaskSpec, err: Exception):
+        payload = serialization.serialize_error(err).to_payload()
+        for i in range(spec.num_returns):
+            oid = ObjectID.for_task_return(spec.task_id, i)
+            self.memory.put(oid, "error", payload)
+
+    # ------------------------------------------------------------ actors
+
+    def create_actor(self, actor_class, args, kwargs, options: ActorOptions):
+        from ant_ray_tpu.actor import ActorHandle  # noqa: PLC0415
+
+        cls_key = self.export(actor_class.cls, "cls")
+        actor_id = ActorID.of(self.job_id)
+        ser = serialization.serialize((args, kwargs))
+        if ser.contained_refs:
+            self._pin(ser.contained_refs)
+        cfg = global_config()
+        spec = ActorSpec(
+            actor_id=actor_id,
+            class_id=cls_key,
+            class_name=actor_class._class_name,
+            args_payload=ser.to_payload(),
+            owner_address=self.address,
+            resources=options.resource_demand(),
+            placement_resources=options.placement_demand(),
+            max_restarts=(options.max_restarts
+                          if options.max_restarts is not None
+                          else cfg.actor_max_restarts_default),
+            max_concurrency=options.max_concurrency,
+            name=options.name,
+            namespace=options.namespace or "default",
+            lifetime=options.lifetime,
+            job_id=self.job_id,
+        )
+        reply = self._gcs.call("CreateActor", spec, retries=3)
+        if "error" in reply:
+            if options.get_if_exists and options.name:
+                return self.get_actor(options.name, options.namespace)
+            raise ValueError(reply["error"])
+        meta = {
+            "method_names": actor_class.method_names(),
+            "method_num_returns": actor_class.method_num_returns(),
+            "max_task_retries": options.max_task_retries,
+        }
+        self._actor_meta_cache[actor_id] = meta
+        self._gcs.call("KVPut", {
+            "key": f"actor_meta:{actor_id.hex()}",
+            "value": serialization.dumps_code(meta)}, retries=3)
+        return ActorHandle(actor_id, actor_class._class_name,
+                           meta["method_names"],
+                           max_concurrency=options.max_concurrency,
+                           method_num_returns=meta["method_num_returns"],
+                           max_task_retries=options.max_task_retries)
+
+    def get_actor(self, name: str, namespace: str | None):
+        from ant_ray_tpu.actor import ActorHandle  # noqa: PLC0415
+
+        info = self._gcs.call("GetNamedActor", {
+            "name": name, "namespace": namespace or "default"}, retries=3)
+        if info is None:
+            raise ValueError(f"Failed to look up actor {name!r}")
+        actor_id = info["actor_id"]
+        meta = self._actor_meta_cache.get(actor_id)
+        if meta is None:
+            blob = self._gcs.call(
+                "KVGet", {"key": f"actor_meta:{actor_id.hex()}"}, retries=3)
+            meta = serialization.loads_code(blob) if blob else {
+                "method_names": (), "method_num_returns": {}}
+            self._actor_meta_cache[actor_id] = meta
+        return ActorHandle(actor_id, info["class_name"],
+                           meta["method_names"],
+                           method_num_returns=meta["method_num_returns"],
+                           max_task_retries=meta.get("max_task_retries", 0))
+
+    def kill_actor(self, handle, no_restart: bool = True):
+        self._gcs.call("KillActor", {
+            "actor_id": handle.actor_id, "no_restart": no_restart}, retries=3)
+        state = self._actor_states.get(handle.actor_id)
+        if state is not None:
+            state.address = ""
+
+    def cancel(self, ref, force=False, recursive=True):
+        # Round 1: cancellation of queued (not yet leased) tasks only is
+        # not yet implemented; running tasks cannot be interrupted.
+        logger.warning("cancel() is not yet implemented; ignoring")
+
+    def submit_actor_task(self, handle, method_name, args, kwargs,
+                          options: TaskOptions):
+        actor_id = handle.actor_id
+        task_id = TaskID.for_actor_task(actor_id)
+        num_returns = options.num_returns
+        return_refs = []
+        for i in range(num_returns):
+            oid = ObjectID.for_task_return(task_id, i)
+            self.memory.mark_pending(oid)
+            return_refs.append(ObjectRef(oid, owner_address=self.address))
+
+        ser = serialization.serialize((args, kwargs))
+        if ser.contained_refs:
+            self._pin(ser.contained_refs)
+        spec = TaskSpec(
+            task_id=task_id,
+            function_id="",
+            function_name=f"{handle.class_name}.{method_name}",
+            args_payload=ser.to_payload(),
+            num_returns=num_returns,
+            owner_address=self.address,
+            resources={},
+            max_retries=getattr(handle, "_max_task_retries", 0),
+            actor_id=actor_id,
+            method_name=method_name,
+        )
+        pinned = list(ser.contained_refs)
+
+        def _enqueue():
+            state = self._actor_states.get(actor_id)
+            if state is None:
+                state = _ActorSubmitState(actor_id=actor_id)
+                self._actor_states[actor_id] = state
+            spec.sequence_no = state.next_seq
+            state.next_seq += 1
+            state.queue.append((spec, pinned, 0))
+            if not state.sender_running:
+                state.sender_running = True
+                asyncio.ensure_future(self._actor_sender(state))
+
+        self._io.loop.call_soon_threadsafe(_enqueue)
+        return return_refs[0] if num_returns == 1 else return_refs
+
+    async def _actor_sender(self, state: _ActorSubmitState):
+        """Drains the per-actor queue in order; pipelined pushes with
+        in-order sends (ref: SequentialActorSubmitQueue)."""
+        try:
+            while state.queue:
+                spec, pinned, attempt = state.queue.popleft()
+                if state.dead_reason is not None:
+                    self._store_error(spec, exceptions.ActorDiedError(
+                        state.actor_id, state.dead_reason))
+                    self._unpin(pinned)
+                    continue
+                if not state.address:
+                    info = await self._gcs.call_async("WaitActorAlive", {
+                        "actor_id": state.actor_id, "timeout": 120.0,
+                    }, timeout=-1)
+                    if info is None or info["state"] != ACTOR_ALIVE:
+                        reason = (info or {}).get("death_reason",
+                                                  "actor not found")
+                        state.dead_reason = reason or "failed to start"
+                        self._store_error(spec, exceptions.ActorDiedError(
+                            state.actor_id, state.dead_reason))
+                        self._unpin(pinned)
+                        continue
+                    state.address = info["address"]
+                client = self._clients.get(state.address)
+                try:
+                    fut = await client.send_request("PushTask", spec)
+                except RpcConnectionError:
+                    await self._on_actor_connection_loss(
+                        state, spec, pinned, attempt)
+                    continue
+                asyncio.ensure_future(
+                    self._actor_reply(state, spec, pinned, attempt, fut))
+        finally:
+            state.sender_running = False
+            if state.queue:  # raced with a new enqueue
+                state.sender_running = True
+                asyncio.ensure_future(self._actor_sender(state))
+
+    async def _actor_reply(self, state, spec, pinned, attempt, fut):
+        try:
+            reply = await fut
+            self._store_returns(spec, reply["returns"])
+            self._unpin(pinned)
+        except (RpcConnectionError, asyncio.CancelledError):
+            await self._on_actor_connection_loss(state, spec, pinned, attempt)
+        except Exception as e:  # noqa: BLE001
+            self._store_error(spec, exceptions.ArtError(repr(e)))
+            self._unpin(pinned)
+
+    async def _on_actor_connection_loss(self, state, spec, pinned, attempt):
+        """The actor's worker went away mid-call.  In-flight tasks fail with
+        ActorDiedError unless the task allows retries (ref: actor
+        max_task_retries semantics — default 0: death during execution is
+        surfaced, not replayed against the restarted instance).  New tasks
+        re-resolve the address and reach the restarted actor."""
+        self._clients.invalidate(state.address)
+        state.address = ""
+        info = await self._gcs.call_async(
+            "GetActorInfo", {"actor_id": state.actor_id}, timeout=10)
+        may_restart = info is not None and info["state"] != ACTOR_DEAD
+        if may_restart and attempt < spec.max_retries:
+            await asyncio.sleep(min(0.05 * 2 ** attempt, 1.0))
+            state.queue.appendleft((spec, pinned, attempt + 1))
+            if not state.sender_running:
+                state.sender_running = True
+                asyncio.ensure_future(self._actor_sender(state))
+            return
+        if not may_restart:
+            state.dead_reason = (info or {}).get(
+                "death_reason", "worker connection lost") or "worker died"
+        self._store_error(spec, exceptions.ActorDiedError(
+            state.actor_id,
+            (info or {}).get("death_reason", "")
+            or "the actor died while this call was executing"))
+        self._unpin(pinned)
+
+    # ------------------------------------------------------------ info
+
+    def cluster_resources(self):
+        return self._gcs.call("ClusterResources", retries=3)
+
+    def available_resources(self):
+        return self._gcs.call("AvailableResources", retries=3)
+
+    def nodes(self):
+        infos = self._gcs.call("GetAllNodes", retries=3)
+        return [{
+            "NodeID": info.node_id.hex(),
+            "Alive": info.alive,
+            "Address": info.address,
+            "Resources": info.total_resources,
+            "Labels": info.labels,
+        } for info in infos.values()]

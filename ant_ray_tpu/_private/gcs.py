@@ -1,0 +1,458 @@
+"""Global control store (GCS) — the cluster head.
+
+Role of the reference's gcs_server (ref: src/ray/gcs/gcs_server.h:99): owns
+the cluster tables (nodes, actors, jobs, named actors, KV, object directory),
+performs actor scheduling, health-checks nodes, and answers placement
+queries.  All handlers run on the single IO-thread event loop, so table
+access needs no locks.  Storage is in-memory round 1 (the store-client
+abstraction for Redis persistence comes with HA).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import time
+from dataclasses import dataclass, field
+
+from ant_ray_tpu._private.config import global_config
+from ant_ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID
+from ant_ray_tpu._private.protocol import ClientPool, IoThread, RpcServer
+from ant_ray_tpu._private.specs import (
+    ACTOR_ALIVE,
+    ACTOR_DEAD,
+    ACTOR_PENDING,
+    ACTOR_RESTARTING,
+    ActorSpec,
+    NodeInfo,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ActorRecord:
+    spec: ActorSpec
+    state: str = ACTOR_PENDING
+    address: str = ""             # worker RPC addr once alive
+    node_id: NodeID | None = None
+    restarts_used: int = 0
+    death_reason: str = ""
+    state_event: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+class GcsServer:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._server = RpcServer(host, port)
+        self._nodes: dict[NodeID, NodeInfo] = {}
+        self._last_heartbeat: dict[NodeID, float] = {}
+        self._actors: dict[ActorID, ActorRecord] = {}
+        self._named_actors: dict[tuple[str, str], ActorID] = {}
+        self._kv: dict[str, bytes] = {}
+        self._object_locations: dict[ObjectID, set[NodeID]] = {}
+        self._jobs: dict[JobID, dict] = {}
+        self._clients = ClientPool()
+        self._io = IoThread.get()
+        self._health_task = None
+        self.address = ""
+
+    # ------------------------------------------------------------- lifecycle
+
+    def start(self) -> str:
+        self._server.routes({
+            "RegisterNode": self._register_node,
+            "Heartbeat": self._heartbeat,
+            "GetAllNodes": self._get_all_nodes,
+            "KVPut": self._kv_put,
+            "KVGet": self._kv_get,
+            "KVDel": self._kv_del,
+            "KVKeys": self._kv_keys,
+            "RegisterJob": self._register_job,
+            "CreateActor": self._create_actor,
+            "GetActorInfo": self._get_actor_info,
+            "WaitActorAlive": self._wait_actor_alive,
+            "GetNamedActor": self._get_named_actor,
+            "KillActor": self._kill_actor,
+            "ActorStateUpdate": self._actor_state_update,
+            "WorkerDied": self._worker_died,
+            "ObjectLocationAdd": self._object_location_add,
+            "ObjectLocationRemove": self._object_location_remove,
+            "ObjectLocationsGet": self._object_locations_get,
+            "FreeObject": self._free_object,
+            "SelectNode": self._select_node,
+            "ClusterResources": self._cluster_resources,
+            "AvailableResources": self._available_resources,
+            "Shutdown": self._shutdown_rpc,
+        })
+        self.address = self._server.start()
+        self._health_task = asyncio.run_coroutine_threadsafe(
+            self._health_check_loop(), self._io.loop)
+        logger.info("GCS listening on %s", self.address)
+        return self.address
+
+    def stop(self):
+        if self._health_task is not None:
+            self._health_task.cancel()
+        self._server.stop()
+        self._clients.close_all()
+
+    async def _shutdown_rpc(self, _payload):
+        loop = asyncio.get_running_loop()
+        loop.call_later(0.05, self.stop)
+        return True
+
+    # ------------------------------------------------------------- nodes
+
+    async def _register_node(self, info: NodeInfo):
+        self._nodes[info.node_id] = info
+        self._last_heartbeat[info.node_id] = time.monotonic()
+        logger.info("node %s registered at %s", info.node_id.hex()[:8],
+                    info.address)
+        return True
+
+    async def _heartbeat(self, payload):
+        node_id = payload["node_id"]
+        info = self._nodes.get(node_id)
+        if info is None:
+            return {"unknown_node": True}  # node must re-register
+        info.available_resources = payload["available_resources"]
+        self._last_heartbeat[node_id] = time.monotonic()
+        return {}
+
+    async def _get_all_nodes(self, _payload):
+        return dict(self._nodes)
+
+    async def _health_check_loop(self):
+        cfg = global_config()
+        period = cfg.heartbeat_period_s
+        timeout = cfg.heartbeat_period_s * cfg.num_heartbeats_timeout
+        while True:
+            await asyncio.sleep(period)
+            now = time.monotonic()
+            for node_id, info in list(self._nodes.items()):
+                if info.alive and now - self._last_heartbeat[node_id] > timeout:
+                    logger.warning("node %s missed heartbeats; marking dead",
+                                   node_id.hex()[:8])
+                    await self._on_node_death(node_id)
+
+    async def _on_node_death(self, node_id: NodeID):
+        info = self._nodes.get(node_id)
+        if info is None or not info.alive:
+            return
+        info.alive = False
+        for oid, nodes in list(self._object_locations.items()):
+            nodes.discard(node_id)
+        for record in list(self._actors.values()):
+            if record.node_id == node_id and record.state in (
+                    ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
+                await self._handle_actor_failure(record, "node died")
+
+    # ------------------------------------------------------------- kv
+
+    async def _kv_put(self, payload):
+        key, value = payload["key"], payload["value"]
+        overwrite = payload.get("overwrite", True)
+        if not overwrite and key in self._kv:
+            return False
+        self._kv[key] = value
+        return True
+
+    async def _kv_get(self, payload):
+        return self._kv.get(payload["key"])
+
+    async def _kv_del(self, payload):
+        return self._kv.pop(payload["key"], None) is not None
+
+    async def _kv_keys(self, payload):
+        prefix = payload.get("prefix", "")
+        return [k for k in self._kv if k.startswith(prefix)]
+
+    # ------------------------------------------------------------- jobs
+
+    async def _register_job(self, payload):
+        self._jobs[payload["job_id"]] = {
+            "driver_address": payload.get("driver_address", ""),
+            "started_at": time.time(),
+        }
+        return True
+
+    # ------------------------------------------------------------- actors
+
+    async def _create_actor(self, spec: ActorSpec):
+        key = (spec.namespace, spec.name)
+        if spec.name:
+            existing_id = self._named_actors.get(key)
+            if existing_id is not None:
+                existing = self._actors.get(existing_id)
+                if existing is not None and existing.state != ACTOR_DEAD:
+                    return {"error": f"actor name {spec.name!r} already taken",
+                            "existing_actor_id": existing_id}
+        record = ActorRecord(spec=spec)
+        self._actors[spec.actor_id] = record
+        if spec.name:
+            self._named_actors[key] = spec.actor_id
+        asyncio.ensure_future(self._schedule_actor(record))
+        return {"ok": True}
+
+    async def _schedule_actor(self, record: ActorRecord):
+        spec = record.spec
+        placement = spec.placement_resources or spec.resources
+        for _attempt in range(60):
+            node = self._pick_node(placement)
+            if node is not None:
+                record.node_id = node.node_id
+                client = self._clients.get(node.address)
+                try:
+                    await client.call_async("StartActorWorker", spec,
+                                            timeout=30)
+                    return  # worker will report ALIVE via ActorStateUpdate
+                except Exception as e:  # noqa: BLE001 — reschedule
+                    logger.warning("actor %s placement on %s failed: %s",
+                                   spec.actor_id.hex()[:8],
+                                   node.node_id.hex()[:8], e)
+            await asyncio.sleep(0.5)
+        record.state = ACTOR_DEAD
+        record.death_reason = "no node with required resources"
+        record.state_event.set()
+
+    def _pick_node(self, resources: dict[str, float],
+                   by_available: bool = True) -> NodeInfo | None:
+        """Least-loaded feasible node (hybrid policy seed).
+
+        by_available=True matches against the (heartbeat-fed, possibly
+        stale) availability view; by_available=False against total
+        capacity — used to distinguish "busy right now" from "can never
+        run" (ref: ClusterResourceScheduler feasibility vs availability).
+        """
+        best, best_score = None, -1.0
+        for info in self._nodes.values():
+            if not info.alive:
+                continue
+            view = (info.available_resources if by_available
+                    else info.total_resources)
+            if all(view.get(k, 0.0) >= v for k, v in resources.items()):
+                total = sum(info.total_resources.values()) or 1.0
+                free = sum(info.available_resources.values())
+                score = free / total
+                if score > best_score:
+                    best, best_score = info, score
+        return best
+
+    async def _actor_state_update(self, payload):
+        actor_id = payload["actor_id"]
+        record = self._actors.get(actor_id)
+        if record is None:
+            return False
+        record.state = payload["state"]
+        record.address = payload.get("address", record.address)
+        if payload.get("node_id") is not None:
+            record.node_id = payload["node_id"]
+        if record.state == ACTOR_DEAD:
+            record.death_reason = payload.get("reason", "")
+        record.state_event.set()
+        record.state_event = asyncio.Event()
+        return True
+
+    async def _get_actor_info(self, payload):
+        record = self._actors.get(payload["actor_id"])
+        if record is None:
+            return None
+        return self._actor_info(record)
+
+    def _actor_info(self, record: ActorRecord) -> dict:
+        return {
+            "actor_id": record.spec.actor_id,
+            "state": record.state,
+            "address": record.address,
+            "node_id": record.node_id,
+            "class_name": record.spec.class_name,
+            "death_reason": record.death_reason,
+            "name": record.spec.name,
+        }
+
+    async def _wait_actor_alive(self, payload):
+        record = self._actors.get(payload["actor_id"])
+        if record is None:
+            return None
+        deadline = time.monotonic() + payload.get("timeout", 30.0)
+        while record.state not in (ACTOR_ALIVE, ACTOR_DEAD):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            event = record.state_event
+            try:
+                await asyncio.wait_for(event.wait(), remaining)
+            except asyncio.TimeoutError:
+                break
+        return self._actor_info(record)
+
+    async def _get_named_actor(self, payload):
+        key = (payload.get("namespace", "default"), payload["name"])
+        actor_id = self._named_actors.get(key)
+        if actor_id is None:
+            return None
+        record = self._actors.get(actor_id)
+        if record is None or record.state == ACTOR_DEAD:
+            return None
+        return self._actor_info(record)
+
+    async def _kill_actor(self, payload):
+        record = self._actors.get(payload["actor_id"])
+        if record is None:
+            return False
+        record.spec.max_restarts = 0 if payload.get("no_restart", True) else \
+            record.spec.max_restarts
+        if record.node_id is not None:
+            node = self._nodes.get(record.node_id)
+            if node is not None and node.alive:
+                client = self._clients.get(node.address)
+                try:
+                    await client.call_async(
+                        "KillActorWorker",
+                        {"actor_id": record.spec.actor_id}, timeout=10)
+                except Exception:  # noqa: BLE001 — worker may be gone already
+                    pass
+        record.state = ACTOR_DEAD
+        record.death_reason = "killed via kill()"
+        record.state_event.set()
+        return True
+
+    async def _worker_died(self, payload):
+        actor_id = payload.get("actor_id")
+        if actor_id is not None:
+            record = self._actors.get(actor_id)
+            if record is not None and record.state != ACTOR_DEAD:
+                await self._handle_actor_failure(
+                    record, payload.get("reason", "worker died"))
+        return True
+
+    async def _handle_actor_failure(self, record: ActorRecord, reason: str):
+        if record.restarts_used < record.spec.max_restarts:
+            record.restarts_used += 1
+            record.state = ACTOR_RESTARTING
+            record.address = ""
+            record.state_event.set()
+            record.state_event = asyncio.Event()
+            logger.info("restarting actor %s (%d/%d): %s",
+                        record.spec.actor_id.hex()[:8], record.restarts_used,
+                        record.spec.max_restarts, reason)
+            asyncio.ensure_future(self._schedule_actor(record))
+        else:
+            record.state = ACTOR_DEAD
+            record.death_reason = reason
+            record.state_event.set()
+            record.state_event = asyncio.Event()
+
+    # ------------------------------------------------------------- objects
+
+    async def _object_location_add(self, payload):
+        self._object_locations.setdefault(
+            payload["object_id"], set()).add(payload["node_id"])
+        return True
+
+    async def _object_location_remove(self, payload):
+        locs = self._object_locations.get(payload["object_id"])
+        if locs is not None:
+            locs.discard(payload["node_id"])
+            if not locs:
+                del self._object_locations[payload["object_id"]]
+        return True
+
+    async def _object_locations_get(self, payload):
+        node_ids = self._object_locations.get(payload["object_id"], set())
+        return [self._nodes[nid] for nid in node_ids
+                if nid in self._nodes and self._nodes[nid].alive]
+
+    async def _free_object(self, payload):
+        oid = payload["object_id"]
+        node_ids = self._object_locations.pop(oid, set())
+        for nid in node_ids:
+            node = self._nodes.get(nid)
+            if node is None or not node.alive:
+                continue
+            client = self._clients.get(node.address)
+            try:
+                await client.oneway_async("DeleteObject", {"object_id": oid})
+            except Exception:  # noqa: BLE001
+                pass
+        return True
+
+    # ------------------------------------------------------------- placement
+
+    async def _select_node(self, payload):
+        resources = payload.get("resources", {})
+        exclude = payload.get("exclude")
+
+        def _excluding(by_available: bool) -> NodeInfo | None:
+            node = self._pick_node(resources, by_available)
+            if node is not None and node.node_id == exclude:
+                others = [
+                    n for n in self._nodes.values()
+                    if n.alive and n.node_id != exclude and all(
+                        (n.available_resources if by_available
+                         else n.total_resources).get(k, 0) >= v
+                        for k, v in resources.items())
+                ]
+                node = others[0] if others else None
+            return node
+
+        # Prefer a node that can run now; fall back to one that is merely
+        # busy (the lease queues there) before declaring infeasibility.
+        return _excluding(True) or _excluding(False)
+
+    async def _cluster_resources(self, _payload):
+        totals: dict[str, float] = {}
+        for info in self._nodes.values():
+            if info.alive:
+                for k, v in info.total_resources.items():
+                    totals[k] = totals.get(k, 0.0) + v
+        return totals
+
+    async def _available_resources(self, _payload):
+        totals: dict[str, float] = {}
+        for info in self._nodes.values():
+            if info.alive:
+                for k, v in info.available_resources.items():
+                    totals[k] = totals.get(k, 0.0) + v
+        return totals
+
+
+def main():  # pragma: no cover — exercised via subprocess in tests
+    import argparse
+    import signal
+    import sys
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--port", type=int, required=True)
+    parser.add_argument("--monitor-pid", type=int, default=0,
+                        help="exit when this process disappears")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=global_config().log_level,
+        format="[gcs %(levelname)s %(asctime)s] %(message)s")
+    server = GcsServer(port=args.port)
+    server.start()
+    print(f"GCS_READY {server.address}", flush=True)
+
+    stop = False
+
+    def _term(*_a):
+        nonlocal stop
+        stop = True
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    while not stop:
+        time.sleep(0.2)
+        if args.monitor_pid and not os.path.exists(
+                f"/proc/{args.monitor_pid}"):
+            logger.warning("monitored pid %d gone; exiting", args.monitor_pid)
+            break
+    server.stop()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,30 @@
+"""Central jax import point.
+
+Some environments pin the platform through plugins/sitecustomize in ways
+that ignore JAX_PLATFORMS (e.g. a TPU tunnel plugin); honoring our own
+``ART_JAX_PLATFORM`` via jax.config *after* import is the reliable
+override.  Every module in this package imports jax through
+:func:`import_jax` so tests can force the virtual CPU mesh while the same
+process tree defaults to the real TPU elsewhere.
+"""
+
+from __future__ import annotations
+
+import os
+
+_configured = False
+
+
+def import_jax():
+    global _configured
+    import jax  # noqa: PLC0415
+
+    if not _configured:
+        platform = os.environ.get("ART_JAX_PLATFORM")
+        if platform:
+            try:
+                jax.config.update("jax_platforms", platform)
+            except Exception:  # noqa: BLE001 — backend already initialized
+                pass
+        _configured = True
+    return jax

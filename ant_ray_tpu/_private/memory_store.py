@@ -1,0 +1,78 @@
+"""In-process memory store for owned objects (ref:
+src/ray/core_worker/store_provider/memory_store/).
+
+Entries hold the terminal state of every object this process owns:
+    ("pending", None)          — task not finished / value not produced yet
+    ("inline", payload)        — small object, serialized payload held here
+    ("error", payload)         — serialized exception (raised at get)
+    ("plasma", size)           — large object, lives in the shm object plane
+
+Thread-safe producers; consumers wait either synchronously (app threads) or
+asynchronously (io-loop handlers serving borrower GetObject RPCs).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+from ant_ray_tpu._private.ids import ObjectID
+
+
+class MemoryStore:
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self._entries: dict[ObjectID, tuple] = {}
+        self._async_waiters: dict[ObjectID, list[asyncio.Future]] = {}
+        self._lock = threading.Lock()
+
+    def mark_pending(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._entries.setdefault(object_id, ("pending", None))
+
+    def put(self, object_id: ObjectID, kind: str, value) -> None:
+        assert kind in ("inline", "error", "plasma"), kind
+        with self._lock:
+            self._entries[object_id] = (kind, value)
+            waiters = self._async_waiters.pop(object_id, [])
+        for fut in waiters:
+            self._loop.call_soon_threadsafe(self._resolve, fut, (kind, value))
+
+    @staticmethod
+    def _resolve(fut: asyncio.Future, entry: tuple) -> None:
+        if not fut.done():
+            fut.set_result(entry)
+
+    def get_entry(self, object_id: ObjectID) -> tuple | None:
+        with self._lock:
+            return self._entries.get(object_id)
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry is not None and entry[0] != "pending"
+
+    def is_owned(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._entries
+
+    async def wait_async(self, object_id: ObjectID,
+                         timeout: float | None = None) -> tuple:
+        """Await a terminal entry (must run on the io loop)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is not None and entry[0] != "pending":
+                return entry
+            fut = self._loop.create_future()
+            self._async_waiters.setdefault(object_id, []).append(fut)
+        if timeout is None:
+            return await fut
+        return await asyncio.wait_for(fut, timeout)
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._entries.pop(object_id, None)
+            waiters = self._async_waiters.pop(object_id, [])
+        for fut in waiters:
+            self._loop.call_soon_threadsafe(
+                lambda f=fut: f.cancel() if not f.done() else None)

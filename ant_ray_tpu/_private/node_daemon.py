@@ -1,0 +1,477 @@
+"""Per-node daemon ("raylet"-equivalent).
+
+Role of the reference's raylet (ref: src/ray/raylet/node_manager.h:134,
+worker_pool.h:285, local_object_manager.h): owns the node's worker pool and
+shared-memory object store, grants worker leases against a local resource
+view with spillback hints to other nodes, pulls remote objects in chunks,
+monitors worker processes, and heartbeats the node's resource availability
+to the GCS.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import time
+import uuid
+from dataclasses import dataclass, field
+
+from ant_ray_tpu._private.config import global_config
+from ant_ray_tpu._private.ids import NodeID, ObjectID, WorkerID
+from ant_ray_tpu._private.object_store import ObjectStore, default_store_capacity
+from ant_ray_tpu._private.protocol import ClientPool, IoThread, RpcServer
+from ant_ray_tpu._private.specs import ACTOR_DEAD, ActorSpec, NodeInfo
+
+logger = logging.getLogger(__name__)
+
+IDLE, LEASED, ACTOR, STARTING = "idle", "leased", "actor", "starting"
+
+
+@dataclass
+class WorkerHandle:
+    worker_id: WorkerID
+    proc: subprocess.Popen
+    address: str = ""
+    state: str = STARTING
+    lease_resources: dict[str, float] = field(default_factory=dict)
+    actor_spec: ActorSpec | None = None
+    blocked: bool = False
+    registered: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+class NodeManager:
+    def __init__(self, gcs_address: str, resources: dict[str, float],
+                 session_dir: str, host: str = "127.0.0.1", port: int = 0,
+                 labels: dict[str, str] | None = None):
+        self.node_id = NodeID.from_random()
+        self._gcs_address = gcs_address
+        self._server = RpcServer(host, port)
+        self._clients = ClientPool()
+        self._io = IoThread.get()
+        self._session_dir = session_dir
+        self._labels = dict(labels or {})
+
+        cfg = global_config()
+        store_capacity = cfg.object_store_memory or default_store_capacity()
+        store_dir = os.path.join(
+            "/dev/shm" if os.path.isdir("/dev/shm") else session_dir,
+            f"art_{uuid.uuid4().hex[:8]}_{self.node_id.hex()[:8]}")
+        self.store = ObjectStore(store_dir, store_capacity)
+
+        self._total = dict(resources)
+        self._available = dict(resources)
+        self._workers: dict[WorkerID, WorkerHandle] = {}
+        self._lease_event = asyncio.Event()
+        self._max_workers = int(
+            cfg.max_workers_per_node or max(1, int(resources.get("CPU", 1))))
+        self._tasks: list = []
+        self._stopping = False
+        self.address = ""
+
+    # ------------------------------------------------------------ lifecycle
+
+    def start(self) -> str:
+        self._server.routes({
+            "RegisterWorker": self._register_worker,
+            "LeaseWorker": self._lease_worker,
+            "ReturnWorker": self._return_worker,
+            "WorkerBlocked": self._worker_blocked,
+            "WorkerUnblocked": self._worker_unblocked,
+            "StartActorWorker": self._start_actor_worker,
+            "KillActorWorker": self._kill_actor_worker,
+            "SealObject": self._seal_object,
+            "EnsureLocal": self._ensure_local,
+            "ReadChunk": self._read_chunk,
+            "DeleteObject": self._delete_object,
+            "ContainsObject": self._contains_object,
+            "GetNodeInfo": self._get_node_info,
+            "Shutdown": self._shutdown_rpc,
+        })
+        self.address = self._server.start()
+        fut = asyncio.run_coroutine_threadsafe(self._register(), self._io.loop)
+        fut.result(timeout=30)
+        self._tasks.append(asyncio.run_coroutine_threadsafe(
+            self._heartbeat_loop(), self._io.loop))
+        self._tasks.append(asyncio.run_coroutine_threadsafe(
+            self._monitor_workers_loop(), self._io.loop))
+        prestart = global_config().num_prestart_workers
+        if prestart < 0:
+            prestart = min(2, self._max_workers)
+        for _ in range(min(prestart, self._max_workers)):
+            self._io.run_coro(self._prestart_worker())
+        logger.info("node %s listening on %s (resources=%s)",
+                    self.node_id.hex()[:8], self.address, self._total)
+        return self.address
+
+    async def _prestart_worker(self):
+        self._spawn_worker()
+
+    def _node_info(self) -> NodeInfo:
+        return NodeInfo(
+            node_id=self.node_id,
+            address=self.address,
+            total_resources=dict(self._total),
+            available_resources=dict(self._available),
+            object_store_dir=self.store.directory,
+            labels=dict(self._labels),
+        )
+
+    async def _register(self):
+        gcs = self._clients.get(self._gcs_address)
+        await gcs.call_async("RegisterNode", self._node_info(), timeout=30)
+
+    async def _get_node_info(self, _payload):
+        return self._node_info()
+
+    async def _heartbeat_loop(self):
+        gcs = self._clients.get(self._gcs_address)
+        period = global_config().heartbeat_period_s
+        while not self._stopping:
+            try:
+                reply = await gcs.call_async("Heartbeat", {
+                    "node_id": self.node_id,
+                    "available_resources": dict(self._available),
+                }, timeout=10)
+                if reply.get("unknown_node"):
+                    await self._register()
+            except Exception as e:  # noqa: BLE001 — head may be restarting
+                logger.debug("heartbeat failed: %s", e)
+            await asyncio.sleep(period)
+
+    def stop(self):
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for handle in list(self._workers.values()):
+            self._terminate_worker(handle)
+        self._server.stop()
+        self._clients.close_all()
+        self.store.destroy()
+
+    async def _shutdown_rpc(self, _payload):
+        asyncio.get_running_loop().call_later(0.05, self.stop)
+        return True
+
+    # ------------------------------------------------------------ workers
+
+    def _spawn_worker(self, actor_spec: ActorSpec | None = None) -> WorkerHandle:
+        worker_id = WorkerID.from_random()
+        env = dict(os.environ)
+        env["ART_NODE_ADDRESS"] = self.address
+        env["ART_GCS_ADDRESS"] = self._gcs_address
+        env["ART_STORE_DIR"] = self.store.directory
+        env["ART_WORKER_ID"] = worker_id.hex()
+        env["ART_NODE_ID"] = self.node_id.hex()
+        log_path = os.path.join(self._session_dir, "logs",
+                                f"worker-{worker_id.hex()[:8]}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        log_file = open(log_path, "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ant_ray_tpu._private.worker_main"],
+            env=env, stdout=log_file, stderr=subprocess.STDOUT,
+            start_new_session=True)
+        log_file.close()
+        handle = WorkerHandle(worker_id, proc, actor_spec=actor_spec)
+        self._workers[worker_id] = handle
+        return handle
+
+    async def _register_worker(self, payload):
+        worker_id = payload["worker_id"]
+        handle = self._workers.get(worker_id)
+        if handle is None:
+            return {"error": "unknown worker"}
+        handle.address = payload["address"]
+        was_actor = handle.actor_spec is not None
+        if was_actor:
+            client = self._clients.get(handle.address)
+            asyncio.ensure_future(
+                client.call_async("InstantiateActor", handle.actor_spec,
+                                  timeout=-1))
+            handle.state = ACTOR
+        else:
+            handle.state = IDLE
+            self._lease_event.set()
+        handle.registered.set()
+        return {"ok": True}
+
+    async def _monitor_workers_loop(self):
+        gcs = self._clients.get(self._gcs_address)
+        while not self._stopping:
+            await asyncio.sleep(0.1)
+            for worker_id, handle in list(self._workers.items()):
+                if handle.proc.poll() is None:
+                    continue
+                del self._workers[worker_id]
+                if handle.state == LEASED and not handle.blocked:
+                    self._release(handle.lease_resources)
+                if handle.state == ACTOR and handle.actor_spec is not None:
+                    self._release(handle.actor_spec.resources)
+                    try:
+                        await gcs.call_async("WorkerDied", {
+                            "node_id": self.node_id,
+                            "worker_id": worker_id,
+                            "actor_id": handle.actor_spec.actor_id,
+                            "reason": f"worker exited with code "
+                                      f"{handle.proc.returncode}",
+                        }, timeout=10)
+                    except Exception:  # noqa: BLE001
+                        pass
+                self._lease_event.set()
+
+    def _terminate_worker(self, handle: WorkerHandle):
+        if handle.proc.poll() is None:
+            handle.proc.terminate()
+            try:
+                handle.proc.wait(timeout=2)
+            except subprocess.TimeoutExpired:
+                handle.proc.kill()
+
+    # ------------------------------------------------------------ leasing
+
+    def _can_allocate(self, demand: dict[str, float]) -> bool:
+        return all(self._available.get(k, 0.0) >= v for k, v in demand.items())
+
+    def _feasible(self, demand: dict[str, float]) -> bool:
+        return all(self._total.get(k, 0.0) >= v for k, v in demand.items())
+
+    def _allocate(self, demand: dict[str, float]):
+        for k, v in demand.items():
+            self._available[k] = self._available.get(k, 0.0) - v
+
+    def _release(self, demand: dict[str, float]):
+        for k, v in demand.items():
+            self._available[k] = self._available.get(k, 0.0) + v
+        self._lease_event.set()
+
+    def _idle_worker(self) -> WorkerHandle | None:
+        for handle in self._workers.values():
+            if handle.state == IDLE and handle.address:
+                return handle
+        return None
+
+    def _pool_size(self) -> int:
+        """Workers counted against the pool cap: task workers that are
+        actually occupying a cpu.  Blocked workers (parked in get()) and
+        dedicated actor workers don't count, so nested task chains can
+        always make progress (ref: worker_pool starts workers beyond
+        num_cpus when existing ones are blocked)."""
+        return sum(1 for h in self._workers.values()
+                   if h.actor_spec is None and not h.blocked)
+
+    async def _lease_worker(self, payload):
+        """Grant a worker lease or reply with a spillback target
+        (ref: NodeManager::HandleRequestWorkerLease, node_manager.cc:1794)."""
+        demand: dict[str, float] = payload.get("resources", {})
+        gcs = self._clients.get(self._gcs_address)
+
+        if not self._feasible(demand):
+            node = await gcs.call_async(
+                "SelectNode", {"resources": demand, "exclude": self.node_id},
+                timeout=10)
+            if node is not None:
+                return {"spill": node.address}
+            return {"infeasible": True}
+
+        start = time.monotonic()
+        spill_deadline = start + global_config().spillback_timeout_s
+        while True:
+            if self._can_allocate(demand):
+                worker = self._idle_worker()
+                if worker is None and self._pool_size() < self._max_workers:
+                    handle = self._spawn_worker()
+                    await handle.registered.wait()
+                    worker = handle if handle.state == IDLE else None
+                if worker is not None:
+                    self._allocate(demand)
+                    worker.state = LEASED
+                    worker.lease_resources = dict(demand)
+                    return {"granted": worker.address,
+                            "worker_id": worker.worker_id}
+            elif time.monotonic() > spill_deadline:
+                node = await gcs.call_async(
+                    "SelectNode",
+                    {"resources": demand, "exclude": self.node_id},
+                    timeout=10)
+                if node is not None and node.node_id != self.node_id:
+                    return {"spill": node.address}
+                spill_deadline = time.monotonic() + \
+                    global_config().spillback_timeout_s
+            self._lease_event.clear()
+            try:
+                await asyncio.wait_for(self._lease_event.wait(), timeout=0.2)
+            except asyncio.TimeoutError:
+                pass
+
+    async def _return_worker(self, payload):
+        handle = self._workers.get(payload["worker_id"])
+        if handle is None:
+            return False
+        if handle.state == LEASED:
+            if not handle.blocked:
+                self._release(handle.lease_resources)
+            handle.blocked = False
+            handle.lease_resources = {}
+            handle.state = IDLE
+            self._lease_event.set()
+        return True
+
+    async def _worker_blocked(self, payload):
+        """Worker blocked in get(): release its cpu so nested tasks can run
+        (ref: raylet releases resources for blocked workers)."""
+        handle = self._workers.get(payload["worker_id"])
+        if handle is not None and handle.state == LEASED and not handle.blocked:
+            handle.blocked = True
+            self._release(handle.lease_resources)
+        return True
+
+    async def _worker_unblocked(self, payload):
+        handle = self._workers.get(payload["worker_id"])
+        if handle is not None and handle.state == LEASED and handle.blocked:
+            handle.blocked = False
+            # Re-acquire even if it drives availability negative: the worker
+            # already holds the lease; balance restores at return.
+            self._allocate(handle.lease_resources)
+        return True
+
+    # ------------------------------------------------------------ actors
+
+    async def _start_actor_worker(self, spec: ActorSpec):
+        placement = spec.placement_resources or spec.resources
+        if not self._feasible(placement):
+            raise RuntimeError("insufficient node resources for actor")
+        # Only the running demand is held for the actor's lifetime
+        # (placement demand is a scheduling-time constraint).
+        self._allocate(spec.resources)
+        self._spawn_worker(actor_spec=spec)
+        return True
+
+    async def _kill_actor_worker(self, payload):
+        actor_id = payload["actor_id"]
+        for handle in list(self._workers.values()):
+            if handle.actor_spec is not None and \
+                    handle.actor_spec.actor_id == actor_id:
+                # Clear the spec first so the monitor loop doesn't report
+                # an (expected) death to the GCS.
+                spec = handle.actor_spec
+                handle.actor_spec = None
+                handle.state = STARTING
+                self._release(spec.resources)
+                self._terminate_worker(handle)
+                return True
+        return False
+
+    # ------------------------------------------------------------ objects
+
+    async def _seal_object(self, payload):
+        """A colocated process wrote `<store_dir>/<hex>.tmp.<nonce>`; rename
+        into place and account for it."""
+        object_id: ObjectID = payload["object_id"]
+        final = self.store.seal_file(object_id, payload["tmp_path"])
+        gcs = self._clients.get(self._gcs_address)
+        await gcs.call_async("ObjectLocationAdd", {
+            "object_id": object_id, "node_id": self.node_id}, timeout=10)
+        return {"path": final}
+
+    async def _contains_object(self, payload):
+        return self.store.contains(payload["object_id"])
+
+    async def _ensure_local(self, payload):
+        """Make the object local (pull from a holder if needed); reply path
+        (ref: PullManager, src/ray/object_manager/pull_manager.h:50)."""
+        object_id: ObjectID = payload["object_id"]
+        deadline = time.monotonic() + payload.get("timeout", 60.0)
+        if self.store.contains(object_id):
+            self.store.touch(object_id)
+            return {"path": self.store.path_of(object_id)}
+        gcs = self._clients.get(self._gcs_address)
+        chunk = global_config().object_transfer_chunk_size
+        while time.monotonic() < deadline:
+            holders: list[NodeInfo] = await gcs.call_async(
+                "ObjectLocationsGet", {"object_id": object_id}, timeout=10)
+            holders = [h for h in holders if h.node_id != self.node_id]
+            for holder in holders:
+                try:
+                    remote = self._clients.get(holder.address)
+                    tmp = self.store.path_of(object_id) + ".pull"
+                    offset = 0
+                    with open(tmp, "wb") as f:
+                        while True:
+                            data = await remote.call_async("ReadChunk", {
+                                "object_id": object_id,
+                                "offset": offset, "length": chunk,
+                            }, timeout=60)
+                            if not data:
+                                break
+                            f.write(data)
+                            offset += len(data)
+                            if len(data) < chunk:
+                                break
+                    await self._seal_object(
+                        {"object_id": object_id, "tmp_path": tmp})
+                    return {"path": self.store.path_of(object_id)}
+                except Exception as e:  # noqa: BLE001 — try next holder
+                    logger.debug("pull of %s from %s failed: %s",
+                                 object_id.hex()[:8], holder.address, e)
+            await asyncio.sleep(0.05)
+        return {"timeout": True}
+
+    async def _read_chunk(self, payload):
+        return self.store.read_chunk(
+            payload["object_id"], payload["offset"], payload["length"])
+
+    async def _delete_object(self, payload):
+        self.store.delete(payload["object_id"])
+        return True
+
+
+def main():  # pragma: no cover — exercised via subprocess in tests
+    import argparse
+    import json
+    import signal
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--resources", default="{}")
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--labels", default="{}")
+    parser.add_argument("--monitor-pid", type=int, default=0,
+                        help="exit when this process disappears")
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=global_config().log_level,
+        format="[noded %(levelname)s %(asctime)s] %(message)s")
+    manager = NodeManager(
+        gcs_address=args.gcs_address,
+        resources=json.loads(args.resources),
+        session_dir=args.session_dir,
+        port=args.port,
+        labels=json.loads(args.labels),
+    )
+    manager.start()
+    print(f"NODED_READY {manager.address}", flush=True)
+
+    stop = False
+
+    def _term(*_a):
+        nonlocal stop
+        stop = True
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    while not stop:
+        time.sleep(0.2)
+        if args.monitor_pid and not os.path.exists(
+                f"/proc/{args.monitor_pid}"):
+            logger.warning("monitored pid %d gone; exiting", args.monitor_pid)
+            break
+    manager.stop()
+    sys.exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,227 @@
+"""Node-local shared-memory object store ("plasma"-equivalent).
+
+Role of the reference's plasma store (ref: src/ray/object_manager/plasma/
+store.h:55, obj_lifecycle_mgr.h, eviction_policy.h), redesigned: each object
+is one file in a tmpfs session directory (/dev/shm on Linux), mmap'd by
+readers for zero-copy access.  The node daemon owns the store; clients in
+worker/driver processes open the files directly by path, so a local `get`
+never copies through an RPC.  Pinning + LRU eviction of unpinned objects;
+capacity enforcement with create-backpressure left to the node daemon.
+
+Why files instead of multiprocessing.shared_memory: named SharedMemory
+segments are entangled with the resource tracker (which unlinks segments
+when their creating process exits); plain tmpfs files have exactly the
+lifetime we manage, and POSIX keeps mappings valid after unlink so readers
+holding an mmap survive eviction.
+"""
+
+from __future__ import annotations
+
+import logging
+import mmap
+import os
+import threading
+import time
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+from ant_ray_tpu._private.ids import ObjectID
+from ant_ray_tpu.exceptions import ObjectLostError
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class ObjectEntry:
+    object_id: ObjectID
+    size: int
+    pin_count: int = 0
+    sealed: bool = False
+    created_at: float = field(default_factory=time.monotonic)
+
+
+class ObjectStore:
+    """Node-side store: tracks entries, capacity, pins, and LRU eviction."""
+
+    def __init__(self, directory: str, capacity_bytes: int):
+        self._dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._capacity = capacity_bytes
+        self._used = 0
+        self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
+        self._lock = threading.RLock()
+
+    # ---- paths
+
+    def path_of(self, object_id: ObjectID) -> str:
+        return os.path.join(self._dir, object_id.hex())
+
+    @property
+    def directory(self) -> str:
+        return self._dir
+
+    @property
+    def capacity(self) -> int:
+        return self._capacity
+
+    @property
+    def used(self) -> int:
+        return self._used
+
+    # ---- write path
+
+    def create(self, object_id: ObjectID, payload: bytes | memoryview) -> str:
+        """Write + seal an object; returns the file path.
+
+        Evicts unpinned LRU objects if needed; raises ObjectStoreFullError
+        when the payload cannot fit even after eviction.
+        """
+        size = len(payload)
+        with self._lock:
+            if object_id in self._entries:
+                return self.path_of(object_id)  # idempotent re-put
+            self._ensure_space(size)
+            path = self.path_of(object_id)
+            with open(path, "wb") as f:
+                f.write(payload)
+            self._entries[object_id] = ObjectEntry(object_id, size, sealed=True)
+            self._used += size
+            return path
+
+    def seal_file(self, object_id: ObjectID, tmp_path: str) -> str:
+        """Adopt a fully-written temp file as a sealed object (zero-copy
+        producer path: colocated workers write into the store directory and
+        the daemon renames into place)."""
+        size = os.path.getsize(tmp_path)
+        with self._lock:
+            if object_id in self._entries:
+                os.unlink(tmp_path)
+                return self.path_of(object_id)
+            self._ensure_space(size)
+            final = self.path_of(object_id)
+            os.rename(tmp_path, final)
+            self._entries[object_id] = ObjectEntry(object_id, size, sealed=True)
+            self._used += size
+            return final
+
+    def _ensure_space(self, size: int) -> None:
+        if size > self._capacity:
+            raise ObjectStoreFullError(
+                f"object of {size} bytes exceeds store capacity "
+                f"{self._capacity}")
+        while self._used + size > self._capacity:
+            evicted = self._evict_one()
+            if not evicted:
+                raise ObjectStoreFullError(
+                    f"store full ({self._used}/{self._capacity} bytes) and "
+                    "all objects pinned")
+
+    def _evict_one(self) -> bool:
+        for oid, entry in self._entries.items():
+            if entry.pin_count == 0:
+                self._delete_locked(oid)
+                return True
+        return False
+
+    def _delete_locked(self, object_id: ObjectID) -> None:
+        entry = self._entries.pop(object_id, None)
+        if entry is None:
+            return
+        self._used -= entry.size
+        try:
+            os.unlink(self.path_of(object_id))
+        except FileNotFoundError:
+            pass
+
+    # ---- read path
+
+    def contains(self, object_id: ObjectID) -> bool:
+        with self._lock:
+            return object_id in self._entries
+
+    def size_of(self, object_id: ObjectID) -> int | None:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            return entry.size if entry else None
+
+    def touch(self, object_id: ObjectID) -> None:
+        """LRU bump."""
+        with self._lock:
+            if object_id in self._entries:
+                self._entries.move_to_end(object_id)
+
+    def pin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                raise ObjectLostError(object_id, "pin on missing object")
+            entry.pin_count += 1
+
+    def unpin(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is not None and entry.pin_count > 0:
+                entry.pin_count -= 1
+
+    def delete(self, object_id: ObjectID) -> None:
+        with self._lock:
+            self._delete_locked(object_id)
+
+    def list_objects(self) -> list[ObjectID]:
+        with self._lock:
+            return list(self._entries)
+
+    def read_chunk(self, object_id: ObjectID, offset: int, length: int) -> bytes:
+        """Read a chunk for cross-node transfer."""
+        with self._lock:
+            if object_id not in self._entries:
+                raise ObjectLostError(object_id, "read on missing object")
+            self._entries.move_to_end(object_id)
+        with open(self.path_of(object_id), "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    def destroy(self) -> None:
+        with self._lock:
+            for oid in list(self._entries):
+                self._delete_locked(oid)
+        try:
+            os.rmdir(self._dir)
+        except OSError:
+            pass
+
+
+class ObjectStoreFullError(ObjectLostError):
+    def __init__(self, message: str):
+        Exception.__init__(self, message)
+
+
+def open_object(path: str) -> memoryview:
+    """Client-side zero-copy read: mmap the sealed object file.
+
+    The returned memoryview keeps the mapping alive; deserialized arrays
+    referencing it remain valid even if the store evicts (unlinks) the file.
+    """
+    with open(path, "rb") as f:
+        size = os.fstat(f.fileno()).st_size
+        if size == 0:
+            return memoryview(b"")
+        mapping = mmap.mmap(f.fileno(), size, prot=mmap.PROT_READ)
+        return memoryview(mapping)
+
+
+def default_store_capacity() -> int:
+    """30% of system memory, capped by available tmpfs space."""
+    try:
+        pages = os.sysconf("SC_PHYS_PAGES")
+        page_size = os.sysconf("SC_PAGE_SIZE")
+        total = pages * page_size
+    except (ValueError, OSError):  # pragma: no cover
+        total = 8 << 30
+    cap = int(total * 0.3)
+    try:
+        stat = os.statvfs("/dev/shm")
+        cap = min(cap, int(stat.f_bavail * stat.f_frsize * 0.8))
+    except OSError:  # pragma: no cover
+        pass
+    return max(cap, 64 << 20)

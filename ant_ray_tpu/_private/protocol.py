@@ -249,7 +249,7 @@ class RpcClient:
         try:
             while True:
                 kind, msg_id, _method, payload = await _read_frame(reader)
-                fut = self._pending.pop(msg_id, None)
+                fut = self._pending.get(msg_id)
                 if fut is None or fut.done():
                     continue
                 if kind == _ERR:
@@ -269,22 +269,38 @@ class RpcClient:
                     fut.set_exception(err)
             self._pending.clear()
 
-    async def call_async(
-        self, method: str, payload: Any = None, timeout: float | None = None
-    ) -> Any:
+    async def send_request(self, method: str, payload: Any = None) -> asyncio.Future:
+        """Write the request frame now; return the future for the reply.
+
+        Callers needing strict send ordering (e.g. per-actor task queues)
+        await this sequentially and await the reply futures separately, so
+        ordering and pipelining compose.
+        """
         if self._chaos.should_fail(method):
             raise RpcConnectionError(f"[chaos] injected failure for {method}")
         await self._ensure_connected()
         msg_id = next(self._counter)
         fut = asyncio.get_running_loop().create_future()
         self._pending[msg_id] = fut
+        # Cleanup on any terminal state — including cancellation by a
+        # wait_for timeout — so abandoned calls never leak their entry.
+        fut.add_done_callback(
+            lambda _f, mid=msg_id: self._pending.pop(mid, None))
         self._writer.write(_encode_frame((_REQ, msg_id, method, payload)))
         await self._writer.drain()
-        timeout = timeout if timeout is not None else global_config().rpc_call_timeout_s
+        return fut
+
+    async def call_async(
+        self, method: str, payload: Any = None, timeout: float | None = None
+    ) -> Any:
+        fut = await self.send_request(method, payload)
+        if timeout is None:
+            timeout = global_config().rpc_call_timeout_s
+        if timeout <= 0:  # explicit "no deadline" (long-running task pushes)
+            return await fut
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError as e:
-            self._pending.pop(msg_id, None)
             raise RpcTimeoutError(f"{method} to {self.address} timed out") from e
 
     async def oneway_async(self, method: str, payload: Any = None) -> None:

@@ -30,9 +30,9 @@ def _maybe_jax():
     global _jax
     if _jax is None:
         try:
-            import jax  # noqa: PLC0415
+            from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
 
-            _jax = jax
+            _jax = import_jax()
         except ImportError:  # pragma: no cover
             _jax = False
     return _jax or None

@@ -1,0 +1,144 @@
+"""Cluster process bootstrap (ref: python/ray/_private/services.py +
+node.py — start/stop of gcs_server, raylet, workers)."""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+import uuid
+
+from ant_ray_tpu._private.protocol import ClientPool, find_free_port
+
+logger = logging.getLogger(__name__)
+
+_READY_TIMEOUT_S = 30.0
+
+
+def _wait_ready(proc: subprocess.Popen, marker: str) -> str:
+    """Read the child's stdout until `<marker> <address>` appears."""
+    deadline = time.monotonic() + _READY_TIMEOUT_S
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if not line:
+            raise RuntimeError(
+                f"process exited (code={proc.poll()}) before ready")
+        text = line.decode(errors="replace").strip()
+        if text.startswith(marker):
+            return text.split(" ", 1)[1]
+    raise RuntimeError(f"timed out waiting for {marker}")
+
+
+def start_gcs(session_dir: str) -> tuple[subprocess.Popen, str]:
+    port = find_free_port()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ant_ray_tpu._private.gcs",
+         "--port", str(port), "--monitor-pid", str(os.getpid())],
+        stdout=subprocess.PIPE, stderr=_log_file(session_dir, "gcs.err"),
+        start_new_session=True)
+    address = _wait_ready(proc, "GCS_READY")
+    return proc, address
+
+
+def start_node(gcs_address: str, resources: dict, session_dir: str,
+               labels: dict | None = None) -> tuple[subprocess.Popen, str]:
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ant_ray_tpu._private.node_daemon",
+         "--gcs-address", gcs_address,
+         "--resources", json.dumps(resources),
+         "--session-dir", session_dir,
+         "--labels", json.dumps(labels or {}),
+         "--monitor-pid", str(os.getpid())],
+        stdout=subprocess.PIPE, stderr=_log_file(session_dir, "noded.err"),
+        start_new_session=True)
+    address = _wait_ready(proc, "NODED_READY")
+    return proc, address
+
+
+def _log_file(session_dir: str, name: str):
+    log_dir = os.path.join(session_dir, "logs")
+    os.makedirs(log_dir, exist_ok=True)
+    return open(os.path.join(log_dir, name), "ab")
+
+
+def default_resources(num_cpus: int | None, num_tpus: int | None,
+                      resources: dict | None) -> dict:
+    out = dict(resources or {})
+    out["CPU"] = float(num_cpus if num_cpus is not None
+                       else (os.cpu_count() or 1))
+    if num_tpus is not None:
+        out["TPU"] = float(num_tpus)
+    else:
+        from ant_ray_tpu._private.accelerators import tpu  # noqa: PLC0415
+
+        detected = tpu.num_tpu_chips()
+        if detected:
+            out["TPU"] = float(detected)
+    return out
+
+
+def new_session_dir() -> str:
+    session_dir = os.path.join(
+        "/tmp", f"art_session_{uuid.uuid4().hex[:10]}")
+    os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+    return session_dir
+
+
+def start_cluster(num_cpus: int | None = None, num_tpus: int | None = None,
+                  resources: dict | None = None) -> dict:
+    """Start head (GCS) + one node daemon; returns addresses + procs."""
+    session_dir = new_session_dir()
+    gcs_proc, gcs_address = start_gcs(session_dir)
+    try:
+        node_proc, node_address = start_node(
+            gcs_address, default_resources(num_cpus, num_tpus, resources),
+            session_dir)
+    except Exception:
+        gcs_proc.terminate()
+        raise
+    store_dir = _store_dir_of(node_address)
+    return {
+        "gcs_address": gcs_address,
+        "node_address": node_address,
+        "store_dir": store_dir,
+        "session_dir": session_dir,
+        "processes": [node_proc, gcs_proc],
+    }
+
+
+def _store_dir_of(node_address: str) -> str:
+    pool = ClientPool()
+    try:
+        info = pool.get(node_address).call("GetNodeInfo", retries=3)
+        return info.object_store_dir
+    finally:
+        pool.close_all()
+
+
+def find_local_node(gcs_address: str) -> tuple[str, str]:
+    """Pick a node for a connecting driver (first alive node)."""
+    pool = ClientPool()
+    try:
+        nodes = pool.get(gcs_address).call("GetAllNodes", retries=5)
+        for info in nodes.values():
+            if info.alive:
+                return info.address, info.object_store_dir
+        raise RuntimeError("no alive nodes in cluster")
+    finally:
+        pool.close_all()
+
+
+def stop_processes(procs: list) -> None:
+    for proc in procs:
+        if proc.poll() is None:
+            proc.terminate()
+    deadline = time.monotonic() + 5
+    for proc in procs:
+        remaining = max(0.1, deadline - time.monotonic())
+        try:
+            proc.wait(timeout=remaining)
+        except subprocess.TimeoutExpired:
+            proc.kill()

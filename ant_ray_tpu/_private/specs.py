@@ -1,0 +1,62 @@
+"""Wire-level task/actor specifications (ref: src/ray/common/task/task_spec.h
+semantics — everything a worker needs to execute a task, self-contained)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ant_ray_tpu._private.ids import ActorID, JobID, NodeID, TaskID
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    function_id: str              # GCS-KV key of the cloudpickled function
+    function_name: str            # human-readable, for errors
+    args_payload: bytes           # SerializedObject.to_payload() of (args, kwargs)
+    num_returns: int
+    owner_address: str            # core service addr of the submitting process
+    resources: dict[str, float] = field(default_factory=dict)
+    max_retries: int = 0
+    retry_exceptions: bool = False
+    # Actor-task fields
+    actor_id: ActorID | None = None
+    method_name: str = ""
+    sequence_no: int = -1         # per-submitter ordering for actor tasks
+
+
+@dataclass
+class ActorSpec:
+    actor_id: ActorID
+    class_id: str                 # GCS-KV key of the cloudpickled class
+    class_name: str
+    args_payload: bytes
+    owner_address: str
+    # Held for the actor's lifetime (default: none).
+    resources: dict[str, float] = field(default_factory=dict)
+    # Matched at scheduling time (default: 1 CPU).
+    placement_resources: dict[str, float] = field(default_factory=dict)
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    name: str = ""
+    namespace: str = "default"
+    lifetime: str | None = None
+    job_id: JobID | None = None
+
+
+@dataclass
+class NodeInfo:
+    node_id: NodeID
+    address: str                  # node daemon RPC addr
+    total_resources: dict[str, float] = field(default_factory=dict)
+    available_resources: dict[str, float] = field(default_factory=dict)
+    object_store_dir: str = ""
+    alive: bool = True
+    labels: dict[str, str] = field(default_factory=dict)
+
+
+# Actor lifecycle states (ref: gcs_actor_manager state machine)
+ACTOR_PENDING = "PENDING_CREATION"
+ACTOR_ALIVE = "ALIVE"
+ACTOR_RESTARTING = "RESTARTING"
+ACTOR_DEAD = "DEAD"

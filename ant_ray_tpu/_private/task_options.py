@@ -55,7 +55,14 @@ class ActorOptions(TaskOptions):
     namespace: str | None = None
     get_if_exists: bool = False
 
-    def resource_demand(self, default_num_cpus: float = 1.0) -> dict[str, float]:
-        # Actors default to 1 CPU for placement but 0 for running
-        # (ref semantics); round 1 keeps the reservation for its lifetime.
+    def resource_demand(self, default_num_cpus: float = 0.0) -> dict[str, float]:
+        """Resources held while the actor is alive.  Default 0 CPU (ref
+        semantics: running actors hold no CPU), so long-lived actors don't
+        starve task scheduling; explicit num_cpus/num_tpus are held."""
         return super().resource_demand(default_num_cpus)
+
+    def placement_demand(self) -> dict[str, float]:
+        """Resources the scheduler matches when *placing* the actor —
+        default 1 CPU (ref semantics: placement uses 1 CPU, running uses
+        0), which bounds how many default actors pack onto a node."""
+        return super().resource_demand(1.0)

@@ -1,0 +1,269 @@
+"""Worker process entry point.
+
+Role of the reference's worker main + task execution path (ref:
+python/ray/_private/workers/default_worker.py + src/ray/core_worker/
+task_execution/task_receiver.h:44): registers with the node daemon, serves
+PushTask / InstantiateActor on the in-process core service, and executes
+tasks on an executor thread (per-actor ordered; thread pool when the actor
+declares max_concurrency > 1; coroutine methods run on a persistent asyncio
+loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import queue
+import threading
+import traceback
+
+from ant_ray_tpu import exceptions
+from ant_ray_tpu._private import serialization
+from ant_ray_tpu._private.config import global_config
+from ant_ray_tpu._private.core import ClusterRuntime
+from ant_ray_tpu._private.ids import JobID, NodeID, ObjectID, WorkerID
+from ant_ray_tpu._private.protocol import IoThread
+from ant_ray_tpu._private.specs import ACTOR_ALIVE, ACTOR_DEAD, ActorSpec, TaskSpec
+from ant_ray_tpu._private.worker import CLUSTER_MODE, global_worker
+from ant_ray_tpu.object_ref import ObjectRef
+
+logger = logging.getLogger(__name__)
+
+
+class TaskExecutor:
+    """Executes tasks for this worker; one main executor thread (actor order
+    preserved), optional thread pool for max_concurrency > 1 actors."""
+
+    def __init__(self, runtime: ClusterRuntime):
+        self.runtime = runtime
+        self.queue: "queue.Queue[tuple]" = queue.Queue()
+        self.actor_instance = None
+        self.actor_spec: ActorSpec | None = None
+        self._async_loop: asyncio.AbstractEventLoop | None = None
+        self._pool: list[threading.Thread] = []
+        self._io = IoThread.get()
+        self._main = threading.Thread(target=self._run_loop, daemon=True,
+                                      name="art-executor")
+        self._main.start()
+
+    def submit(self, spec, reply_fut: asyncio.Future):
+        self.queue.put((spec, reply_fut))
+
+    def _reply(self, fut: asyncio.Future, value):
+        self._io.loop.call_soon_threadsafe(
+            lambda: fut.set_result(value) if not fut.done() else None)
+
+    def _reply_exc(self, fut: asyncio.Future, exc: Exception):
+        self._io.loop.call_soon_threadsafe(
+            lambda: fut.set_exception(exc) if not fut.done() else None)
+
+    def _run_loop(self):
+        while True:
+            spec, fut = self.queue.get()
+            if spec is None:
+                return
+            if (self.actor_spec is not None
+                    and self.actor_spec.max_concurrency > 1):
+                t = threading.Thread(target=self._execute_safely,
+                                     args=(spec, fut), daemon=True)
+                t.start()
+            else:
+                self._execute_safely(spec, fut)
+
+    def _execute_safely(self, spec: TaskSpec, fut: asyncio.Future):
+        try:
+            self._reply(fut, self._execute(spec))
+        except SystemExit:
+            self._reply(fut, self._error_returns(
+                spec, exceptions.ActorDiedError(
+                    spec.actor_id, "actor exited via exit_actor()")))
+            _report_actor_state(self.runtime, self.actor_spec, ACTOR_DEAD,
+                                reason="exit_actor()")
+            os._exit(0)
+        except Exception as e:  # noqa: BLE001 — internal failure
+            logger.exception("internal executor failure")
+            self._reply_exc(fut, exceptions.ArtError(repr(e)))
+
+    # ---- execution
+
+    def _execute(self, spec: TaskSpec) -> dict:
+        try:
+            args, kwargs = self._load_args(spec)
+        except exceptions.ArtError as e:
+            # A dependency failed: propagate the *original* error through
+            # this task's returns (error lineage, ref: RayTaskError chains).
+            return self._error_returns(spec, e)
+        try:
+            if spec.actor_id is not None:
+                if self.actor_instance is None:
+                    raise exceptions.ActorDiedError(
+                        spec.actor_id, "actor instance not initialized")
+                method = getattr(self.actor_instance, spec.method_name)
+                result = method(*args, **kwargs)
+            else:
+                fn = self.runtime.fetch_code(spec.function_id)
+                result = fn(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = self._run_coroutine(result)
+        except SystemExit:
+            raise
+        except Exception as e:  # noqa: BLE001 — app error → error returns
+            err_cls = (exceptions.ActorError if spec.actor_id is not None
+                       else exceptions.TaskError)
+            err = err_cls.from_exception(spec.function_name, e)
+            return self._error_returns(spec, err)
+        values = [result] if spec.num_returns == 1 else list(result)
+        if len(values) != spec.num_returns:
+            err = exceptions.TaskError(
+                spec.function_name, None,
+                f"expected {spec.num_returns} return values, "
+                f"got {len(values)}")
+            return self._error_returns(spec, err)
+        return {"returns": [self._package(spec, i, v)
+                            for i, v in enumerate(values)]}
+
+    def _run_coroutine(self, coro):
+        """Async actor methods run on a persistent loop (so the actor can
+        hold loop-bound state across calls)."""
+        if self._async_loop is None:
+            self._async_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self._async_loop.run_forever,
+                                 daemon=True, name="art-actor-async")
+            t.start()
+        return asyncio.run_coroutine_threadsafe(
+            coro, self._async_loop).result()
+
+    def _load_args(self, spec: TaskSpec):
+        ser = serialization.SerializedObject.from_payload(spec.args_payload)
+        args, kwargs = serialization.deserialize(ser)
+        args = [self._maybe_fetch(a) for a in args]
+        kwargs = {k: self._maybe_fetch(v) for k, v in kwargs.items()}
+        return args, kwargs
+
+    def _maybe_fetch(self, value):
+        if isinstance(value, ObjectRef):
+            return self.runtime.get([value], timeout=None)[0]
+        return value
+
+    def _package(self, spec: TaskSpec, index: int, value):
+        oid = ObjectID.for_task_return(spec.task_id, index)
+        ser = serialization.serialize(value)
+        payload = ser.to_payload()
+        if len(payload) <= global_config().max_inline_object_size:
+            return ("inline", payload)
+        self.runtime._write_plasma(oid, payload)
+        return ("plasma", len(payload))
+
+    def _error_returns(self, spec: TaskSpec, err: Exception) -> dict:
+        payload = serialization.serialize_error(err).to_payload()
+        return {"returns": [("error", payload)] * spec.num_returns}
+
+def _report_actor_state(runtime: ClusterRuntime, spec: ActorSpec | None,
+                        state: str, address: str = "", reason: str = ""):
+    if spec is None:
+        return
+    try:
+        runtime._gcs.call("ActorStateUpdate", {
+            "actor_id": spec.actor_id,
+            "state": state,
+            "address": address,
+            "node_id": NodeID.from_hex(os.environ["ART_NODE_ID"]),
+            "reason": reason,
+        }, timeout=10, retries=3)
+    except Exception:  # noqa: BLE001
+        logger.exception("failed to report actor state")
+
+
+def main():  # pragma: no cover — exercised via subprocess in tests
+    logging.basicConfig(
+        level=global_config().log_level,
+        format="[worker %(levelname)s %(asctime)s] %(message)s")
+
+    node_address = os.environ["ART_NODE_ADDRESS"]
+    gcs_address = os.environ["ART_GCS_ADDRESS"]
+    store_dir = os.environ["ART_STORE_DIR"]
+    worker_id = WorkerID.from_hex(os.environ["ART_WORKER_ID"])
+
+    runtime = ClusterRuntime(
+        role="worker",
+        job_id=JobID.from_random(),  # replaced per-task by spec job ids
+        gcs_address=gcs_address,
+        node_address=node_address,
+        store_dir=store_dir,
+        worker_id=worker_id,
+    )
+    global_worker.runtime = runtime
+    global_worker.mode = CLUSTER_MODE
+
+    executor = TaskExecutor(runtime)
+    io = IoThread.get()
+
+    async def handle_push_task(spec: TaskSpec):
+        fut = asyncio.get_running_loop().create_future()
+        executor.submit(spec, fut)  # sync enqueue preserves arrival order
+        return await fut
+
+    async def handle_instantiate(spec: ActorSpec):
+        executor.actor_spec = spec
+        fut = asyncio.get_running_loop().create_future()
+
+        def _do_instantiate():
+            try:
+                cls = runtime.fetch_code(spec.class_id)
+                ser = serialization.SerializedObject.from_payload(
+                    spec.args_payload)
+                args, kwargs = serialization.deserialize(ser)
+                args = [executor._maybe_fetch(a) for a in args]
+                kwargs = {k: executor._maybe_fetch(v)
+                          for k, v in kwargs.items()}
+                executor.actor_instance = cls(*args, **kwargs)
+                _report_actor_state(runtime, spec, ACTOR_ALIVE,
+                                    address=runtime.address)
+                io.loop.call_soon_threadsafe(fut.set_result, True)
+            except Exception as e:  # noqa: BLE001
+                tb = traceback.format_exc()
+                logger.error("actor init failed: %s", tb)
+                _report_actor_state(
+                    runtime, spec, ACTOR_DEAD,
+                    reason=f"creation task failed: {e!r}")
+                io.loop.call_soon_threadsafe(fut.set_result, False)
+                threading.Timer(0.2, lambda: os._exit(1)).start()
+
+        threading.Thread(target=_do_instantiate, daemon=True).start()
+        return await fut
+
+    async def handle_ping(_payload):
+        return "pong"
+
+    runtime.server.routes({
+        "PushTask": handle_push_task,
+        "InstantiateActor": handle_instantiate,
+        "Ping": handle_ping,
+    })
+
+    runtime._node.call("RegisterWorker", {
+        "worker_id": worker_id,
+        "address": runtime.address,
+        "pid": os.getpid(),
+    }, retries=5)
+    logger.info("worker %s serving at %s", worker_id.hex()[:8],
+                runtime.address)
+
+    # Die with the node daemon (a real node failure takes its workers;
+    # the simulated one via Cluster.remove_node must behave the same).
+    failures = 0
+    while True:
+        try:
+            runtime._node.call("GetNodeInfo", timeout=5)
+            failures = 0
+        except Exception:  # noqa: BLE001
+            failures += 1
+            if failures >= 3:
+                logger.warning("node daemon unreachable; worker exiting")
+                os._exit(1)
+        threading.Event().wait(2.0)
+
+
+if __name__ == "__main__":
+    main()

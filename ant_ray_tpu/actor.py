@@ -46,12 +46,14 @@ class ActorHandle:
 
     def __init__(self, actor_id: ActorID, class_name: str,
                  method_names: tuple[str, ...] = (), max_concurrency: int = 1,
-                 method_num_returns: dict[str, int] | None = None):
+                 method_num_returns: dict[str, int] | None = None,
+                 max_task_retries: int = 0):
         self._actor_id = actor_id
         self._class_name = class_name
         self._method_names = tuple(method_names)
         self._max_concurrency = max_concurrency
         self._method_num_returns = dict(method_num_returns or {})
+        self._max_task_retries = max_task_retries
 
     @property
     def actor_id(self) -> ActorID:
@@ -77,7 +79,8 @@ class ActorHandle:
         return (
             ActorHandle,
             (self._actor_id, self._class_name, self._method_names,
-             self._max_concurrency, self._method_num_returns),
+             self._max_concurrency, self._method_num_returns,
+             self._max_task_retries),
         )
 
     def __hash__(self):

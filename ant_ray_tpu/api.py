@@ -77,7 +77,20 @@ def init(
         config=config,
     )
     global_worker.mode = CLUSTER_MODE
+    _register_atexit_once()
     return ClientContext(CLUSTER_MODE)
+
+
+_atexit_registered = False
+
+
+def _register_atexit_once():
+    global _atexit_registered
+    if not _atexit_registered:
+        import atexit  # noqa: PLC0415
+
+        atexit.register(shutdown)  # shutdown() is idempotent
+        _atexit_registered = True
 
 
 class ClientContext:

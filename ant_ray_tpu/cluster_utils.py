@@ -1,0 +1,69 @@
+"""Multi-node-on-one-host test cluster (ref: python/ray/cluster_utils.py:135
+— the mechanism by which all distributed scheduling/FT tests run without
+real machines: N node daemons, each a full node, on one host)."""
+
+from __future__ import annotations
+
+import subprocess
+
+from ant_ray_tpu._private import services
+from ant_ray_tpu._private.protocol import ClientPool
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True,
+                 head_node_args: dict | None = None):
+        self._session_dir = services.new_session_dir()
+        self._procs: list[subprocess.Popen] = []
+        self._node_addresses: list[str] = []
+        self.gcs_address: str | None = None
+        self._pool = ClientPool()
+        if initialize_head:
+            self.add_node(**(head_node_args or {}))
+
+    @property
+    def address(self) -> str:
+        assert self.gcs_address is not None, "cluster has no head"
+        return self.gcs_address
+
+    def add_node(self, num_cpus: int | None = None,
+                 num_tpus: int | None = None,
+                 resources: dict | None = None,
+                 labels: dict | None = None) -> str:
+        """Start one more node daemon; the first call also starts the GCS."""
+        if self.gcs_address is None:
+            gcs_proc, self.gcs_address = services.start_gcs(self._session_dir)
+            self._procs.append(gcs_proc)
+        node_resources = services.default_resources(
+            num_cpus if num_cpus is not None else 1, num_tpus, resources)
+        proc, address = services.start_node(
+            self.gcs_address, node_resources, self._session_dir, labels)
+        self._procs.append(proc)
+        self._node_addresses.append(address)
+        return address
+
+    def remove_node(self, address: str, graceful: bool = False) -> None:
+        """Kill a node daemon (simulates node failure when not graceful)."""
+        index = self._node_addresses.index(address)
+        proc = self._procs[1 + index]  # procs[0] is the GCS
+        if graceful:
+            try:
+                self._pool.get(address).call("Shutdown", timeout=2)
+            except Exception:  # noqa: BLE001
+                pass
+            proc.terminate()
+        else:
+            proc.kill()
+        proc.wait(timeout=5)
+
+    def connect(self, **init_kwargs):
+        import ant_ray_tpu as art  # noqa: PLC0415
+
+        return art.init(address=self.address, **init_kwargs)
+
+    def shutdown(self):
+        self._pool.close_all()
+        services.stop_processes(self._procs)
+        self._procs.clear()
+        self._node_addresses.clear()
+        self.gcs_address = None

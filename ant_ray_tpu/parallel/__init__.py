@@ -1,0 +1,34 @@
+"""Parallelism layer: device meshes, sharding plans, and sequence-parallel
+attention (ring / Ulysses) — the TPU-native expression of the reference's
+parallelism strategies (SURVEY §2.3), plus the sequence/context parallelism
+the reference lacks entirely."""
+
+from ant_ray_tpu.parallel.mesh import (
+    AxisNames,
+    MeshConfig,
+    build_mesh,
+    local_chip_mesh,
+)
+from ant_ray_tpu.parallel.sharding import (
+    LogicalAxisRules,
+    DEFAULT_LLAMA_RULES,
+    logical_to_spec,
+    shard_pytree,
+    constrain,
+)
+from ant_ray_tpu.parallel.ring import ring_attention
+from ant_ray_tpu.parallel.ulysses import ulysses_attention
+
+__all__ = [
+    "AxisNames",
+    "DEFAULT_LLAMA_RULES",
+    "LogicalAxisRules",
+    "MeshConfig",
+    "build_mesh",
+    "constrain",
+    "local_chip_mesh",
+    "logical_to_spec",
+    "ring_attention",
+    "shard_pytree",
+    "ulysses_attention",
+]

@@ -1,0 +1,91 @@
+"""Device mesh construction for TPU slices.
+
+The mesh is the foundation of every parallelism strategy (scaling-book
+recipe: pick a mesh, annotate shardings, let XLA insert collectives).  Axis
+order puts the bandwidth-hungriest axis innermost so it maps to the
+tightest ICI neighborhood: ("pp", "dp", "fsdp", "ep", "sp", "tp").
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ant_ray_tpu._private.jax_utils import import_jax
+
+
+class AxisNames:
+    PIPELINE = "pp"
+    DATA = "dp"
+    FSDP = "fsdp"
+    EXPERT = "ep"
+    SEQUENCE = "sp"
+    TENSOR = "tp"
+
+    ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclass
+class MeshConfig:
+    """Degrees for each parallelism axis; -1 on at most one axis means
+    "absorb all remaining devices"."""
+
+    pp: int = 1
+    dp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def degrees(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in AxisNames.ORDER}
+
+    def resolve(self, n_devices: int) -> "MeshConfig":
+        degrees = self.degrees()
+        wildcards = [k for k, v in degrees.items() if v == -1]
+        if len(wildcards) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(v for v in degrees.values() if v != -1)
+        if wildcards:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes "
+                    f"product {fixed}")
+            degrees[wildcards[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {degrees} needs {fixed} devices, have {n_devices}")
+        return MeshConfig(**degrees)
+
+
+def build_mesh(config: MeshConfig | None = None, devices=None,
+               **axis_degrees):
+    """Build a jax Mesh with the standard axis order.
+
+    ``build_mesh(dp=2, tp=4)`` or ``build_mesh(MeshConfig(fsdp=-1, tp=4))``.
+    """
+    jax = import_jax()
+    from jax.sharding import Mesh  # noqa: PLC0415
+
+    if config is None:
+        config = MeshConfig(**axis_degrees)
+    elif axis_degrees:
+        raise ValueError("pass either MeshConfig or axis kwargs, not both")
+    devices = list(devices) if devices is not None else list(jax.devices())
+    config = config.resolve(len(devices))
+    degrees = config.degrees()
+    shape = tuple(degrees[name] for name in AxisNames.ORDER)
+    array = np.array(devices).reshape(shape)
+    return Mesh(array, AxisNames.ORDER)
+
+
+def local_chip_mesh(**axis_degrees):
+    """Mesh over this process's local devices only."""
+    jax = import_jax()
+    return build_mesh(devices=jax.local_devices(), **axis_degrees)
+
+
+def mesh_axis_size(mesh, *names: str) -> int:
+    return math.prod(mesh.shape[n] for n in names)

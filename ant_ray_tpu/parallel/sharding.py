@@ -1,0 +1,98 @@
+"""Logical-axis sharding rules → NamedShardings.
+
+Parallelism strategies (DP / FSDP / TP / EP — SURVEY §2.3) are expressed as
+a mapping from *logical* tensor dimensions ("batch", "embed", "heads", …)
+to mesh axes, so one model definition serves every strategy by swapping
+rule tables (the idiomatic pjit recipe; contrast with the reference where
+DP is torch-DDP actors and TP/PP are vLLM config passthrough).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+from ant_ray_tpu._private.jax_utils import import_jax
+
+# A rule maps a logical dim name to: None (replicate), one mesh axis, or a
+# tuple of mesh axes (dimension sharded over their product).
+LogicalAxisRules = dict[str, Any]
+
+# Llama-family rules: batch over (dp, fsdp); sequence over sp; attention
+# heads and mlp hidden over tp; params sharded over fsdp on one dim
+# (ZeRO-style) and tp on the parallel dim.
+DEFAULT_LLAMA_RULES: LogicalAxisRules = {
+    "batch": ("dp", "fsdp"),
+    "seq": "sp",
+    "embed": None,
+    "embed_param": "fsdp",       # param dim sharded for FSDP/ZeRO
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "mlp": "tp",
+    "vocab": "tp",
+    "experts": "ep",
+    "norm": None,
+}
+
+
+def logical_to_spec(logical_dims: Sequence[str | None],
+                    rules: LogicalAxisRules | None = None):
+    """("batch","seq","embed") → PartitionSpec(("dp","fsdp"), "sp", None)."""
+    from jax.sharding import PartitionSpec  # noqa: PLC0415
+
+    rules = rules if rules is not None else DEFAULT_LLAMA_RULES
+    parts = []
+    for dim in logical_dims:
+        if dim is None:
+            parts.append(None)
+        else:
+            if dim not in rules:
+                raise KeyError(f"no sharding rule for logical dim {dim!r}")
+            parts.append(rules[dim])
+    return PartitionSpec(*parts)
+
+
+def named_sharding(mesh, logical_dims: Sequence[str | None],
+                   rules: LogicalAxisRules | None = None):
+    from jax.sharding import NamedSharding  # noqa: PLC0415
+
+    return NamedSharding(mesh, logical_to_spec(logical_dims, rules))
+
+
+def shard_pytree(tree, logical_tree, mesh,
+                 rules: LogicalAxisRules | None = None):
+    """Device-put a pytree of arrays according to a parallel pytree of
+    logical dim tuples; logical leaves of None mean replicate."""
+    jax = import_jax()
+
+    def _place(x, dims):
+        if dims is None:
+            dims = (None,) * getattr(x, "ndim", 0)
+        return jax.device_put(x, named_sharding(mesh, dims, rules))
+
+    return jax.tree.map(_place, tree, logical_tree,
+                        is_leaf=lambda x: x is None)
+
+
+def pytree_shardings(tree, logical_tree, mesh,
+                     rules: LogicalAxisRules | None = None):
+    """NamedSharding pytree (for jit in_shardings/out_shardings)."""
+
+    def _spec(x, dims):
+        if dims is None:
+            dims = (None,) * getattr(x, "ndim", 0)
+        return named_sharding(mesh, dims, rules)
+
+    jax = import_jax()
+    return jax.tree.map(_spec, tree, logical_tree,
+                        is_leaf=lambda x: x is None)
+
+
+def constrain(x, logical_dims: Sequence[str | None],
+              rules: LogicalAxisRules | None = None):
+    """In-jit sharding constraint by logical dims (mesh from context)."""
+    jax = import_jax()
+    from jax.lax import with_sharding_constraint  # noqa: PLC0415
+
+    return with_sharding_constraint(
+        x, logical_to_spec(logical_dims, rules))

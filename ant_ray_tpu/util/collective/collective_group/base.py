@@ -1,0 +1,58 @@
+"""Base collective group interface (ref:
+python/ray/util/collective/collective_group/base_collective_group.py)."""
+
+from __future__ import annotations
+
+from ant_ray_tpu.util.collective import types
+
+
+class BaseGroup:
+    def __init__(self, world_size: int, rank: int, group_name: str):
+        self._world_size = world_size
+        self._rank = rank
+        self._group_name = group_name
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def world_size(self) -> int:
+        return self._world_size
+
+    @property
+    def group_name(self) -> str:
+        return self._group_name
+
+    @classmethod
+    def backend(cls) -> str:
+        raise NotImplementedError
+
+    def destroy_group(self):
+        pass
+
+    # ---- collective verbs
+
+    def allreduce(self, tensors, opts: types.AllReduceOptions):
+        raise NotImplementedError
+
+    def barrier(self, opts: types.BarrierOptions):
+        raise NotImplementedError
+
+    def reduce(self, tensors, opts: types.ReduceOptions):
+        raise NotImplementedError
+
+    def broadcast(self, tensors, opts: types.BroadcastOptions):
+        raise NotImplementedError
+
+    def allgather(self, tensors, opts: types.AllGatherOptions):
+        raise NotImplementedError
+
+    def reducescatter(self, tensors, opts: types.ReduceScatterOptions):
+        raise NotImplementedError
+
+    def send(self, tensors, opts: types.SendOptions):
+        raise NotImplementedError
+
+    def recv(self, tensors, opts: types.RecvOptions):
+        raise NotImplementedError

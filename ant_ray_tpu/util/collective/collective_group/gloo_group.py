@@ -1,0 +1,156 @@
+"""Gloo (CPU) collective group via torch.distributed — the cross-process
+fallback over sockets/DCN (ref: python/ray/util/collective/collective_group/
+torch_gloo_collective_group.py).  Rendezvous of the TCP store rides the GCS
+KV instead of a named store actor."""
+
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as np
+
+from ant_ray_tpu.util.collective import types
+from ant_ray_tpu.util.collective.collective_group.base import BaseGroup
+
+logger = logging.getLogger(__name__)
+
+_REDUCE_MAP = None
+
+
+def _dist():
+    import torch.distributed as dist  # noqa: PLC0415
+
+    global _REDUCE_MAP
+    if _REDUCE_MAP is None:
+        _REDUCE_MAP = {
+            types.ReduceOp.SUM: dist.ReduceOp.SUM,
+            types.ReduceOp.PRODUCT: dist.ReduceOp.PRODUCT,
+            types.ReduceOp.MIN: dist.ReduceOp.MIN,
+            types.ReduceOp.MAX: dist.ReduceOp.MAX,
+            types.ReduceOp.AVERAGE: dist.ReduceOp.AVG,
+        }
+    return dist
+
+
+class GlooGroup(BaseGroup):
+    def __init__(self, world_size: int, rank: int, group_name: str,
+                 init_method: str):
+        super().__init__(world_size, rank, group_name)
+        dist = _dist()
+        if dist.is_initialized():
+            raise RuntimeError(
+                "torch.distributed already initialized in this process; "
+                "only one live gloo group per process is supported "
+                "(destroy the existing group first)")
+        dist.init_process_group(
+            "gloo", init_method=init_method, rank=rank,
+            world_size=world_size)
+
+    @classmethod
+    def backend(cls):
+        return "gloo"
+
+    def destroy_group(self):
+        dist = _dist()
+        if dist.is_initialized():
+            dist.destroy_process_group()
+
+    # ---- torch/jax/numpy interop
+
+    @staticmethod
+    def _to_torch(tensor):
+        import torch  # noqa: PLC0415
+
+        if isinstance(tensor, torch.Tensor):
+            return tensor, "torch"
+        arr = np.asarray(tensor)
+        return torch.from_numpy(arr.copy()), type(tensor).__module__
+
+    @staticmethod
+    def _from_torch(t, origin):
+        if origin == "torch":
+            return t
+        out = t.numpy()
+        if origin.startswith("jax"):
+            import jax.numpy as jnp  # noqa: PLC0415
+
+            return jnp.asarray(out)
+        return out
+
+    # ---- verbs
+
+    def allreduce(self, tensors, opts: types.AllReduceOptions):
+        dist = _dist()
+        t, origin = self._to_torch(tensors[0])
+        dist.all_reduce(t, op=_REDUCE_MAP[opts.reduce_op])
+        return [self._from_torch(t, origin)]
+
+    def barrier(self, opts: types.BarrierOptions):
+        _dist().barrier()
+
+    def reduce(self, tensors, opts: types.ReduceOptions):
+        dist = _dist()
+        t, origin = self._to_torch(tensors[0])
+        dist.reduce(t, dst=opts.root_rank, op=_REDUCE_MAP[opts.reduce_op])
+        return [self._from_torch(t, origin)]
+
+    def broadcast(self, tensors, opts: types.BroadcastOptions):
+        dist = _dist()
+        t, origin = self._to_torch(tensors[0])
+        dist.broadcast(t, src=opts.root_rank)
+        return [self._from_torch(t, origin)]
+
+    def allgather(self, tensors, opts: types.AllGatherOptions):
+        import torch  # noqa: PLC0415
+
+        dist = _dist()
+        t, origin = self._to_torch(tensors[0])
+        out = [torch.empty_like(t) for _ in range(self._world_size)]
+        dist.all_gather(out, t)
+        return [[self._from_torch(o, origin) for o in out]]
+
+    def reducescatter(self, tensors, opts: types.ReduceScatterOptions):
+        import torch  # noqa: PLC0415
+
+        dist = _dist()
+        t, origin = self._to_torch(tensors[0])
+        if t.shape[0] % self._world_size != 0:
+            raise ValueError("reducescatter needs dim0 divisible by world")
+        dist.all_reduce(t, op=_REDUCE_MAP[opts.reduce_op])
+        chunk = t.shape[0] // self._world_size
+        piece = t[self._rank * chunk:(self._rank + 1) * chunk]
+        return [self._from_torch(piece, origin)]
+
+    def send(self, tensors, opts: types.SendOptions):
+        dist = _dist()
+        t, _origin = self._to_torch(tensors[0])
+        dist.send(t, dst=opts.dst_rank)
+
+    def recv(self, tensors, opts: types.RecvOptions):
+        dist = _dist()
+        t, origin = self._to_torch(tensors[0])
+        dist.recv(t, src=opts.src_rank)
+        return [self._from_torch(t, origin)]
+
+
+def rendezvous_init_method(group_name: str, rank: int,
+                           timeout_s: float = 60.0) -> str:
+    """Agree on a TCP init method via GCS KV (replaces the reference's
+    named-actor NCCLUniqueID rendezvous, nccl_collective_group.py:29-78)."""
+    from ant_ray_tpu._private.protocol import find_free_port  # noqa: PLC0415
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    runtime = global_worker.runtime
+    key = f"collective:{group_name}:init_method"
+    if rank == 0:
+        method = f"tcp://127.0.0.1:{find_free_port()}"
+        runtime._gcs.call("KVPut", {"key": key, "value": method.encode()})
+        return method
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        value = runtime._gcs.call("KVGet", {"key": key})
+        if value is not None:
+            return value.decode()
+        time.sleep(0.05)
+    raise TimeoutError(f"rendezvous for group {group_name!r} timed out")

@@ -1,0 +1,85 @@
+"""Collective types (ref: python/ray/util/collective/types.py — Backend :34,
+ReduceOp :55), with the NCCL backend replaced by a TPU-native ``xla``
+backend lowering to XLA collectives over ICI/DCN."""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+
+
+class Backend:
+    """Supported backends: ``xla`` (XLA collectives over ICI/DCN — the
+    TPU-native replacement for NCCL) and ``gloo`` (CPU fallback over
+    sockets, alias ``cpu``)."""
+
+    XLA = "xla"
+    GLOO = "gloo"
+
+    @staticmethod
+    def normalize(name: str) -> str:
+        name = name.lower()
+        if name in ("xla", "tpu", "ici"):
+            return Backend.XLA
+        if name in ("gloo", "cpu", "torch_gloo"):
+            return Backend.GLOO
+        if name in ("nccl", "cuda"):
+            raise ValueError(
+                "NCCL is not available in the TPU-native build; use "
+                "backend='xla' (ICI/DCN collectives) instead")
+        raise ValueError(f"Unknown collective backend {name!r}")
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    AVERAGE = "average"
+
+
+@dataclass
+class AllReduceOptions:
+    reduce_op: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class BarrierOptions:
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class ReduceOptions:
+    reduce_op: ReduceOp = ReduceOp.SUM
+    root_rank: int = 0
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class BroadcastOptions:
+    root_rank: int = 0
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class AllGatherOptions:
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class ReduceScatterOptions:
+    reduce_op: ReduceOp = ReduceOp.SUM
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class SendOptions:
+    dst_rank: int = 0
+    timeout_ms: int = 30_000
+
+
+@dataclass
+class RecvOptions:
+    src_rank: int = 0
+    timeout_ms: int = 30_000

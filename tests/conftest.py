@@ -9,7 +9,14 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("ART_WORKER_JAX_CPU", "1")
+# The env var alone is not enough where a site plugin pins the platform;
+# ART_JAX_PLATFORM makes ant_ray_tpu's jax_utils force it via jax.config
+# (inherited by worker subprocesses).
+os.environ["ART_JAX_PLATFORM"] = "cpu"
+
+from ant_ray_tpu._private.jax_utils import import_jax  # noqa: E402
+
+import_jax()
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,288 @@
+"""Cluster-mode (multiprocess) runtime tests
+(ref test model: python/ray/tests/test_basic.py, test_actor.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu.exceptions import ActorDiedError, GetTimeoutError, TaskError
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    art.init(num_cpus=4, num_tpus=0)
+
+    @art.remote
+    def _warm(i):
+        time.sleep(0.2)
+        return i
+
+    # Fill the worker pool so timing-sensitive tests see warm workers.
+    art.get([_warm.remote(i) for i in range(4)])
+    yield None
+    art.shutdown()
+
+
+def test_task_roundtrip(cluster):
+    @art.remote
+    def add(a, b):
+        return a + b
+
+    assert art.get(add.remote(1, 2)) == 3
+
+
+def test_parallel_tasks(cluster):
+    @art.remote
+    def slow(i):
+        time.sleep(0.3)
+        return i
+
+    t0 = time.monotonic()
+    out = art.get([slow.remote(i) for i in range(4)])
+    elapsed = time.monotonic() - t0
+    assert out == list(range(4))
+    # 4 tasks on 4 cpus should run concurrently, not serially (4 * 0.3).
+    assert elapsed < 1.1
+
+
+def test_chained_and_nested(cluster):
+    @art.remote
+    def inc(x):
+        return x + 1
+
+    @art.remote
+    def fan_in(*xs):
+        return sum(xs)
+
+    refs = [inc.remote(i) for i in range(3)]
+    assert art.get(fan_in.remote(*refs)) == 6
+
+    @art.remote
+    def nested(depth):
+        if depth == 0:
+            return 0
+        return art.get(nested.remote(depth - 1)) + 1
+
+    assert art.get(nested.remote(3)) == 3
+
+
+def test_large_object_plasma(cluster):
+    arr = np.random.rand(500_000)  # 4 MB > inline threshold
+    ref = art.put(arr)
+    out = art.get(ref)
+    np.testing.assert_array_equal(out, arr)
+
+    @art.remote
+    def total(x):
+        return float(x.sum())
+
+    assert abs(art.get(total.remote(ref)) - arr.sum()) < 1e-6
+
+
+def test_large_task_return(cluster):
+    @art.remote
+    def big():
+        return np.ones(400_000)
+
+    assert art.get(big.remote()).shape == (400_000,)
+
+
+def test_error_propagation(cluster):
+    @art.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(TaskError, match="kaboom"):
+        art.get(boom.remote())
+
+    @art.remote
+    def passthrough(x):
+        return x
+
+    with pytest.raises(TaskError, match="kaboom"):
+        art.get(passthrough.remote(boom.remote()))
+
+
+def test_get_timeout(cluster):
+    @art.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    ref = slow.remote()
+    with pytest.raises(GetTimeoutError):
+        art.get(ref, timeout=0.3)
+    assert art.get(ref) == 1  # still resolvable afterwards
+
+
+def test_wait(cluster):
+    @art.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    fast = sleepy.remote(0.05)
+    slow = sleepy.remote(3.0)
+    ready, not_ready = art.wait([fast, slow], num_returns=1, timeout=2.0)
+    assert ready == [fast]
+    assert not_ready == [slow]
+
+
+def test_actor_state_and_ordering(cluster):
+    @art.remote
+    class Counter:
+        def __init__(self):
+            self.values = []
+
+        def push(self, v):
+            self.values.append(v)
+            return len(self.values)
+
+        def get_all(self):
+            return self.values
+
+    c = Counter.remote()
+    for i in range(20):
+        c.push.remote(i)
+    assert art.get(c.get_all.remote()) == list(range(20))
+
+
+def test_actor_passed_to_task(cluster):
+    @art.remote
+    class Store:
+        def __init__(self):
+            self.v = 0
+
+        def set(self, v):
+            self.v = v
+
+        def get(self):
+            return self.v
+
+    @art.remote
+    def writer(store, v):
+        art.get(store.set.remote(v))
+        return "done"
+
+    s = Store.remote()
+    assert art.get(writer.remote(s, 42)) == "done"
+    assert art.get(s.get.remote()) == 42
+
+
+def test_named_actor_cross_process(cluster):
+    @art.remote
+    class Registry:
+        def ping(self):
+            return "pong"
+
+    Registry.options(name="reg", lifetime="detached").remote()
+
+    @art.remote
+    def lookup():
+        h = art.get_actor("reg")
+        return art.get(h.ping.remote())
+
+    assert art.get(lookup.remote()) == "pong"
+
+
+def test_actor_crash_and_kill(cluster):
+    @art.remote
+    class Fragile:
+        def ping(self):
+            return "ok"
+
+        def crash(self):
+            import os
+
+            os._exit(1)
+
+    a = Fragile.remote()
+    assert art.get(a.ping.remote()) == "ok"
+    with pytest.raises(ActorDiedError):
+        art.get(a.crash.remote())
+    with pytest.raises(ActorDiedError):
+        art.get(a.ping.remote())
+
+    b = Fragile.remote()
+    assert art.get(b.ping.remote()) == "ok"
+    art.kill(b)
+    with pytest.raises(ActorDiedError):
+        art.get(b.ping.remote())
+
+
+def test_actor_restart(cluster):
+    @art.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.calls = 0
+
+        def incr(self):
+            self.calls += 1
+            return self.calls
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+    p = Phoenix.remote()
+    assert art.get(p.incr.remote()) == 1
+    with pytest.raises(ActorDiedError):
+        art.get(p.die.remote())
+    # Restarted instance has fresh state.
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        try:
+            assert art.get(p.incr.remote()) == 1
+            break
+        except ActorDiedError:
+            time.sleep(0.2)
+    else:
+        pytest.fail("actor did not restart in time")
+
+
+def test_async_actor(cluster):
+    @art.remote
+    class AsyncActor:
+        async def work(self, x):
+            import asyncio
+
+            await asyncio.sleep(0.01)
+            return x * 2
+
+    a = AsyncActor.remote()
+    assert art.get(a.work.remote(21)) == 42
+
+
+def test_detached_lifetime_and_get_if_exists(cluster):
+    @art.remote
+    class Singleton:
+        def whoami(self):
+            return id(self)
+
+    h1 = Singleton.options(name="single", get_if_exists=True).remote()
+    h2 = Singleton.options(name="single", get_if_exists=True).remote()
+    assert art.get(h1.whoami.remote()) == art.get(h2.whoami.remote())
+
+
+def test_num_returns_cluster(cluster):
+    @art.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    refs = three.remote()
+    assert art.get(list(refs)) == [1, 2, 3]
+
+
+def test_task_submitting_tasks(cluster):
+    @art.remote
+    def leaf(x):
+        return x * 10
+
+    @art.remote
+    def branch(n):
+        return sum(art.get([leaf.remote(i) for i in range(n)]))
+
+    assert art.get(branch.remote(4)) == 60

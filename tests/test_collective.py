@@ -1,0 +1,142 @@
+"""Collective layer tests on the virtual 8-device CPU mesh
+(ref test model: python/ray/util/collective/tests/single_node_cpu_tests/)."""
+
+import numpy as np
+import pytest
+
+from ant_ray_tpu.util import collective as col
+from ant_ray_tpu.util.collective import ReduceOp
+
+
+@pytest.fixture
+def xla_group():
+    col.init_collective_group(world_size=1, rank=0, backend="xla",
+                              group_name="g")
+    yield "g"
+    col.destroy_collective_group("g")
+
+
+def test_backend_normalize():
+    from ant_ray_tpu.util.collective.types import Backend
+
+    assert Backend.normalize("TPU") == "xla"
+    assert Backend.normalize("cpu") == "gloo"
+    with pytest.raises(ValueError, match="NCCL"):
+        Backend.normalize("nccl")
+
+
+def test_group_lifecycle(xla_group):
+    assert col.is_group_initialized("g")
+    assert col.get_rank("g") == 0
+    assert col.get_collective_group_size("g") == 1
+    with pytest.raises(RuntimeError):
+        col.init_collective_group(1, 0, backend="xla", group_name="g")
+
+
+def test_uninitialized_group_errors():
+    with pytest.raises(RuntimeError, match="not initialized"):
+        col.allreduce(np.ones(2), group_name="nope")
+
+
+def test_allreduce_multidevice(xla_group):
+    import jax
+
+    n = len(jax.devices())
+    assert n == 8  # conftest forces the virtual mesh
+    tensors = [np.full((4, 4), float(i)) for i in range(n)]
+    out = col.allreduce_multidevice(tensors, group_name="g")
+    expected = sum(range(n))
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), expected)
+
+
+def test_allreduce_multidevice_ops(xla_group):
+    import jax
+
+    n = len(jax.devices())
+    tensors = [np.full((2,), float(i + 1)) for i in range(n)]
+    out_max = col.allreduce_multidevice(tensors, group_name="g",
+                                        op=ReduceOp.MAX)
+    np.testing.assert_allclose(np.asarray(out_max[0]), n)
+    out_min = col.allreduce_multidevice(tensors, group_name="g",
+                                        op=ReduceOp.MIN)
+    np.testing.assert_allclose(np.asarray(out_min[0]), 1.0)
+    out_avg = col.allreduce_multidevice(tensors, group_name="g",
+                                        op=ReduceOp.AVERAGE)
+    np.testing.assert_allclose(np.asarray(out_avg[0]), (n + 1) / 2)
+
+
+def test_broadcast_multidevice(xla_group):
+    import jax
+
+    n = len(jax.devices())
+    tensors = [np.full((3,), float(i)) for i in range(n)]
+    out = col.broadcast_multidevice(tensors, src_rank=2, group_name="g")
+    for o in out:
+        np.testing.assert_allclose(np.asarray(o), 2.0)
+
+
+def test_allgather_multidevice(xla_group):
+    import jax
+
+    n = len(jax.devices())
+    tensors = [np.full((2,), float(i)) for i in range(n)]
+    out = col.allgather_multidevice(tensors, group_name="g")
+    assert len(out) == n and len(out[0]) == n
+    for dev_out in out:
+        for i, piece in enumerate(dev_out):
+            np.testing.assert_allclose(np.asarray(piece), float(i))
+
+
+def test_reducescatter_multidevice(xla_group):
+    import jax
+
+    n = len(jax.devices())
+    tensors = [np.arange(n * 2, dtype=np.float32) for _ in range(n)]
+    out = col.reducescatter_multidevice(tensors, group_name="g")
+    for i, piece in enumerate(out):
+        expected = np.arange(n * 2, dtype=np.float32)[i * 2:(i + 1) * 2] * n
+        np.testing.assert_allclose(np.asarray(piece), expected)
+
+
+def test_world1_per_rank_verbs(xla_group):
+    x = np.ones((4,))
+    np.testing.assert_allclose(col.allreduce(x, group_name="g"), x)
+    np.testing.assert_allclose(col.broadcast(x, group_name="g"), x)
+    assert len(col.allgather(x, group_name="g")) == 1
+    col.barrier(group_name="g")
+
+
+def test_compiled_cache_reuse(xla_group):
+    from ant_ray_tpu.util.collective.collective import _group_mgr
+
+    group = _group_mgr.get_group("g")
+    import jax
+
+    n = len(jax.devices())
+    tensors = [np.ones((8,)) for _ in range(n)]
+    col.allreduce_multidevice(tensors, group_name="g")
+    hits_before = group._compiled.cache_info().hits
+    col.allreduce_multidevice(tensors, group_name="g")
+    assert group._compiled.cache_info().hits == hits_before + 1
+
+
+def test_gloo_group_across_actors(shutdown_only):
+    """Two actor processes allreduce over the gloo backend with GCS-KV
+    rendezvous (ref: distributed_cpu_tests)."""
+    import ant_ray_tpu as art
+
+    art.init(num_cpus=2, num_tpus=0)
+
+    @art.remote
+    class Ranker(col.CollectiveActorMixin):
+        def allreduce_ones(self, world):
+            out = col.allreduce(np.ones(4), group_name="gloo_g")
+            return np.asarray(out).tolist()
+
+    actors = [Ranker.remote() for _ in range(2)]
+    col.create_collective_group(actors, world_size=2, ranks=[0, 1],
+                                backend="gloo", group_name="gloo_g")
+    results = art.get([a.allreduce_ones.remote(2) for a in actors])
+    for r in results:
+        assert r == [2.0, 2.0, 2.0, 2.0]

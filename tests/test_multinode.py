@@ -1,0 +1,113 @@
+"""Multi-node scheduling / transfer / fault-tolerance tests using the
+Cluster harness (ref: python/ray/cluster_utils.py:135 test pattern)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture
+def three_node_cluster():
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    cluster.add_node(num_cpus=2, resources={"special": 1})
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    yield cluster
+    art.shutdown()
+    cluster.shutdown()
+
+
+def test_cluster_view(three_node_cluster):
+    nodes = art.nodes()
+    assert len(nodes) == 3
+    assert all(n["Alive"] for n in nodes)
+    assert art.cluster_resources()["CPU"] == 5.0
+
+
+def test_spillback_spreads_load(three_node_cluster):
+    @art.remote
+    def which_node(t):
+        time.sleep(t)
+        return os.environ["ART_NODE_ID"]
+
+    locations = art.get([which_node.remote(0.5) for _ in range(5)])
+    assert len(set(locations)) >= 2  # work left the driver's node
+
+
+def test_custom_resource_routing(three_node_cluster):
+    @art.remote(resources={"special": 1})
+    def on_special():
+        return os.environ["ART_NODE_ID"]
+
+    @art.remote
+    def anywhere():
+        return os.environ["ART_NODE_ID"]
+
+    special_node = art.get(on_special.remote())
+    assert special_node  # scheduled despite driver node lacking "special"
+    assert art.get(on_special.remote()) == special_node
+
+
+def test_infeasible_task_errors(three_node_cluster):
+    @art.remote(resources={"nonexistent": 1})
+    def impossible():
+        return 1
+
+    with pytest.raises(art.exceptions.ArtError, match="no node can ever"):
+        art.get(impossible.remote())
+
+
+def test_cross_node_object_transfer(three_node_cluster):
+    @art.remote(resources={"special": 1})
+    def produce():
+        return np.arange(1_000_000, dtype=np.float64)  # 8 MB
+
+    @art.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    expected = float(np.arange(1_000_000, dtype=np.float64).sum())
+    assert art.get(consume.remote(ref)) == expected
+    # Driver-side fetch also pulls across nodes.
+    assert art.get(ref)[-1] == 999_999.0
+
+
+def test_node_death_marks_cluster_view(three_node_cluster):
+    cluster = three_node_cluster
+    victim = cluster.add_node(num_cpus=1, resources={"victim": 1})
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(
+            [n for n in art.nodes() if n["Alive"]]) != 4:
+        time.sleep(0.2)
+    cluster.remove_node(victim)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        alive = [n for n in art.nodes() if n["Alive"]]
+        if len(alive) == 3:
+            return
+        time.sleep(0.3)
+    pytest.fail("dead node never marked dead")
+
+
+def test_actor_on_dead_node_dies(three_node_cluster):
+    cluster = three_node_cluster
+    victim = cluster.add_node(num_cpus=1, resources={"victim": 1})
+
+    @art.remote(resources={"victim": 0.5})
+    class Doomed:
+        def ping(self):
+            return "pong"
+
+    d = Doomed.remote()
+    assert art.get(d.ping.remote()) == "pong"
+    cluster.remove_node(victim)
+    with pytest.raises(art.exceptions.ActorDiedError):
+        for _ in range(100):
+            art.get(d.ping.remote(), timeout=30)
+            time.sleep(0.3)
