@@ -114,6 +114,7 @@ class ClusterRuntime(CoreRuntime):
 
         self._actor_states: dict[ActorID, _ActorSubmitState] = {}
         self._actor_meta_cache: dict[ActorID, dict] = {}
+        self._pg_bundle_cache: dict = {}  # pg_id -> [node addresses]
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
         self._shutdown = False
@@ -467,6 +468,11 @@ class ClusterRuntime(CoreRuntime):
                          if options.max_retries is not None
                          else cfg.task_max_retries_default),
             retry_exceptions=options.retry_exceptions,
+            placement_group_id=(options.placement_group.id
+                                if options.placement_group is not None
+                                else None),
+            placement_group_bundle_index=max(
+                options.placement_group_bundle_index, 0),
         )
         pinned = list(ser.contained_refs)
         asyncio.run_coroutine_threadsafe(
@@ -501,13 +507,48 @@ class ClusterRuntime(CoreRuntime):
             if pinned_args:
                 self._unpin(pinned_args)
 
+    async def _resolve_bundle_node(self, pg_id, bundle_index: int):
+        """Wait for the placement group, return the bundle's node client.
+        Bundle → node never changes after creation, so resolution is
+        cached (no per-task GCS round-trip on the hot path)."""
+        cached = self._pg_bundle_cache.get(pg_id)
+        if cached is None:
+            for _ in range(240):
+                state = await self._gcs.call_async(
+                    "GetPlacementGroup", {"pg_id": pg_id}, timeout=10)
+                if state is None:
+                    raise exceptions.ArtError("placement group was removed")
+                if state["state"] == "FAILED":
+                    raise exceptions.ArtError(
+                        f"placement group failed: {state.get('reason', '')}")
+                if state["state"] == "CREATED":
+                    cached = state["bundle_nodes"]
+                    self._pg_bundle_cache[pg_id] = cached
+                    break
+                await asyncio.sleep(0.25)
+            else:
+                raise exceptions.ArtError(
+                    "placement group never became ready")
+        if not 0 <= bundle_index < len(cached):
+            raise exceptions.ArtError(
+                f"bundle index {bundle_index} out of range for group with "
+                f"{len(cached)} bundles")
+        return self._clients.get(cached[bundle_index])
+
     async def _lease_and_push(self, spec: TaskSpec) -> dict:
         """Lease a worker (following spillback redirects), push the task,
         return the worker reply (ref: NormalTaskSubmitter::SubmitTask)."""
-        node = self._node
+        lease_payload = {"resources": spec.resources}
+        if spec.placement_group_id is not None:
+            node = await self._resolve_bundle_node(
+                spec.placement_group_id, spec.placement_group_bundle_index)
+            lease_payload["pg"] = (spec.placement_group_id,
+                                   spec.placement_group_bundle_index)
+        else:
+            node = self._node
         for _hop in range(16):
             reply = await node.call_async(
-                "LeaseWorker", {"resources": spec.resources}, timeout=-1)
+                "LeaseWorker", lease_payload, timeout=-1)
             if "granted" in reply:
                 worker_addr = reply["granted"]
                 worker_id = reply["worker_id"]
@@ -570,6 +611,11 @@ class ClusterRuntime(CoreRuntime):
             namespace=options.namespace or "default",
             lifetime=options.lifetime,
             job_id=self.job_id,
+            placement_group_id=(options.placement_group.id
+                                if options.placement_group is not None
+                                else None),
+            placement_group_bundle_index=max(
+                options.placement_group_bundle_index, 0),
         )
         reply = self._gcs.call("CreateActor", spec, retries=3)
         if "error" in reply:

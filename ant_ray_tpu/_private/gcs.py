@@ -52,6 +52,7 @@ class GcsServer:
         self._kv: dict[str, bytes] = {}
         self._object_locations: dict[ObjectID, set[NodeID]] = {}
         self._jobs: dict[JobID, dict] = {}
+        self._placement_groups: dict = {}  # pg_id -> record dict
         self._clients = ClientPool()
         self._io = IoThread.get()
         self._health_task = None
@@ -83,6 +84,12 @@ class GcsServer:
             "SelectNode": self._select_node,
             "ClusterResources": self._cluster_resources,
             "AvailableResources": self._available_resources,
+            "CreatePlacementGroup": self._create_placement_group,
+            "GetPlacementGroup": self._get_placement_group,
+            "RemovePlacementGroup": self._remove_placement_group,
+            "ListPlacementGroups": self._list_placement_groups,
+            "ListActors": self._list_actors,
+            "ListObjects": self._list_objects,
             "Shutdown": self._shutdown_rpc,
         })
         self.address = self._server.start()
@@ -196,10 +203,24 @@ class GcsServer:
         return {"ok": True}
 
     async def _schedule_actor(self, record: ActorRecord):
+        try:
+            await self._schedule_actor_inner(record)
+        except Exception as e:  # noqa: BLE001 — never leave PENDING forever
+            logger.exception("actor scheduling failed")
+            record.state = ACTOR_DEAD
+            record.death_reason = f"scheduling error: {e}"
+            record.state_event.set()
+
+    async def _schedule_actor_inner(self, record: ActorRecord):
         spec = record.spec
         placement = spec.placement_resources or spec.resources
         for _attempt in range(60):
-            node = self._pick_node(placement)
+            if spec.placement_group_id is not None:
+                node = self._pg_bundle_node(
+                    spec.placement_group_id,
+                    spec.placement_group_bundle_index)
+            else:
+                node = self._pick_node(placement)
             if node is not None:
                 record.node_id = node.node_id
                 client = self._clients.get(node.address)
@@ -239,6 +260,16 @@ class GcsServer:
                     best, best_score = info, score
         return best
 
+    def _pg_bundle_node(self, pg_id, bundle_index: int) -> NodeInfo | None:
+        record = self._placement_groups.get(pg_id)
+        if record is None or record["state"] != "CREATED":
+            return None
+        if not 0 <= bundle_index < len(record["bundle_nodes"]):
+            raise ValueError(
+                f"bundle index {bundle_index} out of range for group with "
+                f"{len(record['bundle_nodes'])} bundles")
+        return record["bundle_nodes"][bundle_index]
+
     async def _actor_state_update(self, payload):
         actor_id = payload["actor_id"]
         record = self._actors.get(actor_id)
@@ -253,6 +284,28 @@ class GcsServer:
         record.state_event.set()
         record.state_event = asyncio.Event()
         return True
+
+    async def _list_actors(self, _payload):
+        return [
+            {
+                "actor_id": r.spec.actor_id.hex(),
+                "class_name": r.spec.class_name,
+                "state": r.state,
+                "address": r.address,
+                "name": r.spec.name,
+                "death_reason": r.death_reason,
+            }
+            for r in self._actors.values()
+        ]
+
+    async def _list_objects(self, _payload):
+        return [
+            {
+                "object_id": oid.hex(),
+                "locations": [nid.hex() for nid in nodes],
+            }
+            for oid, nodes in self._object_locations.items()
+        ]
 
     async def _get_actor_info(self, payload):
         record = self._actors.get(payload["actor_id"])
@@ -377,6 +430,182 @@ class GcsServer:
             except Exception:  # noqa: BLE001
                 pass
         return True
+
+    # ------------------------------------------------- placement groups
+    # (ref: GcsPlacementGroupManager + 2-phase bundle reservation,
+    #  gcs_placement_group_scheduler.h)
+
+    async def _create_placement_group(self, payload):
+        record = {
+            "pg_id": payload["pg_id"],
+            "bundles": payload["bundles"],
+            "strategy": payload["strategy"],
+            "name": payload.get("name", ""),
+            "state": "PENDING",
+            "bundle_nodes": [None] * len(payload["bundles"]),
+            "reason": "",
+        }
+        self._placement_groups[payload["pg_id"]] = record
+        asyncio.ensure_future(self._schedule_placement_group(record))
+        return True
+
+    def _plan_bundles(self, bundles, strategy) -> list[NodeInfo] | None:
+        """Choose a node per bundle against the availability view; None if
+        no valid assignment right now."""
+        alive = [n for n in self._nodes.values() if n.alive]
+        remaining = {n.node_id: dict(n.available_resources) for n in alive}
+
+        def fits(node_id, bundle):
+            return all(remaining[node_id].get(k, 0.0) >= v
+                       for k, v in bundle.items())
+
+        def take(node_id, bundle):
+            for k, v in bundle.items():
+                remaining[node_id][k] = remaining[node_id].get(k, 0.0) - v
+
+        plan: list[NodeInfo] = []
+        if strategy in ("STRICT_PACK", "PACK"):
+            # try to fit everything on one node
+            for node in alive:
+                snapshot = dict(remaining[node.node_id])
+                ok = True
+                for bundle in bundles:
+                    if fits(node.node_id, bundle):
+                        take(node.node_id, bundle)
+                    else:
+                        ok = False
+                        break
+                remaining[node.node_id] = snapshot
+                if ok:
+                    return [node] * len(bundles)
+            if strategy == "STRICT_PACK":
+                return None
+        # greedy per-bundle; SPREAD/STRICT_SPREAD prefer unused nodes
+        used: set = set()
+        for bundle in bundles:
+            candidates = sorted(
+                alive, key=lambda n: (n.node_id in used,
+                                      -sum(remaining[n.node_id].values())))
+            chosen = None
+            for node in candidates:
+                if strategy == "STRICT_SPREAD" and node.node_id in used:
+                    continue
+                if fits(node.node_id, bundle):
+                    chosen = node
+                    break
+            if chosen is None:
+                return None
+            take(chosen.node_id, bundle)
+            used.add(chosen.node_id)
+            plan.append(chosen)
+        return plan
+
+    async def _schedule_placement_group(self, record):
+        bundles = record["bundles"]
+        for _attempt in range(120):
+            if record["state"] == "REMOVED":
+                return
+            plan = self._plan_bundles(bundles, record["strategy"])
+            if plan is not None:
+                prepared = []
+                ok = True
+                for index, (bundle, node) in enumerate(zip(bundles, plan)):
+                    client = self._clients.get(node.address)
+                    try:
+                        reply = await client.call_async("PrepareBundle", {
+                            "pg_id": record["pg_id"], "index": index,
+                            "resources": bundle}, timeout=10)
+                    except Exception:  # noqa: BLE001
+                        reply = {"ok": False}
+                    if reply.get("ok"):
+                        prepared.append((index, node))
+                    else:
+                        ok = False
+                        break
+                # A concurrent RemovePlacementGroup may have fired while we
+                # were preparing — or a node may die mid-commit.  Any such
+                # case aborts and rolls back every prepared bundle.
+                if ok and record["state"] != "REMOVED":
+                    committed = True
+                    for index, node in prepared:
+                        client = self._clients.get(node.address)
+                        try:
+                            await client.call_async("CommitBundle", {
+                                "pg_id": record["pg_id"], "index": index},
+                                timeout=10)
+                        except Exception:  # noqa: BLE001
+                            committed = False
+                            break
+                        record["bundle_nodes"][index] = node
+                    if committed and record["state"] != "REMOVED":
+                        record["state"] = "CREATED"
+                        return
+                for index, node in prepared:  # roll back (2-phase abort)
+                    record["bundle_nodes"][index] = None
+                    client = self._clients.get(node.address)
+                    try:
+                        await client.call_async("ReturnBundle", {
+                            "pg_id": record["pg_id"], "index": index},
+                            timeout=10)
+                    except Exception:  # noqa: BLE001
+                        pass
+                if record["state"] == "REMOVED":
+                    return
+            else:
+                # Distinguish "busy now" from "never possible".
+                totals = {n.node_id: dict(n.total_resources)
+                          for n in self._nodes.values() if n.alive}
+                feasible_nodes = len(totals)
+                if record["strategy"] == "STRICT_SPREAD" and \
+                        len(bundles) > feasible_nodes:
+                    record["state"] = "FAILED"
+                    record["reason"] = (
+                        f"STRICT_SPREAD needs {len(bundles)} nodes, "
+                        f"cluster has {feasible_nodes}")
+                    return
+            await asyncio.sleep(0.25)
+        record["state"] = "FAILED"
+        record["reason"] = "timed out waiting for resources"
+
+    async def _get_placement_group(self, payload):
+        record = self._placement_groups.get(payload["pg_id"])
+        if record is None:
+            return None
+        return {
+            "state": record["state"],
+            "strategy": record["strategy"],
+            "reason": record["reason"],
+            "bundle_nodes": [
+                (n.address if n is not None else None)
+                for n in record["bundle_nodes"]
+            ],
+            "bundles": record["bundles"],
+        }
+
+    async def _remove_placement_group(self, payload):
+        record = self._placement_groups.get(payload["pg_id"])
+        if record is None:
+            return False
+        record["state"] = "REMOVED"
+        for index, node in enumerate(record["bundle_nodes"]):
+            if node is None:
+                continue
+            client = self._clients.get(node.address)
+            try:
+                await client.call_async("ReturnBundle", {
+                    "pg_id": record["pg_id"], "index": index}, timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+        del self._placement_groups[payload["pg_id"]]
+        return True
+
+    async def _list_placement_groups(self, _payload):
+        return {
+            pg_id.hex(): {"state": r["state"], "strategy": r["strategy"],
+                          "name": r["name"],
+                          "bundles": r["bundles"]}
+            for pg_id, r in self._placement_groups.items()
+        }
 
     # ------------------------------------------------------------- placement
 

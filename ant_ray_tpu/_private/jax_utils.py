@@ -28,3 +28,13 @@ def import_jax():
                 pass
         _configured = True
     return jax
+
+
+def shard_map():
+    """The shard_map entry point across jax versions."""
+    import_jax()
+    try:
+        from jax import shard_map as fn  # noqa: PLC0415
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as fn  # noqa: PLC0415
+    return fn

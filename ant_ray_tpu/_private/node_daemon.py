@@ -37,6 +37,7 @@ class WorkerHandle:
     address: str = ""
     state: str = STARTING
     lease_resources: dict[str, float] = field(default_factory=dict)
+    lease_pg: tuple | None = None        # (pg_id, bundle_index) if any
     actor_spec: ActorSpec | None = None
     blocked: bool = False
     registered: asyncio.Event = field(default_factory=asyncio.Event)
@@ -63,6 +64,8 @@ class NodeManager:
 
         self._total = dict(resources)
         self._available = dict(resources)
+        # (pg_id, bundle_index) -> {"resources", "available", "committed"}
+        self._bundles: dict[tuple, dict] = {}
         self._workers: dict[WorkerID, WorkerHandle] = {}
         self._lease_event = asyncio.Event()
         self._max_workers = int(
@@ -82,6 +85,9 @@ class NodeManager:
             "WorkerUnblocked": self._worker_unblocked,
             "StartActorWorker": self._start_actor_worker,
             "KillActorWorker": self._kill_actor_worker,
+            "PrepareBundle": self._prepare_bundle,
+            "CommitBundle": self._commit_bundle,
+            "ReturnBundle": self._return_bundle,
             "SealObject": self._seal_object,
             "EnsureLocal": self._ensure_local,
             "ReadChunk": self._read_chunk,
@@ -206,9 +212,13 @@ class NodeManager:
                     continue
                 del self._workers[worker_id]
                 if handle.state == LEASED and not handle.blocked:
-                    self._release(handle.lease_resources)
+                    if handle.lease_pg is not None:
+                        self._bundle_release(handle.lease_pg,
+                                             handle.lease_resources)
+                    else:
+                        self._release(handle.lease_resources)
                 if handle.state == ACTOR and handle.actor_spec is not None:
-                    self._release(handle.actor_spec.resources)
+                    self._release_actor_resources(handle.actor_spec)
                     try:
                         await gcs.call_async("WorkerDied", {
                             "node_id": self.node_id,
@@ -267,6 +277,42 @@ class NodeManager:
         demand: dict[str, float] = payload.get("resources", {})
         gcs = self._clients.get(self._gcs_address)
 
+        pg_key = payload.get("pg")
+        if pg_key is not None:
+            # Lease against a committed placement-group bundle: resources
+            # come out of the reservation, never the general pool.
+            while True:
+                bundle = self._bundles.get(pg_key)
+                if bundle is not None and not all(
+                        bundle["resources"].get(k, 0.0) >= v
+                        for k, v in demand.items()):
+                    return {"infeasible": True,
+                            "reason": f"demand {demand} exceeds bundle "
+                                      f"capacity {bundle['resources']}"}
+                if self._bundle_can_allocate(pg_key, demand):
+                    worker = self._idle_worker()
+                    if worker is None and \
+                            self._pool_size() < self._max_workers + 4:
+                        handle = self._spawn_worker()
+                        await handle.registered.wait()
+                        worker = handle if handle.state == IDLE else None
+                    if worker is not None:
+                        self._bundle_allocate(pg_key, demand)
+                        worker.state = LEASED
+                        worker.lease_resources = dict(demand)
+                        worker.lease_pg = pg_key
+                        return {"granted": worker.address,
+                                "worker_id": worker.worker_id}
+                elif pg_key not in self._bundles:
+                    return {"infeasible": True,
+                            "reason": "bundle not reserved on this node"}
+                self._lease_event.clear()
+                try:
+                    await asyncio.wait_for(self._lease_event.wait(),
+                                           timeout=0.2)
+                except asyncio.TimeoutError:
+                    pass
+
         if not self._feasible(demand):
             node = await gcs.call_async(
                 "SelectNode", {"resources": demand, "exclude": self.node_id},
@@ -311,9 +357,14 @@ class NodeManager:
             return False
         if handle.state == LEASED:
             if not handle.blocked:
-                self._release(handle.lease_resources)
+                if handle.lease_pg is not None:
+                    self._bundle_release(handle.lease_pg,
+                                         handle.lease_resources)
+                else:
+                    self._release(handle.lease_resources)
             handle.blocked = False
             handle.lease_resources = {}
+            handle.lease_pg = None
             handle.state = IDLE
             self._lease_event.set()
         return True
@@ -324,7 +375,10 @@ class NodeManager:
         handle = self._workers.get(payload["worker_id"])
         if handle is not None and handle.state == LEASED and not handle.blocked:
             handle.blocked = True
-            self._release(handle.lease_resources)
+            if handle.lease_pg is not None:
+                self._bundle_release(handle.lease_pg, handle.lease_resources)
+            else:
+                self._release(handle.lease_resources)
         return True
 
     async def _worker_unblocked(self, payload):
@@ -333,12 +387,84 @@ class NodeManager:
             handle.blocked = False
             # Re-acquire even if it drives availability negative: the worker
             # already holds the lease; balance restores at return.
-            self._allocate(handle.lease_resources)
+            if handle.lease_pg is not None:
+                self._bundle_allocate(handle.lease_pg,
+                                      handle.lease_resources)
+            else:
+                self._allocate(handle.lease_resources)
         return True
+
+    # ------------------------------------------------------------ bundles
+    # 2-phase placement-group reservation (ref: raylet
+    # placement_group_resource_manager.h prepare/commit/return)
+
+    async def _prepare_bundle(self, payload):
+        key = (payload["pg_id"], payload["index"])
+        if key in self._bundles:
+            return {"ok": True}  # idempotent retry
+        resources = payload["resources"]
+        if not self._can_allocate(resources):
+            return {"ok": False, "reason": "insufficient resources"}
+        self._allocate(resources)
+        self._bundles[key] = {
+            "resources": dict(resources),
+            "available": dict(resources),
+            "committed": False,
+        }
+        return {"ok": True}
+
+    async def _commit_bundle(self, payload):
+        key = (payload["pg_id"], payload["index"])
+        bundle = self._bundles.get(key)
+        if bundle is None:
+            return {"ok": False}
+        bundle["committed"] = True
+        return {"ok": True}
+
+    async def _return_bundle(self, payload):
+        key = (payload["pg_id"], payload["index"])
+        bundle = self._bundles.pop(key, None)
+        if bundle is not None:
+            # Release only the unused portion now; leases still running
+            # against this bundle return their share to the general pool
+            # when they finish (see _bundle_release) — otherwise removal
+            # would oversubscribe the node while tasks still run.
+            self._release(bundle["available"])
+        return True
+
+    def _bundle_can_allocate(self, key, demand) -> bool:
+        bundle = self._bundles.get(key)
+        return bundle is not None and bundle["committed"] and all(
+            bundle["available"].get(k, 0.0) >= v for k, v in demand.items())
+
+    def _bundle_allocate(self, key, demand):
+        bundle = self._bundles[key]
+        for k, v in demand.items():
+            bundle["available"][k] = bundle["available"].get(k, 0.0) - v
+
+    def _bundle_release(self, key, demand):
+        bundle = self._bundles.get(key)
+        if bundle is None:
+            # Bundle was removed while this lease was outstanding: its
+            # in-use portion was withheld from the general pool at
+            # ReturnBundle time, so it goes back to the pool here.
+            self._release(demand)
+            return
+        for k, v in demand.items():
+            bundle["available"][k] = bundle["available"].get(k, 0.0) + v
+        self._lease_event.set()
 
     # ------------------------------------------------------------ actors
 
     async def _start_actor_worker(self, spec: ActorSpec):
+        if spec.placement_group_id is not None:
+            key = (spec.placement_group_id,
+                   spec.placement_group_bundle_index)
+            if not self._bundle_can_allocate(key, spec.resources):
+                raise RuntimeError("bundle cannot host this actor")
+            self._bundle_allocate(key, spec.resources)
+            self._spawn_worker(actor_spec=spec)
+            return True
         placement = spec.placement_resources or spec.resources
         if not self._feasible(placement):
             raise RuntimeError("insufficient node resources for actor")
@@ -358,10 +484,18 @@ class NodeManager:
                 spec = handle.actor_spec
                 handle.actor_spec = None
                 handle.state = STARTING
-                self._release(spec.resources)
+                self._release_actor_resources(spec)
                 self._terminate_worker(handle)
                 return True
         return False
+
+    def _release_actor_resources(self, spec: ActorSpec):
+        if spec.placement_group_id is not None:
+            self._bundle_release(
+                (spec.placement_group_id,
+                 spec.placement_group_bundle_index), spec.resources)
+        else:
+            self._release(spec.resources)
 
     # ------------------------------------------------------------ objects
 

@@ -105,7 +105,9 @@ def serialize(value: Any) -> SerializedObject:
                 # NEXT_BUFFER consumption order of other buffers.
                 host = np.asarray(jax.device_get(obj))
                 return (_rebuild_jax_array, (host,))
-            return NotImplemented
+            # Defer to cloudpickle's own reducer_override (it implements
+            # local-function/class support there, not in dispatch).
+            return super().reducer_override(obj)
 
     out = io.BytesIO()
     try:

@@ -23,6 +23,9 @@ class TaskSpec:
     actor_id: ActorID | None = None
     method_name: str = ""
     sequence_no: int = -1         # per-submitter ordering for actor tasks
+    # Placement-group routing
+    placement_group_id: "object | None" = None
+    placement_group_bundle_index: int = -1
 
 
 @dataclass
@@ -42,6 +45,8 @@ class ActorSpec:
     namespace: str = "default"
     lifetime: str | None = None
     job_id: JobID | None = None
+    placement_group_id: "object | None" = None
+    placement_group_bundle_index: int = -1
 
 
 @dataclass

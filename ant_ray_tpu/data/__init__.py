@@ -1,0 +1,8 @@
+"""Streaming datasets (ref capability: ray.data — lazy logical plan,
+block-parallel execution, streaming iteration)."""
+
+from ant_ray_tpu.data.dataset import Dataset, from_items, from_numpy, range_
+
+range = range_  # noqa: A001 — mirrors ray.data.range
+
+__all__ = ["Dataset", "from_items", "from_numpy", "range"]

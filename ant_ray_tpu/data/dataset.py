@@ -1,0 +1,184 @@
+"""Dataset: lazy block-parallel transforms with streaming execution.
+
+Architecture (scaled-down mirror of the reference, SURVEY §2.4 Data):
+data is a list of *blocks* (object refs to item lists), transforms build a
+lazy chain of fused per-block functions (the reference's OneToOne operator
+fusion), and consumption streams blocks through tasks with a bounded
+in-flight window (the StreamingExecutor's backpressure, ref:
+execution/streaming_executor.py:67) so datasets larger than memory flow.
+"""
+
+from __future__ import annotations
+
+import builtins
+from typing import Any, Callable, Iterable, Iterator
+
+DEFAULT_PARALLELISM = 8
+DEFAULT_IN_FLIGHT = 8
+
+
+def _art():
+    import ant_ray_tpu as art  # noqa: PLC0415
+
+    return art
+
+
+class Dataset:
+    def __init__(self, block_refs: list, transforms: tuple = ()):
+        self._block_refs = list(block_refs)
+        self._transforms = tuple(transforms)
+
+    # -------------------------------------------------------- transforms
+
+    def _with(self, fn: Callable[[list], list]) -> "Dataset":
+        return Dataset(self._block_refs, self._transforms + (fn,))
+
+    def map(self, fn: Callable[[Any], Any]) -> "Dataset":
+        return self._with(lambda block: [fn(x) for x in block])
+
+    def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
+        return self._with(lambda block: [x for x in block if fn(x)])
+
+    def flat_map(self, fn: Callable[[Any], Iterable]) -> "Dataset":
+        return self._with(
+            lambda block: [y for x in block for y in fn(x)])
+
+    def map_batches(self, fn: Callable[[list], list],
+                    batch_size: int | None = None) -> "Dataset":
+        def apply(block: list) -> list:
+            if batch_size is None:
+                return list(fn(block))
+            out: list = []
+            for i in builtins.range(0, len(block), batch_size):
+                out.extend(fn(block[i:i + batch_size]))
+            return out
+
+        return self._with(apply)
+
+    # -------------------------------------------------------- execution
+
+    def _fused_fn(self):
+        transforms = self._transforms
+
+        def run(block: list) -> list:
+            for t in transforms:
+                block = t(block)
+            return block
+
+        return run
+
+    def materialize(self) -> "Dataset":
+        """Execute all pending transforms; returns a transform-free
+        Dataset over new blocks."""
+        if not self._transforms:
+            return self
+        art = _art()
+        run = self._fused_fn()
+        apply_block = art.remote(lambda block: run(block))
+        new_refs = [apply_block.remote(ref) for ref in self._block_refs]
+        return Dataset(new_refs)
+
+    def _iter_result_blocks(self, in_flight: int = DEFAULT_IN_FLIGHT
+                            ) -> Iterator[list]:
+        """Stream blocks through the transform chain with bounded
+        in-flight tasks (backpressure)."""
+        art = _art()
+        if not self._transforms:
+            for ref in self._block_refs:
+                yield art.get(ref)
+            return
+        run = self._fused_fn()
+        apply_block = art.remote(lambda block: run(block))
+        pending_input = list(self._block_refs)
+        running: list = []
+        while pending_input or running:
+            while pending_input and len(running) < in_flight:
+                running.append(apply_block.remote(pending_input.pop(0)))
+            ready, running = art.wait(running, num_returns=1, timeout=30.0)
+            for ref in ready:
+                yield art.get(ref)
+
+    # -------------------------------------------------------- consumption
+
+    def iter_rows(self) -> Iterator[Any]:
+        for block in self._iter_result_blocks():
+            yield from block
+
+    def iter_batches(self, batch_size: int = 256) -> Iterator[list]:
+        buffer: list = []
+        for block in self._iter_result_blocks():
+            buffer.extend(block)
+            while len(buffer) >= batch_size:
+                yield buffer[:batch_size]
+                buffer = buffer[batch_size:]
+        if buffer:
+            yield buffer
+
+    def take(self, n: int = 20) -> list:
+        out: list = []
+        for block in self._iter_result_blocks():
+            out.extend(block)
+            if len(out) >= n:
+                return out[:n]
+        return out
+
+    def take_all(self) -> list:
+        return [x for block in self._iter_result_blocks() for x in block]
+
+    def count(self) -> int:
+        art = _art()
+        run = self._fused_fn()
+        counter = art.remote(lambda block: len(run(block)))
+        return sum(art.get([counter.remote(r) for r in self._block_refs]))
+
+    # -------------------------------------------------------- reshaping
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        items = self.take_all()
+        return from_items(items, parallelism=num_blocks)
+
+    def split(self, n: int) -> list["Dataset"]:
+        """Split into n datasets block-wise (for per-worker shards)."""
+        ds = self.materialize()
+        shards: list[list] = [[] for _ in builtins.range(n)]
+        for i, ref in enumerate(ds._block_refs):
+            shards[i % n].append(ref)
+        return [Dataset(refs) for refs in shards]
+
+    def random_shuffle(self, seed: int | None = None) -> "Dataset":
+        import random as _random  # noqa: PLC0415
+
+        items = self.take_all()
+        _random.Random(seed).shuffle(items)
+        return from_items(items, parallelism=max(1, len(self._block_refs)))
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self._block_refs)
+
+    def __repr__(self):
+        return (f"Dataset(num_blocks={self.num_blocks}, "
+                f"pending_transforms={len(self._transforms)})")
+
+
+# ------------------------------------------------------------ constructors
+
+def from_items(items: list, parallelism: int = DEFAULT_PARALLELISM
+               ) -> Dataset:
+    art = _art()
+    items = list(items)
+    if not items:
+        return Dataset([art.put([])])
+    parallelism = max(1, min(parallelism, len(items)))
+    size = (len(items) + parallelism - 1) // parallelism
+    refs = [art.put(items[i:i + size])
+            for i in builtins.range(0, len(items), size)]
+    return Dataset(refs)
+
+
+def range_(n: int, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return from_items(list(builtins.range(n)), parallelism)
+
+
+def from_numpy(array, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return from_items(list(array), parallelism)

@@ -1,0 +1,6 @@
+"""Model family implementations (functional JAX, sharding-rule driven)."""
+
+from ant_ray_tpu.models import llama
+from ant_ray_tpu.models.llama import LlamaConfig
+
+__all__ = ["LlamaConfig", "llama"]

@@ -1,0 +1,276 @@
+"""Llama model family — functional JAX, one definition for every
+parallelism strategy.
+
+Design: parameters are a plain pytree with a parallel tree of *logical*
+dimension names (parallel/sharding.py) so DP / FSDP / TP / SP placement is
+a rule-table swap, not a model change.  Layers are stacked on a leading
+axis and executed with ``lax.scan`` (fast compiles, uniform remat), blocks
+are ``jax.checkpoint``-ed, attention dispatches to blockwise / pallas
+flash / ring (sequence-parallel) based on the mesh.
+
+Flagship configs mirror the reference's north-star benchmark target
+(BASELINE.md: Llama-3-8B ≥ 40% MFU on v5e-64).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ant_ray_tpu.ops.attention import attention
+from ant_ray_tpu.ops.rmsnorm import rmsnorm
+from ant_ray_tpu.ops.rope import apply_rope, rope_frequencies
+from ant_ray_tpu.parallel.sharding import logical_to_spec
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    mlp_dim: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    def num_params(self) -> int:
+        p = self.vocab_size * self.dim                       # embed
+        per_layer = (
+            self.dim * self.n_heads * self.head_dim          # wq
+            + 2 * self.dim * self.n_kv_heads * self.head_dim  # wk, wv
+            + self.n_heads * self.head_dim * self.dim        # wo
+            + 3 * self.dim * self.mlp_dim                    # gate, up, down
+            + 2 * self.dim                                   # norms
+        )
+        p += self.n_layers * per_layer + self.dim            # final norm
+        if not self.tie_embeddings:
+            p += self.dim * self.vocab_size                  # lm head
+        return p
+
+
+CONFIGS: dict[str, LlamaConfig] = {
+    # ref parity: the Llama-3-8B benchmark model (BASELINE.md north star)
+    "llama3-8b": LlamaConfig(),
+    "llama3-1b": LlamaConfig(
+        vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
+        mlp_dim=8192, max_seq=8192),
+    # small enough to train on one v5e chip (bench fallback)
+    "llama-400m": LlamaConfig(
+        vocab_size=32768, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
+        mlp_dim=4096, max_seq=4096),
+    "tiny": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=128, max_seq=512, dtype=jnp.float32),
+}
+
+
+# ---------------------------------------------------------------- params
+
+def param_shapes(config: LlamaConfig) -> dict:
+    c = config
+    hd = c.head_dim
+    return {
+        "embed": (c.vocab_size, c.dim),
+        "layers": {
+            "ln_attn": (c.n_layers, c.dim),
+            "wq": (c.n_layers, c.dim, c.n_heads * hd),
+            "wk": (c.n_layers, c.dim, c.n_kv_heads * hd),
+            "wv": (c.n_layers, c.dim, c.n_kv_heads * hd),
+            "wo": (c.n_layers, c.n_heads * hd, c.dim),
+            "ln_mlp": (c.n_layers, c.dim),
+            "w_gate": (c.n_layers, c.dim, c.mlp_dim),
+            "w_up": (c.n_layers, c.dim, c.mlp_dim),
+            "w_down": (c.n_layers, c.mlp_dim, c.dim),
+        },
+        "norm_f": (c.dim,),
+        **({} if config.tie_embeddings else
+           {"lm_head": (c.dim, c.vocab_size)}),
+    }
+
+
+def param_logical_dims(config: LlamaConfig) -> dict:
+    """Logical dim names per param (see parallel/sharding.py rules)."""
+    tree = {
+        "embed": ("vocab", "embed_param"),
+        "layers": {
+            "ln_attn": (None, "norm"),
+            "wq": (None, "embed_param", "heads_flat"),
+            "wk": (None, "embed_param", "heads_flat"),
+            "wv": (None, "embed_param", "heads_flat"),
+            "wo": (None, "heads_flat", "embed_param"),
+            "ln_mlp": (None, "norm"),
+            "w_gate": (None, "embed_param", "mlp"),
+            "w_up": (None, "embed_param", "mlp"),
+            "w_down": (None, "mlp", "embed_param"),
+        },
+        "norm_f": ("norm",),
+    }
+    if not config.tie_embeddings:
+        tree["lm_head"] = ("embed_param", "vocab")
+    return tree
+
+
+# extra rule: flattened (heads*head_dim) dims shard over tp
+LLAMA_RULES_EXTRA = {"heads_flat": "tp"}
+
+
+def llama_rules() -> dict:
+    from ant_ray_tpu.parallel.sharding import DEFAULT_LLAMA_RULES  # noqa: PLC0415
+
+    rules = dict(DEFAULT_LLAMA_RULES)
+    rules.update(LLAMA_RULES_EXTRA)
+    return rules
+
+
+def init_params(config: LlamaConfig, key) -> dict:
+    shapes = param_shapes(config)
+    flat, treedef = jax.tree.flatten(shapes, is_leaf=lambda x: isinstance(
+        x, tuple))
+    keys = jax.random.split(key, len(flat))
+
+    def _init(shape, k):
+        if len(shape) <= 2 and shape[-1] == config.dim and len(shape) == 1:
+            return jnp.ones(shape, config.dtype)             # final norm
+        if shape[-1] == config.dim and len(shape) == 2 and \
+                shape[0] == config.n_layers:
+            return jnp.ones(shape, config.dtype)             # layer norms
+        scale = 0.02
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(
+            config.dtype)
+
+    leaves = [_init(s, k) for s, k in zip(flat, keys)]
+    return jax.tree.unflatten(treedef, leaves)
+
+
+def param_shardings(config: LlamaConfig, mesh) -> dict:
+    """NamedSharding pytree for jit in_shardings / device_put."""
+    from jax.sharding import NamedSharding  # noqa: PLC0415
+
+    rules = llama_rules()
+    logical = param_logical_dims(config)
+    shapes = param_shapes(config)
+
+    def _shard(dims, _shape):
+        return NamedSharding(mesh, logical_to_spec(dims, rules))
+
+    return jax.tree.map(
+        _shard, logical, shapes,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            isinstance(d, (str, type(None))) for d in x))
+
+
+# ---------------------------------------------------------------- forward
+
+def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
+            attn_impl: str = "auto", positions=None):
+    """tokens: (batch, seq) int32 → logits (batch, seq, vocab) fp32.
+
+    When ``mesh`` is provided, activations get sharding constraints
+    (batch over dp/fsdp, seq over sp, heads over tp) and sequence-sharded
+    meshes use ring attention.
+    """
+    c = config
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta,
+                                jnp.float32)
+    use_ring = mesh is not None and mesh.shape.get("sp", 1) > 1
+
+    def constrain_act(x, dims):
+        if mesh is None:
+            return x
+        from jax.sharding import NamedSharding  # noqa: PLC0415
+
+        spec = logical_to_spec(dims, llama_rules())
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    def attend(xq, xk, xv):
+        if use_ring:
+            from ant_ray_tpu.parallel.ring import ring_attention  # noqa: PLC0415
+
+            return ring_attention(xq, xk, xv, mesh=mesh, causal=True)
+        return attention(xq, xk, xv, causal=True, impl=attn_impl)
+
+    @jax.checkpoint
+    def block(x, layer):
+        batch, seq, _ = x.shape
+        h = rmsnorm(x, layer["ln_attn"], c.norm_eps)
+        xq = (h @ layer["wq"]).reshape(batch, seq, c.n_heads, c.head_dim)
+        xk = (h @ layer["wk"]).reshape(batch, seq, c.n_kv_heads, c.head_dim)
+        xv = (h @ layer["wv"]).reshape(batch, seq, c.n_kv_heads, c.head_dim)
+        xq = apply_rope(xq, cos, sin, positions)
+        xk = apply_rope(xk, cos, sin, positions)
+        xq = constrain_act(xq, ("batch", "seq", "heads", "head_dim"))
+        xk = constrain_act(xk, ("batch", "seq", "kv_heads", "head_dim"))
+        attn = attend(xq, xk, xv)
+        attn = attn.reshape(batch, seq, c.n_heads * c.head_dim)
+        x = x + (attn @ layer["wo"]).astype(x.dtype)
+        x = constrain_act(x, ("batch", "seq", "embed"))
+
+        h = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
+        gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+        x = x + (gated @ layer["w_down"]).astype(x.dtype)
+        return constrain_act(x, ("batch", "seq", "embed"))
+
+    x = params["embed"][tokens].astype(c.dtype)
+    x = constrain_act(x, ("batch", "seq", "embed"))
+    x, _ = lax.scan(lambda h_, layer: (block(h_, layer), None),
+                    x, params["layers"])
+    x = rmsnorm(x, params["norm_f"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+    return constrain_act(logits, ("batch", "seq", None))
+
+
+def loss_fn(params: dict, batch: dict, config: LlamaConfig, *, mesh=None,
+            attn_impl: str = "auto"):
+    """batch: {"tokens": (b, s+1) int32} — next-token cross entropy."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, config, mesh=mesh, attn_impl=attn_impl)
+    import optax  # noqa: PLC0415
+
+    losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:]
+        return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(losses)
+
+
+def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token (6·N matmul + attention quadratic term)."""
+    c = config
+    matmul = 6 * c.num_params()
+    attn = 12 * c.n_layers * c.head_dim * c.n_heads * seq_len
+    return matmul + attn
+
+
+# ---------------------------------------------------------------- generate
+
+def greedy_generate(params: dict, config: LlamaConfig, prompt,
+                    max_new_tokens: int = 32):
+    """Minimal greedy decoding (no KV cache — correctness utility; the
+    serving engine owns the fast path)."""
+    tokens = jnp.asarray(prompt)[None] if jnp.ndim(prompt) == 1 else prompt
+
+    @jax.jit
+    def next_token(toks):
+        logits = forward(params, toks, config)
+        return jnp.argmax(logits[:, -1], axis=-1)
+
+    for _ in range(max_new_tokens):
+        nxt = next_token(tokens)
+        tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    return tokens

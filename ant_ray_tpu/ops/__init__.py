@@ -1,0 +1,14 @@
+"""TPU compute ops: attention (blockwise / pallas flash / ring dispatch),
+rotary embeddings, rmsnorm."""
+
+from ant_ray_tpu.ops.attention import attention, blockwise_attention
+from ant_ray_tpu.ops.rmsnorm import rmsnorm
+from ant_ray_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = [
+    "apply_rope",
+    "attention",
+    "blockwise_attention",
+    "rmsnorm",
+    "rope_frequencies",
+]

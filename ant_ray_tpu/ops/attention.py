@@ -1,0 +1,137 @@
+"""Attention implementations and the dispatch layer.
+
+* :func:`blockwise_attention` — pure-JAX flash-style attention: a
+  ``lax.scan`` over KV blocks with online softmax, O(seq · block) memory,
+  fully differentiable (JAX derives the backward through the scan, and
+  ``jax.checkpoint`` on the block body keeps the residuals bounded).  This
+  is the training default: static shapes, MXU-shaped matmuls, no custom
+  VJP to maintain.
+* pallas flash forward kernel (ops/pallas/flash_attention.py) — the fast
+  forward path, wired as custom_vjp with blockwise recompute backward.
+* :func:`attention` — dispatcher: pallas on TPU when shapes tile cleanly,
+  blockwise otherwise; ring attention (parallel/ring.py) takes over when
+  the sequence axis is sharded.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # avoids -inf NaN pitfalls in fully-masked blocks
+
+
+def _repeat_kv(k, groups: int):
+    return jnp.repeat(k, groups, axis=2) if groups > 1 else k
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "scale", "block_k"))
+def blockwise_attention(q, k, v, *, causal: bool = True,
+                        scale: float | None = None, block_k: int = 512):
+    """Flash-style attention in pure JAX.
+
+    q: (batch, q_len, heads, dim); k/v: (batch, kv_len, kv_heads, dim).
+    Memory is O(q_len · block_k) per head instead of O(q_len · kv_len).
+    """
+    batch, q_len, num_heads, head_dim = q.shape
+    kv_len, num_kv_heads = k.shape[1], k.shape[2]
+    groups = num_heads // num_kv_heads
+    scale = scale if scale is not None else head_dim ** -0.5
+    block_k = min(block_k, kv_len)
+    if kv_len % block_k != 0:
+        raise ValueError(f"kv_len {kv_len} % block_k {block_k} != 0")
+    num_blocks = kv_len // block_k
+
+    # Matmul inputs stay in the model dtype (bf16 on TPU) with fp32
+    # accumulation — fp32 inputs would cut the MXU rate severalfold.
+    qt = q.transpose(0, 2, 1, 3)                                 # b h q d
+    kt = _repeat_kv(k, groups).transpose(0, 2, 1, 3)
+    vt = _repeat_kv(v, groups).transpose(0, 2, 1, 3)
+    k_blocks = kt.reshape(batch, num_heads, num_blocks, block_k, head_dim)
+    v_blocks = vt.reshape(batch, num_heads, num_blocks, block_k, head_dim)
+
+    q_pos = jnp.arange(q_len)
+
+    @jax.checkpoint
+    def body(carry, blk):
+        o, l, m = carry
+        k_b, v_b, blk_idx = blk
+        scores = jnp.einsum("bhqd,bhkd->bhqk", qt, k_b,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            kv_pos = blk_idx * block_k + jnp.arange(block_k)
+            mask = kv_pos[None, :] > q_pos[:, None]
+            scores = jnp.where(mask[None, None], NEG_INF, scores)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1))
+        p = jnp.exp(scores - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(qt.dtype), v_b,
+            preferred_element_type=jnp.float32)
+        l = l * corr + jnp.sum(p, axis=-1)
+        return (o, l, m_new), None
+
+    o0 = jnp.zeros((batch, num_heads, q_len, head_dim), jnp.float32)
+    l0 = jnp.zeros((batch, num_heads, q_len), jnp.float32)
+    m0 = jnp.full((batch, num_heads, q_len), NEG_INF, jnp.float32)
+    (o, l, _m), _ = lax.scan(
+        body, (o0, l0, m0),
+        (k_blocks.transpose(2, 0, 1, 3, 4),
+         v_blocks.transpose(2, 0, 1, 3, 4),
+         jnp.arange(num_blocks)))
+    l = jnp.where(l == 0.0, 1.0, l)
+    out = (o / l[..., None]).transpose(0, 2, 1, 3)
+    return out.astype(q.dtype)
+
+
+def _pallas_available() -> bool:
+    try:
+        backend = jax.default_backend()
+    except Exception:  # noqa: BLE001
+        return False
+    return backend in ("tpu", "axon")
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_with_blockwise_bwd(q, k, v, causal, scale):
+    from ant_ray_tpu.ops.pallas.flash_attention import flash_attention_forward  # noqa: PLC0415
+
+    return flash_attention_forward(q, k, v, causal=causal, scale=scale)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    return _flash_with_blockwise_bwd(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bwd(causal, scale, residuals, g):
+    q, k, v = residuals
+    _out, vjp = jax.vjp(
+        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
+                                               scale=scale), q, k, v)
+    return vjp(g)
+
+
+_flash_with_blockwise_bwd.defvjp(_flash_fwd, _flash_bwd)
+
+
+def attention(q, k, v, *, causal: bool = True, scale: float | None = None,
+              impl: str = "auto"):
+    """Dispatch: 'pallas' | 'blockwise' | 'reference' | 'auto'."""
+    if impl == "auto":
+        seq_ok = q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0
+        dim_ok = q.shape[-1] in (64, 128, 256)
+        impl = ("pallas" if _pallas_available() and seq_ok and dim_ok
+                else "blockwise")
+    if impl == "pallas":
+        return _flash_with_blockwise_bwd(q, k, v, causal, scale)
+    if impl == "blockwise":
+        return blockwise_attention(q, k, v, causal=causal, scale=scale)
+    if impl == "reference":
+        from ant_ray_tpu.parallel.ring import reference_attention  # noqa: PLC0415
+
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,136 @@
+"""Pallas TPU flash-attention forward kernel.
+
+Blocked online-softmax attention: grid (batch, heads, q_blocks, kv_blocks)
+with the KV dimension innermost, accumulators living in VMEM scratch across
+the KV sweep.  Q·Kᵀ and P·V land on the MXU in fp32 accumulation; the
+backward pass recomputes via the blockwise-JAX path (see ops/attention.py),
+so this kernel stays residual-free.
+
+GQA is handled in the BlockSpec index maps (KV head = q head // groups) —
+no materialized head repeat.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            scale: float, causal: bool, block_q: int, block_k: int,
+            num_kv_blocks: int):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: KV blocks strictly above the diagonal contribute nothing.
+    q_start = iq * block_q
+    k_start = ik * block_k
+    needed = (not causal) or (k_start <= q_start + block_q - 1)
+
+    @pl.when(needed)
+    def _attend():
+        # Keep matmul inputs in the native (bf16) dtype — the MXU runs at
+        # full rate with fp32 accumulation via preferred_element_type.
+        q = q_ref[0, 0]                                      # (BQ, D)
+        k = k_ref[0, 0]                                      # (BK, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale      # (BQ, BK)
+        if causal:
+            q_pos = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = k_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(k_pos > q_pos, NEG_INF, s)
+
+        m_prev = m_ref[:]                                    # (BQ, 128)
+        s_max = jnp.max(s, axis=-1, keepdims=True)           # (BQ, 1)
+        m_new = jnp.maximum(m_prev, jnp.broadcast_to(s_max, m_prev.shape))
+        p = jnp.exp(s - m_new[:, :1])                        # (BQ, BK)
+        corr = jnp.exp(m_prev - m_new)                       # (BQ, 128)
+        l_ref[:] = l_ref[:] * corr + jnp.broadcast_to(
+            jnp.sum(p, axis=-1, keepdims=True), corr.shape)
+        v = v_ref[0, 0]                                      # (BK, D)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)              # (BQ, D)
+        acc_ref[:] = acc_ref[:] * corr[:, :1] + pv
+        m_ref[:] = m_new
+
+    @pl.when(ik == num_kv_blocks - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"))
+def flash_attention_forward(q, k, v, *, causal: bool = True,
+                            scale: float | None = None,
+                            block_q: int = DEFAULT_BLOCK_Q,
+                            block_k: int = DEFAULT_BLOCK_K,
+                            interpret: bool | None = None):
+    """q: (batch, q_len, heads, dim); k/v: (batch, kv_len, kv_heads, dim).
+    Returns (batch, q_len, heads, dim) in q.dtype."""
+    batch, q_len, num_heads, head_dim = q.shape
+    kv_len, num_kv_heads = k.shape[1], k.shape[2]
+    groups = num_heads // num_kv_heads
+    scale_val = scale if scale is not None else head_dim ** -0.5
+    if q_len % block_q or kv_len % block_k:
+        raise ValueError(
+            f"sequence lengths ({q_len}, {kv_len}) must tile by "
+            f"({block_q}, {block_k})")
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    qt = q.transpose(0, 2, 1, 3)                             # (B,H,S,D)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+
+    num_q_blocks = q_len // block_q
+    num_kv_blocks = kv_len // block_k
+    grid = (batch, num_heads, num_q_blocks, num_kv_blocks)
+
+    kernel = functools.partial(
+        _kernel, scale=scale_val, causal=causal, block_q=block_q,
+        block_k=block_k, num_kv_blocks=num_kv_blocks)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, head_dim),
+                         lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, i, j, g=groups: (b, h // g, j, 0)),
+            pl.BlockSpec((1, 1, block_k, head_dim),
+                         lambda b, h, i, j, g=groups: (b, h // g, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, head_dim),
+                               lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, head_dim), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)

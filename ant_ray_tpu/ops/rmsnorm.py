@@ -1,0 +1,15 @@
+"""RMSNorm.  A plain jnp formulation — XLA fuses the reduction and scale
+into neighboring ops on TPU, so a hand kernel buys nothing here; the hot
+ops that do deserve Pallas live in ops/pallas/."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rmsnorm(x, weight, eps: float = 1e-5):
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    scale = jnp.reciprocal(
+        jnp.sqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + eps))
+    return (x32 * scale).astype(dtype) * weight

@@ -24,11 +24,9 @@ from ant_ray_tpu._private.jax_utils import import_jax
 
 
 def _shard_map():
-    try:
-        from jax.experimental.shard_map import shard_map  # noqa: PLC0415
-    except ImportError:  # moved in newer jax
-        from jax import shard_map  # noqa: PLC0415
-    return shard_map
+    from ant_ray_tpu._private.jax_utils import shard_map  # noqa: PLC0415
+
+    return shard_map()
 
 
 def ring_attention_kernel(q, k, v, *, axis_name: str, axis_size: int,
@@ -96,9 +94,11 @@ def ring_attention_kernel(q, k, v, *, axis_name: str, axis_size: int,
         v_next = lax.ppermute(v_cur, axis_name, perm)
         return (o_acc, l_acc, m_new, k_next, v_next), None
 
-    o0 = jnp.zeros((batch, q_len, num_heads, head_dim), jnp.float32)
-    l0 = jnp.zeros((batch, num_heads, q_len), jnp.float32)
-    m0 = jnp.full((batch, num_heads, q_len), -jnp.inf, jnp.float32)
+    # Derive accumulators from q so they carry q's varying-axes type under
+    # shard_map (plain zeros are "unvarying" and fail the scan carry check).
+    o0 = jnp.zeros_like(q32)
+    l0 = jnp.swapaxes(q32[..., 0] * 0.0, 1, 2)               # (b, h, q)
+    m0 = l0 - jnp.inf
     (o, l, _m, _k, _v), _ = lax.scan(
         attend_block, (o0, l0, m0, k, v), jnp.arange(axis_size))
 

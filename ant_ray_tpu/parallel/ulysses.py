@@ -19,11 +19,9 @@ from ant_ray_tpu.parallel.ring import reference_attention
 
 
 def _shard_map():
-    try:
-        from jax.experimental.shard_map import shard_map  # noqa: PLC0415
-    except ImportError:
-        from jax import shard_map  # noqa: PLC0415
-    return shard_map
+    from ant_ray_tpu._private.jax_utils import shard_map  # noqa: PLC0415
+
+    return shard_map()
 
 
 def ulysses_attention_kernel(q, k, v, *, axis_name: str, axis_size: int,

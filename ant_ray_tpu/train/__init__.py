@@ -1,0 +1,37 @@
+"""Distributed training (ref capability: ray.train v2 — JaxTrainer path)."""
+
+from ant_ray_tpu.train.checkpoint import Checkpoint, load_pytree, save_pytree
+from ant_ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    Result,
+    RunConfig,
+    ScalingConfig,
+)
+from ant_ray_tpu.train.session import (
+    get_checkpoint,
+    get_context,
+    get_world_rank,
+    get_world_size,
+    report,
+)
+from ant_ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TpuTrainer
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "DataParallelTrainer",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TpuTrainer",
+    "get_checkpoint",
+    "get_context",
+    "get_world_rank",
+    "get_world_size",
+    "load_pytree",
+    "report",
+    "save_pytree",
+]

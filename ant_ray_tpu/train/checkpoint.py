@@ -1,0 +1,79 @@
+"""Checkpoints: directory-backed handles + orbax pytree helpers + a top-K
+retention manager (ref: train/v2/_internal/execution/checkpoint/ +
+storage.py; orbax replaces torch.save as the native TPU path)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Checkpoint:
+    """A handle to a checkpoint directory (ref: ray.train.Checkpoint)."""
+
+    path: str
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path=os.path.abspath(path))
+
+    def as_directory(self) -> str:
+        return self.path
+
+    # ---- jax pytree convenience (orbax)
+
+    @classmethod
+    def from_pytree(cls, tree, path: str) -> "Checkpoint":
+        save_pytree(tree, path)
+        return cls(path=os.path.abspath(path))
+
+    def to_pytree(self, abstract_tree=None):
+        return load_pytree(self.path, abstract_tree)
+
+
+def save_pytree(tree, path: str) -> None:
+    import orbax.checkpoint as ocp  # noqa: PLC0415
+
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    with ocp.PyTreeCheckpointer() as ckptr:
+        ckptr.save(path, tree)
+
+
+def load_pytree(path: str, abstract_tree=None):
+    import orbax.checkpoint as ocp  # noqa: PLC0415
+
+    with ocp.PyTreeCheckpointer() as ckptr:
+        if abstract_tree is not None:
+            return ckptr.restore(os.path.abspath(path),
+                                 args=ocp.args.PyTreeRestore(abstract_tree))
+        return ckptr.restore(os.path.abspath(path))
+
+
+class CheckpointManager:
+    """Controller-side retention of reported checkpoints (top-K by
+    recency; ref: CheckpointManager keeps top-K)."""
+
+    def __init__(self, storage_path: str, num_to_keep: int | None = None):
+        self._storage_path = storage_path
+        self._num_to_keep = num_to_keep
+        self._checkpoints: list[Checkpoint] = []
+        os.makedirs(storage_path, exist_ok=True)
+
+    @property
+    def latest(self) -> Checkpoint | None:
+        return self._checkpoints[-1] if self._checkpoints else None
+
+    def register(self, checkpoint: Checkpoint) -> None:
+        self._checkpoints.append(checkpoint)
+        if self._num_to_keep is not None:
+            while len(self._checkpoints) > self._num_to_keep:
+                stale = self._checkpoints.pop(0)
+                if stale.path.startswith(self._storage_path):
+                    shutil.rmtree(stale.path, ignore_errors=True)
+
+    def next_checkpoint_dir(self, index: int) -> str:
+        return os.path.join(self._storage_path, f"checkpoint_{index:06d}")

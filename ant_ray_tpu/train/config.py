@@ -1,0 +1,72 @@
+"""Train configuration dataclasses (ref: train/v2/api/config.py —
+ScalingConfig TPU fields :73-74, RunConfig, FailureConfig)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each needs.
+
+    TPU-native fields mirror the reference's ScalingConfig(use_tpu=True,
+    topology="4x8"): one worker per TPU host in a slice, chips bound via
+    the TPU resource.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    topology: str = ""                  # e.g. "4x8" (whole-slice reservation)
+    chips_per_worker: int = 0           # TPU chips each worker binds (0=all)
+    resources_per_worker: dict = dataclasses.field(default_factory=dict)
+    placement_strategy: str = "PACK"
+
+    def worker_resources(self) -> dict:
+        res = dict(self.resources_per_worker)
+        if self.use_tpu and self.chips_per_worker:
+            res["TPU"] = float(self.chips_per_worker)
+        res.setdefault("CPU", 1.0)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0               # worker-group restarts allowed
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: int | None = None      # None = keep all
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: str = ""
+    storage_path: str = ""
+    failure_config: FailureConfig = dataclasses.field(
+        default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(
+        default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(
+            tempfile.gettempdir(), "art_train")
+        name = self.name or "run"
+        return os.path.join(base, name)
+
+
+@dataclasses.dataclass
+class Result:
+    """What fit() returns (ref: ray.train.Result)."""
+
+    metrics: dict
+    checkpoint: "object | None"
+    error: Exception | None
+    path: str
+
+    @property
+    def best_checkpoint(self):
+        return self.checkpoint

@@ -1,0 +1,191 @@
+"""TrainController + worker group — the driving actors of a training run
+(ref: train/v2/_internal/execution/controller/controller.py:101 control
+loop :505-527, worker_group.py:269,376-391).
+
+The controller is an actor (max_concurrency > 1 so workers can report
+while the control loop blocks), the worker group is one actor per rank.
+Failure handling: a dead worker fails the epoch; the controller restarts
+the whole group up to FailureConfig.max_failures, handing the latest
+checkpoint to the restarted loop (elastic recovery — ref:
+failure_handling/).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ant_ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ant_ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
+from ant_ray_tpu.train.session import TrainContext, _set_context
+
+logger = logging.getLogger(__name__)
+
+
+class TrainWorker:
+    """One rank of the worker group (actor)."""
+
+    def __init__(self, rank: int, world_size: int, storage_path: str,
+                 experiment_name: str, use_tpu: bool):
+        self._rank = rank
+        self._world_size = world_size
+        self._storage_path = storage_path
+        self._experiment_name = experiment_name
+        self._use_tpu = use_tpu
+
+    def propose_coordinator(self) -> str:
+        """Rank 0 advertises host:port for the jax.distributed
+        coordination service (ref: rank-0 address broadcast,
+        train/v2/jax/config.py:103)."""
+        import socket  # noqa: PLC0415
+
+        from ant_ray_tpu._private.protocol import find_free_port  # noqa: PLC0415
+
+        try:
+            host = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            host = "127.0.0.1"
+        return f"{host}:{find_free_port()}"
+
+    def setup_distributed(self, coordinator: str | None) -> bool:
+        """jax.distributed rendezvous for multi-host slices (ref:
+        train/v2/jax/config.py:30,73).  Degrades gracefully where the
+        coordination service is unavailable (single-host)."""
+        if not self._use_tpu or self._world_size == 1 or coordinator is None:
+            return False
+        try:
+            from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
+
+            jax = import_jax()
+            jax.distributed.initialize(
+                coordinator, num_processes=self._world_size,
+                process_id=self._rank)
+            return jax.process_count() == self._world_size
+        except Exception as e:  # noqa: BLE001
+            logger.warning("jax.distributed init failed (%s); continuing "
+                           "single-process", e)
+            return False
+
+    def run(self, loop_fn, loop_config, controller, latest_checkpoint):
+        ctx = TrainContext(
+            world_rank=self._rank,
+            world_size=self._world_size,
+            local_rank=0,
+            experiment_name=self._experiment_name,
+            storage_path=self._storage_path,
+            controller=controller,
+            latest_checkpoint=latest_checkpoint,
+        )
+        _set_context(ctx)
+        try:
+            if loop_config is None:
+                return loop_fn()
+            return loop_fn(loop_config)
+        finally:
+            _set_context(None)  # type: ignore[arg-type]
+
+    def ping(self):
+        return "pong"
+
+
+class TrainController:
+    """Detached driving actor of one training run."""
+
+    def __init__(self, loop_fn, loop_config, scaling: ScalingConfig,
+                 run_config: RunConfig):
+        self._loop_fn = loop_fn
+        self._loop_config = loop_config
+        self._scaling = scaling
+        self._run_config = run_config
+        self._storage_path = run_config.resolved_storage_path()
+        self._ckpt_manager = CheckpointManager(
+            self._storage_path, run_config.checkpoint_config.num_to_keep)
+        self._metrics_history: list[dict] = []
+        self._latest_metrics: dict = {}
+        self._report_index = 0
+        self._lock = threading.Lock()
+
+    # ---- called by workers (concurrently with run())
+
+    def report_from_worker(self, rank: int, metrics: dict, checkpoint):
+        with self._lock:
+            if rank == 0:
+                self._latest_metrics = metrics
+                self._metrics_history.append(metrics)
+                if checkpoint is not None:
+                    if not isinstance(checkpoint, Checkpoint):
+                        checkpoint = Checkpoint.from_pytree(
+                            checkpoint,
+                            self._ckpt_manager.next_checkpoint_dir(
+                                self._report_index))
+                    self._ckpt_manager.register(checkpoint)
+                self._report_index += 1
+        return True
+
+    def get_metrics_history(self):
+        with self._lock:
+            return list(self._metrics_history)
+
+    # ---- control loop
+
+    def run(self, self_handle):
+        import ant_ray_tpu as art  # noqa: PLC0415
+
+        failure_config: FailureConfig = self._run_config.failure_config
+        attempts = failure_config.max_failures + 1
+        last_error: Exception | None = None
+        for attempt in range(attempts):
+            try:
+                self._run_worker_group(art, self_handle)
+                return self._result(error=None)
+            except art.exceptions.ArtError as e:
+                last_error = e
+                logger.warning("worker group failed (attempt %d/%d): %s",
+                               attempt + 1, attempts, e)
+                time.sleep(0.5)
+        return self._result(error=last_error)
+
+    def _run_worker_group(self, art, self_handle):
+        from ant_ray_tpu.api import remote  # noqa: PLC0415
+
+        scaling = self._scaling
+        worker_cls = remote(TrainWorker).options(
+            **{"resources": scaling.worker_resources(),
+               "num_cpus": 0})
+        workers = [
+            worker_cls.remote(rank, scaling.num_workers,
+                              self._storage_path,
+                              self._run_config.name or "run",
+                              scaling.use_tpu)
+            for rank in range(scaling.num_workers)
+        ]
+        # Rendezvous: rank 0's host coordinates (multi-host slices).
+        coordinator = None
+        if scaling.use_tpu and scaling.num_workers > 1:
+            coordinator = art.get(workers[0].propose_coordinator.remote())
+        art.get([w.setup_distributed.remote(coordinator) for w in workers])
+        latest = self._ckpt_manager.latest
+        run_refs = [
+            w.run.remote(self._loop_fn, self._loop_config, self_handle,
+                         latest)
+            for w in workers
+        ]
+        try:
+            art.get(run_refs)
+        finally:
+            for w in workers:
+                try:
+                    art.kill(w)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _result(self, error):
+        from ant_ray_tpu.train.config import Result  # noqa: PLC0415
+
+        return Result(
+            metrics=dict(self._latest_metrics),
+            checkpoint=self._ckpt_manager.latest,
+            error=error,
+            path=self._storage_path,
+        )

@@ -1,0 +1,62 @@
+"""In-worker training session: report() / get_context() / get_checkpoint()
+(ref: train/v2/_internal/execution/train_fn_utils.py + session semantics)."""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any
+
+
+@dataclass
+class TrainContext:
+    world_rank: int = 0
+    world_size: int = 1
+    local_rank: int = 0
+    node_rank: int = 0
+    experiment_name: str = ""
+    storage_path: str = ""
+    controller: Any = None              # ActorHandle of the controller
+    latest_checkpoint: Any = None
+    _report_lock: threading.Lock = field(default_factory=threading.Lock)
+
+
+_ctx = threading.local()
+
+
+def _set_context(ctx: TrainContext) -> None:
+    _ctx.value = ctx
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_ctx, "value", None)
+    if ctx is None:
+        raise RuntimeError(
+            "No training context: this API is only available inside a "
+            "train_loop_per_worker")
+    return ctx
+
+
+def report(metrics: dict, checkpoint=None) -> None:
+    """Report metrics (and optionally a checkpoint) to the controller
+    (ref: ray.train.report).  Blocks until the controller acknowledged, so
+    checkpoint ordering is deterministic."""
+    import ant_ray_tpu as art  # noqa: PLC0415
+
+    ctx = get_context()
+    with ctx._report_lock:
+        art.get(ctx.controller.report_from_worker.remote(
+            ctx.world_rank, dict(metrics), checkpoint))
+
+
+def get_checkpoint():
+    """Latest checkpoint to resume from (set on restore/restart)."""
+    return get_context().latest_checkpoint
+
+
+def get_world_rank() -> int:
+    return get_context().world_rank
+
+
+def get_world_size() -> int:
+    return get_context().world_size

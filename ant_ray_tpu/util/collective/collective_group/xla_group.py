@@ -43,11 +43,9 @@ def _jax():
 
 
 def _shard_map():
-    try:
-        from jax.experimental.shard_map import shard_map  # noqa: PLC0415
-    except ImportError:  # moved in newer jax
-        from jax import shard_map  # noqa: PLC0415
-    return shard_map
+    from ant_ray_tpu._private.jax_utils import shard_map  # noqa: PLC0415
+
+    return shard_map()
 
 
 class XLAGroup(BaseGroup):

@@ -1,0 +1,78 @@
+"""Cluster state inspection API (ref: python/ray/util/state — list/get/
+summarize entities served from GCS tables)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+def _gcs():
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    global_worker._check_connected()
+    return global_worker.runtime._gcs
+
+
+@dataclass
+class NodeState:
+    node_id: str
+    address: str
+    alive: bool
+    total_resources: dict
+    available_resources: dict
+    labels: dict
+
+
+@dataclass
+class ActorState:
+    actor_id: str
+    class_name: str
+    state: str
+    address: str
+    name: str
+    death_reason: str
+
+
+def list_nodes() -> list[NodeState]:
+    nodes = _gcs().call("GetAllNodes", retries=3)
+    return [
+        NodeState(
+            node_id=info.node_id.hex(),
+            address=info.address,
+            alive=info.alive,
+            total_resources=info.total_resources,
+            available_resources=info.available_resources,
+            labels=info.labels,
+        )
+        for info in nodes.values()
+    ]
+
+
+def list_actors() -> list[ActorState]:
+    records = _gcs().call("ListActors", retries=3)
+    return [ActorState(**r) for r in records]
+
+
+def list_placement_groups() -> dict:
+    return _gcs().call("ListPlacementGroups", retries=3)
+
+
+def list_objects() -> list[dict]:
+    """Objects known to the cluster object directory (plasma tier)."""
+    return _gcs().call("ListObjects", retries=3)
+
+
+def summarize_cluster() -> dict:
+    nodes = list_nodes()
+    actors = list_actors()
+    return {
+        "nodes": {"alive": sum(n.alive for n in nodes),
+                  "dead": sum(not n.alive for n in nodes)},
+        "actors": {
+            state: sum(1 for a in actors if a.state == state)
+            for state in {a.state for a in actors}
+        },
+        "resources_total": _gcs().call("ClusterResources", retries=3),
+        "resources_available": _gcs().call("AvailableResources",
+                                           retries=3),
+    }

@@ -1,0 +1,99 @@
+"""Benchmark: Llama training-step MFU on the local accelerator.
+
+Measures a full jitted train step (loss + grad + adam) on the largest
+Llama-family config that fits the chip, and reports MFU against the
+north-star baseline (BASELINE.md: Llama-3-8B ≥ 40% MFU on v5e — here
+normalized per-chip: achieved_flops / peak_bf16_flops, vs_baseline =
+mfu / 0.40).
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run_bench():
+    from ant_ray_tpu._private.accelerators import tpu as tpu_accel
+    from ant_ray_tpu._private.jax_utils import import_jax
+    from ant_ray_tpu.models import llama
+
+    jax = import_jax()
+    import jax.numpy as jnp
+    import optax
+
+    backend = jax.default_backend()
+    on_tpu = backend in ("tpu", "axon")
+
+    if on_tpu:
+        config = llama.CONFIGS["llama-400m"]
+        batch, seq = 8, 2048
+        peak_flops = tpu_accel.peak_bf16_tflops("v5e") * 1e12
+        metric = "llama400m_train_mfu_v5e_1chip"
+    else:  # CI / no-accelerator fallback: tiny config, nominal peak
+        config = llama.CONFIGS["tiny"]
+        batch, seq = 2, 256
+        peak_flops = 1e12
+        metric = "llama_tiny_train_flops_cpu"
+
+    params = llama.init_params(config, jax.random.PRNGKey(0))
+    opt = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = opt.init(params)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(
+            0, config.vocab_size, (batch, seq + 1)), jnp.int32)
+
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(llama.loss_fn)(
+            params, {"tokens": tokens}, config)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    # Warmup (compile) + timed steps.  Sync via a value fetch — on some
+    # remote-tunnel platforms block_until_ready() returns before the
+    # computation actually ran.
+    for _ in range(3):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+
+    n_steps = 10
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        params, opt_state, loss = step(params, opt_state, tokens)
+    float(loss)
+    elapsed = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    steps_per_s = n_steps / elapsed
+    tokens_per_s = tokens_per_step * steps_per_s
+    achieved = tokens_per_s * llama.flops_per_token(config, seq)
+    mfu = achieved / peak_flops
+
+    return {
+        "metric": metric,
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "tokens_per_s": round(tokens_per_s, 1),
+        "step_time_ms": round(1000 * elapsed / n_steps, 2),
+        "loss": round(float(loss), 4),
+        "backend": backend,
+    }
+
+
+if __name__ == "__main__":
+    try:
+        result = run_bench()
+    except Exception as e:  # noqa: BLE001 — bench must always emit a line
+        result = {"metric": "bench_error", "value": 0.0, "unit": "MFU",
+                  "vs_baseline": 0.0, "error": repr(e)[:200]}
+    print(json.dumps(result))
+    sys.exit(0)

@@ -1,0 +1,90 @@
+"""Data layer tests (ref test model: python/ray/data/tests)."""
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu import data
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    art.init(num_cpus=4, num_tpus=0)
+    yield None
+    art.shutdown()
+
+
+def test_from_items_and_count(cluster):
+    ds = data.from_items(list(range(100)), parallelism=4)
+    assert ds.num_blocks == 4
+    assert ds.count() == 100
+
+
+def test_map_filter_chain(cluster):
+    ds = data.range(50).map(lambda x: x * 2).filter(lambda x: x % 10 == 0)
+    out = sorted(ds.take_all())
+    assert out == [0, 10, 20, 30, 40, 50, 60, 70, 80, 90]
+
+
+def test_flat_map(cluster):
+    ds = data.from_items([1, 2, 3]).flat_map(lambda x: [x] * x)
+    assert sorted(ds.take_all()) == [1, 2, 2, 3, 3, 3]
+
+
+def test_map_batches(cluster):
+    ds = data.range(32, parallelism=2).map_batches(
+        lambda batch: [sum(batch)], batch_size=8)
+    out = ds.take_all()
+    assert sum(out) == sum(range(32))
+    assert len(out) == 4  # 32 items / 8 per batch
+
+
+def test_iter_batches_streaming(cluster):
+    ds = data.range(100, parallelism=10).map(lambda x: x + 1)
+    batches = list(ds.iter_batches(batch_size=30))
+    assert sorted(x for b in batches for x in b) == list(range(1, 101))
+    assert max(len(b) for b in batches) == 30
+
+
+def test_take(cluster):
+    assert len(data.range(1000).take(5)) == 5
+
+
+def test_split_for_workers(cluster):
+    shards = data.range(100, parallelism=8).split(4)
+    assert len(shards) == 4
+    total = sorted(x for s in shards for x in s.take_all())
+    assert total == list(range(100))
+
+
+def test_random_shuffle(cluster):
+    base = list(range(64))
+    shuffled = data.from_items(base).random_shuffle(seed=42).take_all()
+    assert sorted(shuffled) == base
+    assert shuffled != base
+
+
+def test_materialize_executes_once(cluster):
+    ds = data.range(16, parallelism=2).map(lambda x: x * 3).materialize()
+    assert ds._transforms == ()
+    assert sorted(ds.take_all()) == [x * 3 for x in range(16)]
+
+
+def test_state_api(cluster):
+    from ant_ray_tpu.util import state
+
+    @art.remote
+    class Visible:
+        def ping(self):
+            return 1
+
+    a = Visible.options(name="vis").remote()
+    art.get(a.ping.remote())
+
+    nodes = state.list_nodes()
+    assert len(nodes) == 1 and nodes[0].alive
+    actors = state.list_actors()
+    assert any(s.class_name == "Visible" and s.state == "ALIVE"
+               for s in actors)
+    summary = state.summarize_cluster()
+    assert summary["nodes"]["alive"] == 1
+    assert "CPU" in summary["resources_total"]

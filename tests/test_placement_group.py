@@ -1,0 +1,134 @@
+"""Placement group tests (ref test model: test_placement_group*.py)."""
+
+import os
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu.cluster_utils import Cluster
+from ant_ray_tpu.util.placement_group import (
+    placement_group,
+    placement_group_table,
+    remove_placement_group,
+)
+
+
+@pytest.fixture
+def three_nodes():
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    yield cluster
+    art.shutdown()
+    cluster.shutdown()
+
+
+def test_strict_spread_places_on_distinct_nodes(three_nodes):
+    pg = placement_group([{"CPU": 1}] * 3, strategy="STRICT_SPREAD")
+    assert pg.ready(timeout=30)
+
+    @art.remote(num_cpus=1)
+    def where():
+        return os.environ["ART_NODE_ID"]
+
+    locations = art.get([
+        where.options(placement_group=pg,
+                      placement_group_bundle_index=i).remote()
+        for i in range(3)
+    ])
+    assert len(set(locations)) == 3
+
+
+def test_strict_pack_places_on_one_node(three_nodes):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=30)
+
+    @art.remote(num_cpus=1)
+    def where():
+        return os.environ["ART_NODE_ID"]
+
+    locations = art.get([
+        where.options(placement_group=pg,
+                      placement_group_bundle_index=i).remote()
+        for i in range(2)
+    ])
+    assert len(set(locations)) == 1
+
+
+def test_infeasible_strict_spread_fails(three_nodes):
+    pg = placement_group([{"CPU": 1}] * 5, strategy="STRICT_SPREAD")
+    with pytest.raises(RuntimeError, match="STRICT_SPREAD"):
+        pg.ready(timeout=30)
+
+
+def test_remove_placement_group_frees_resources(three_nodes):
+    # Reserve the whole cluster, then free it and check tasks run again.
+    pg = placement_group([{"CPU": 2}] * 3, strategy="SPREAD")
+    assert pg.ready(timeout=30)
+
+    remove_placement_group(pg)
+
+    @art.remote(num_cpus=2)
+    def heavy():
+        return 1
+
+    assert art.get([heavy.remote() for _ in range(3)]) == [1, 1, 1]
+
+
+def test_actor_in_placement_group(three_nodes):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @art.remote(num_cpus=1)
+    class Pinned:
+        def where(self):
+            return os.environ["ART_NODE_ID"]
+
+    a = Pinned.options(placement_group=pg,
+                       placement_group_bundle_index=0).remote()
+    node = art.get(a.where.remote())
+    assert pg.bundle_node(0) is not None
+    assert node
+
+
+def test_pg_table(three_nodes):
+    pg = placement_group([{"CPU": 1}], strategy="PACK", name="mypg")
+    assert pg.ready(timeout=30)
+    table = placement_group_table()
+    assert any(entry["name"] == "mypg" and entry["state"] == "CREATED"
+               for entry in table.values())
+
+
+def test_invalid_strategy():
+    with pytest.raises(ValueError, match="strategy"):
+        placement_group([{"CPU": 1}], strategy="BOGUS")
+
+
+def test_oversized_demand_vs_bundle_errors(three_nodes):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @art.remote(num_cpus=2)
+    def too_big():
+        return 1
+
+    ref = too_big.options(placement_group=pg,
+                          placement_group_bundle_index=0).remote()
+    with pytest.raises(art.exceptions.ArtError):
+        art.get(ref, timeout=30)
+
+
+def test_bundle_index_out_of_range(three_nodes):
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=30)
+
+    @art.remote(num_cpus=1)
+    def f():
+        return 1
+
+    ref = f.options(placement_group=pg,
+                    placement_group_bundle_index=5).remote()
+    with pytest.raises(art.exceptions.ArtError, match="out of range"):
+        art.get(ref, timeout=30)
