@@ -42,6 +42,12 @@ class Config:
     object_store_memory: int = 0
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_size: int = 8 * 1024 * 1024
+    # An unsealed arena grant younger than this is presumed live (its
+    # producer is still writing); only older grants are reclaimed.
+    unsealed_grant_ttl_s: float = 30.0
+    # Arena read pins auto-expire after this long if the reader never
+    # sends ReadDone (crashed client), so the slot becomes evictable.
+    read_pin_ttl_s: float = 120.0
     # LRU-evict unpinned objects when the store is this full.
     object_store_high_watermark: float = 0.8
 

@@ -17,6 +17,7 @@ import hashlib
 import logging
 import os
 import threading
+import time
 import uuid
 from collections import deque
 from dataclasses import dataclass, field
@@ -35,7 +36,7 @@ from ant_ray_tpu._private.ids import (
     WorkerID,
 )
 from ant_ray_tpu._private.memory_store import MemoryStore
-from ant_ray_tpu._private.object_store import open_object
+from ant_ray_tpu._private.object_store import ArenaClient, open_object
 from ant_ray_tpu._private.protocol import (
     ClientPool,
     IoThread,
@@ -115,6 +116,7 @@ class ClusterRuntime(CoreRuntime):
         self._actor_states: dict[ActorID, _ActorSubmitState] = {}
         self._actor_meta_cache: dict[ActorID, dict] = {}
         self._pg_bundle_cache: dict = {}  # pg_id -> [node addresses]
+        self._arena_client = ArenaClient()
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
         self._shutdown = False
@@ -308,6 +310,31 @@ class ClusterRuntime(CoreRuntime):
         return self.put_serialized(serialization.serialize(value))
 
     def _write_plasma(self, oid: ObjectID, payload: bytes):
+        """Zero-copy produce: grant a write window in the node's arena,
+        write, seal (plasma create→seal; falls back to a tmp file when
+        the native arena is unavailable)."""
+        deadline = time.monotonic() + 60
+        while True:
+            grant = self._node.call("CreateBuffer",
+                                    {"object_id": oid, "size": len(payload)},
+                                    timeout=60)
+            if grant.get("offset") is not None:
+                view = self._arena_client.view(grant["path"], grant["offset"],
+                                               len(payload))
+                view[:] = payload
+                self._node.call("SealBuffer", {"object_id": oid}, timeout=60)
+                return
+            if grant.get("exists"):
+                return  # idempotent re-put
+            if grant.get("busy"):
+                # Another producer/pull holds a live grant for this id —
+                # it will seal the identical payload; wait for it.
+                if time.monotonic() >= deadline:
+                    raise exceptions.ObjectLostError(
+                        oid, "timed out waiting on a concurrent producer")
+                time.sleep(0.02)
+                continue
+            break
         tmp = os.path.join(self.store_dir,
                            f"{oid.hex()}.tmp.{uuid.uuid4().hex[:8]}")
         with open(tmp, "wb") as f:
@@ -337,7 +364,8 @@ class ClusterRuntime(CoreRuntime):
         ser = serialization.SerializedObject.from_payload(payload)
         return serialization.deserialize(ser)
 
-    async def _fetch_plasma(self, oid: ObjectID, timeout: float | None):
+    async def _fetch_plasma(self, oid: ObjectID,
+                            timeout: float | None) -> memoryview:
         reply = await self._node.call_async(
             "EnsureLocal",
             {"object_id": oid, "timeout": timeout if timeout else 60.0},
@@ -345,7 +373,21 @@ class ClusterRuntime(CoreRuntime):
         if reply.get("timeout"):
             raise exceptions.GetTimeoutError(
                 f"object {oid.hex()[:12]} not available in time")
-        return reply["path"]
+        if reply.get("offset") is not None:
+            # The daemon pinned the entry for us; copy out and release.
+            # One copy is deliberate: arena slots are recycled after
+            # eviction, so zero-copy views could not outlive the pin —
+            # deserialization then builds arrays over the owned bytes
+            # without further copies.
+            try:
+                view = self._arena_client.view(
+                    reply["path"], reply["offset"], reply["size"])
+                return memoryview(bytes(view))
+            finally:
+                if reply.get("pinned"):
+                    await self._node.oneway_async(
+                        "ReadDone", {"object_id": oid})
+        return open_object(reply["path"])
 
     async def _get_one(self, ref: ObjectRef, timeout: float | None):
         """Resolve one ref to (kind, data): kind ∈ value|error."""
@@ -368,8 +410,7 @@ class ClusterRuntime(CoreRuntime):
                 raise exceptions.ObjectLostError(
                     oid, f"owner {ref.owner_address} does not know this object")
         if kind == "plasma":
-            path = await self._fetch_plasma(oid, timeout)
-            view = open_object(path)
+            view = await self._fetch_plasma(oid, timeout)
             return ("value", self._deserialize_payload(view))
         if kind == "inline":
             return ("value", self._deserialize_payload(value))

@@ -20,7 +20,11 @@ def import_jax():
     import jax  # noqa: PLC0415
 
     if not _configured:
-        platform = os.environ.get("ART_JAX_PLATFORM")
+        # JAX_PLATFORMS alone is not reliable here: a site plugin (e.g.
+        # the axon TPU tunnel) can still initialize eagerly and stall for
+        # minutes when the tunnel is down; the config-level update is.
+        platform = (os.environ.get("ART_JAX_PLATFORM")
+                    or os.environ.get("JAX_PLATFORMS"))
         if platform:
             try:
                 jax.config.update("jax_platforms", platform)

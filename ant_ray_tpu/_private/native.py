@@ -1,0 +1,81 @@
+"""Loader for the native extension (native/store_core.cpp).
+
+Builds art_native on demand with the system toolchain into a per-user
+cache directory; falls back cleanly (returns None) where no compiler is
+available so the pure-Python paths keep working.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import importlib.util
+import logging
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+
+logger = logging.getLogger(__name__)
+
+_lock = threading.Lock()
+_module = None
+_attempted = False
+
+
+def _source_path() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native",
+        "store_core.cpp")
+
+
+def _build_dir() -> str:
+    src = _source_path()
+    with open(src, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    d = os.path.join(os.path.expanduser("~"), ".cache", "art_native",
+                     f"{digest}-py{sys.version_info[0]}{sys.version_info[1]}")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load_native():
+    """The art_native module, building it if needed; None if unavailable."""
+    global _module, _attempted
+    with _lock:
+        if _module is not None or _attempted:
+            return _module
+        _attempted = True
+        src = _source_path()
+        if not os.path.exists(src):
+            return None
+        build_dir = _build_dir()
+        so_path = os.path.join(build_dir, "art_native.so")
+        if not os.path.exists(so_path):
+            include = sysconfig.get_path("include")
+            # Per-process temp name: concurrent daemon startups may race
+            # to build; each compiles privately, rename is atomic.
+            tmp_path = f"{so_path}.tmp.{os.getpid()}"
+            cmd = [
+                "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+                f"-I{include}", src, "-o", tmp_path,
+            ]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+                os.rename(tmp_path, so_path)
+            except (subprocess.CalledProcessError, OSError,
+                    subprocess.TimeoutExpired) as e:
+                stderr = getattr(e, "stderr", b"")
+                logger.warning("art_native build failed: %s %s", e,
+                               stderr.decode()[:500] if stderr else "")
+                return None
+        spec = importlib.util.spec_from_file_location("art_native", so_path)
+        module = importlib.util.module_from_spec(spec)
+        try:
+            spec.loader.exec_module(module)
+        except Exception as e:  # noqa: BLE001
+            logger.warning("art_native load failed: %s", e)
+            return None
+        _module = module
+        return _module

@@ -72,6 +72,9 @@ class NodeManager:
             cfg.max_workers_per_node or max(1, int(resources.get("CPU", 1))))
         self._tasks: list = []
         self._stopping = False
+        # object_id -> sorted lease-expiry times, one per outstanding
+        # arena read pin (see _locate_pinned / _reap_expired_pins).
+        self._pin_leases: dict[ObjectID, list[float]] = {}
         self.address = ""
 
     # ------------------------------------------------------------ lifecycle
@@ -89,7 +92,11 @@ class NodeManager:
             "CommitBundle": self._commit_bundle,
             "ReturnBundle": self._return_bundle,
             "SealObject": self._seal_object,
+            "CreateBuffer": self._create_buffer,
+            "SealBuffer": self._seal_buffer,
+            "LocateObject": self._locate_object,
             "EnsureLocal": self._ensure_local,
+            "ReadDone": self._read_done,
             "ReadChunk": self._read_chunk,
             "DeleteObject": self._delete_object,
             "ContainsObject": self._contains_object,
@@ -145,17 +152,29 @@ class NodeManager:
                     await self._register()
             except Exception as e:  # noqa: BLE001 — head may be restarting
                 logger.debug("heartbeat failed: %s", e)
+            self._reap_expired_pins()
             await asyncio.sleep(period)
 
     def stop(self):
         self._stopping = True
         for t in self._tasks:
             t.cancel()
-        for handle in list(self._workers.values()):
-            self._terminate_worker(handle)
-        self._server.stop()
-        self._clients.close_all()
+        # Destroy the store first: everything after can take seconds and
+        # the parent's kill-grace window is short — tmpfs cleanup must
+        # never lose the race.
         self.store.destroy()
+        self._server.stop()
+        for handle in list(self._workers.values()):
+            if handle.proc.poll() is None:
+                handle.proc.terminate()
+        deadline = time.monotonic() + 3
+        for handle in list(self._workers.values()):
+            remaining = max(0.05, deadline - time.monotonic())
+            try:
+                handle.proc.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                handle.proc.kill()
+        self._clients.close_all()
 
     async def _shutdown_rpc(self, _payload):
         asyncio.get_running_loop().call_later(0.05, self.stop)
@@ -509,6 +528,81 @@ class NodeManager:
             "object_id": object_id, "node_id": self.node_id}, timeout=10)
         return {"path": final}
 
+    async def _create_buffer(self, payload):
+        """Grant a colocated producer a write window in the arena
+        (plasma create→seal protocol; ref: CreateRequestQueue)."""
+        from ant_ray_tpu._private.object_store import BufferExistsError  # noqa: PLC0415
+
+        if not self.store.uses_arena:
+            return {"unsupported": True}
+        object_id = payload["object_id"]
+        try:
+            offset = self.store.create_buffer(object_id, payload["size"])
+        except BufferExistsError as e:
+            if e.sealed:
+                return {"exists": True}
+            # An unsealed grant may belong to a live producer (or to our
+            # own in-flight pull) still writing through its view — only
+            # reclaim it once it has gone stale (crashed producer).
+            ttl = global_config().unsealed_grant_ttl_s
+            if self.store.grant_age(object_id) < ttl:
+                return {"busy": True}
+            self.store.abort_buffer(object_id)
+            try:
+                offset = self.store.create_buffer(object_id,
+                                                  payload["size"])
+            except BufferExistsError as e2:
+                return {"exists": True} if e2.sealed else {"busy": True}
+        return {"path": self.store.arena_path,
+                "offset": self.store.arena_file_offset(offset)}
+
+    async def _seal_buffer(self, payload):
+        object_id = payload["object_id"]
+        self.store.seal_buffer(object_id)
+        gcs = self._clients.get(self._gcs_address)
+        await gcs.call_async("ObjectLocationAdd", {
+            "object_id": object_id, "node_id": self.node_id}, timeout=10)
+        return True
+
+    def _locate_pinned(self, object_id: ObjectID) -> dict | None:
+        """Locate for a reader, pinning arena entries until the client's
+        ReadDone — eviction reuses arena slots, so an unpinned window
+        could be recycled mid-copy.  Each pin carries a lease so a
+        reader that dies before ReadDone can't wedge the slot forever
+        (the heartbeat loop reaps expired leases)."""
+        located = self.store.locate(object_id)
+        if located is not None and located["offset"] is not None:
+            self.store.pin(object_id)
+            self._pin_leases.setdefault(object_id, []).append(
+                time.monotonic() + global_config().read_pin_ttl_s)
+            located["pinned"] = True
+        return located
+
+    async def _read_done(self, payload):
+        object_id = payload["object_id"]
+        leases = self._pin_leases.get(object_id)
+        if leases:
+            leases.pop(0)
+            if not leases:
+                self._pin_leases.pop(object_id, None)
+            self.store.unpin(object_id)
+        return True
+
+    def _reap_expired_pins(self):
+        now = time.monotonic()
+        for object_id in list(self._pin_leases):
+            leases = self._pin_leases[object_id]
+            while leases and leases[0] < now:
+                leases.pop(0)
+                self.store.unpin(object_id)
+                logger.warning("read pin on %s expired without ReadDone",
+                               object_id.hex()[:8])
+            if not leases:
+                self._pin_leases.pop(object_id, None)
+
+    async def _locate_object(self, payload):
+        return self.store.locate(payload["object_id"])
+
     async def _contains_object(self, payload):
         return self.store.contains(payload["object_id"])
 
@@ -517,40 +611,95 @@ class NodeManager:
         (ref: PullManager, src/ray/object_manager/pull_manager.h:50)."""
         object_id: ObjectID = payload["object_id"]
         deadline = time.monotonic() + payload.get("timeout", 60.0)
-        if self.store.contains(object_id):
-            self.store.touch(object_id)
-            return {"path": self.store.path_of(object_id)}
+        located = self._locate_pinned(object_id)
+        if located is not None:
+            return located
         gcs = self._clients.get(self._gcs_address)
         chunk = global_config().object_transfer_chunk_size
         while time.monotonic() < deadline:
+            # A colocated producer (or a concurrent EnsureLocal) may have
+            # sealed the object since the last iteration.
+            located = self._locate_pinned(object_id)
+            if located is not None:
+                return located
             holders: list[NodeInfo] = await gcs.call_async(
                 "ObjectLocationsGet", {"object_id": object_id}, timeout=10)
             holders = [h for h in holders if h.node_id != self.node_id]
             for holder in holders:
                 try:
                     remote = self._clients.get(holder.address)
-                    tmp = self.store.path_of(object_id) + ".pull"
-                    offset = 0
-                    with open(tmp, "wb") as f:
-                        while True:
-                            data = await remote.call_async("ReadChunk", {
-                                "object_id": object_id,
-                                "offset": offset, "length": chunk,
-                            }, timeout=60)
-                            if not data:
-                                break
-                            f.write(data)
-                            offset += len(data)
-                            if len(data) < chunk:
-                                break
-                    await self._seal_object(
-                        {"object_id": object_id, "tmp_path": tmp})
-                    return {"path": self.store.path_of(object_id)}
+                    await self._pull_from(remote, object_id, chunk)
+                    located = self._locate_pinned(object_id)
+                    if located is not None:
+                        await gcs.call_async("ObjectLocationAdd", {
+                            "object_id": object_id,
+                            "node_id": self.node_id}, timeout=10)
+                        return located
                 except Exception as e:  # noqa: BLE001 — try next holder
                     logger.debug("pull of %s from %s failed: %s",
                                  object_id.hex()[:8], holder.address, e)
             await asyncio.sleep(0.05)
         return {"timeout": True}
+
+    async def _pull_from(self, remote, object_id: ObjectID, chunk: int):
+        """Chunked pull from a holding node into the local store
+        (ref: ObjectManager push/pull, push_manager.h:28)."""
+        info = await remote.call_async(
+            "LocateObject", {"object_id": object_id}, timeout=10)
+        if info is None:
+            raise RuntimeError("holder no longer has the object")
+        size = info["size"]
+
+        async def fetch_into(write):
+            pos = 0
+            while pos < size:
+                data = await remote.call_async("ReadChunk", {
+                    "object_id": object_id, "offset": pos,
+                    "length": min(chunk, size - pos)}, timeout=60)
+                if not data:
+                    raise RuntimeError(
+                        f"short read at {pos}/{size} from holder")
+                write(pos, data)
+                pos += len(data)
+
+        if self.store.uses_arena:
+            from ant_ray_tpu._private.object_store import BufferExistsError  # noqa: PLC0415
+
+            try:
+                self.store.create_buffer(object_id, size)
+            except BufferExistsError as e:
+                if e.sealed:
+                    return  # already local — nothing to pull
+                # Another coroutine's pull (or a local producer) owns the
+                # grant; let the caller's retry loop re-check presence.
+                raise RuntimeError(
+                    "concurrent write in progress for this object") from e
+            try:
+                view = self.store.view_unsealed(object_id)
+
+                def write(pos, data):
+                    view[pos:pos + len(data)] = data
+
+                await fetch_into(write)
+            except BaseException:
+                # Includes CancelledError at shutdown: never leave a
+                # wedged half-written grant (we created it above, so it
+                # is ours to abort).
+                self.store.abort_buffer(object_id)
+                raise
+            self.store.seal_buffer(object_id)
+            return
+        tmp = self.store.path_of(object_id) + ".pull"
+        try:
+            with open(tmp, "wb") as f:
+                await fetch_into(lambda _pos, data: f.write(data))
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except FileNotFoundError:
+                pass
+            raise
+        self.store.seal_file(object_id, tmp)
 
     async def _read_chunk(self, payload):
         return self.store.read_chunk(

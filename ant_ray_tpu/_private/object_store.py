@@ -37,19 +37,131 @@ class ObjectEntry:
     size: int
     pin_count: int = 0
     sealed: bool = False
+    offset: int | None = None       # arena payload offset (native mode)
     created_at: float = field(default_factory=time.monotonic)
 
 
-class ObjectStore:
-    """Node-side store: tracks entries, capacity, pins, and LRU eviction."""
+ARENA_FILENAME = "arena.buf"
 
-    def __init__(self, directory: str, capacity_bytes: int):
+
+class ObjectStore:
+    """Node-side store: tracks entries, capacity, pins, and LRU eviction.
+
+    Two storage backends share the bookkeeping:
+    * **arena** (preferred): one mmap'd tmpfs file managed by the C++
+      boundary-tag allocator (native/store_core.cpp) — objects are
+      [offset, size) windows, created by granting write buffers to
+      colocated producers (plasma's create→seal protocol).
+    * **file-per-object** fallback when the native extension is
+      unavailable.
+    """
+
+    def __init__(self, directory: str, capacity_bytes: int,
+                 use_arena: bool = True):
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
         self._capacity = capacity_bytes
         self._used = 0
         self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
         self._lock = threading.RLock()
+        self._arena = None
+        if use_arena:
+            from ant_ray_tpu._private.native import load_native  # noqa: PLC0415
+
+            native = load_native()
+            if native is not None:
+                self._arena = native.Arena(
+                    self.arena_path, capacity=capacity_bytes, create=True)
+
+    @property
+    def arena_path(self) -> str:
+        return os.path.join(self._dir, ARENA_FILENAME)
+
+    @property
+    def uses_arena(self) -> bool:
+        return self._arena is not None
+
+    # ---- arena create/seal protocol (native mode)
+
+    def create_buffer(self, object_id: ObjectID, size: int) -> int:
+        """Reserve an unsealed write window; returns the payload offset.
+
+        Raises BufferExistsError carrying whether the existing entry is
+        sealed, so callers can distinguish idempotent re-put (sealed)
+        from an abandoned grant (unsealed → abort and retry)."""
+        with self._lock:
+            existing = self._entries.get(object_id)
+            if existing is not None:
+                raise BufferExistsError(object_id, existing.sealed)
+            self._ensure_space(size)
+            offset = self._arena_alloc(size)
+            self._entries[object_id] = ObjectEntry(
+                object_id, size, sealed=False, offset=offset)
+            self._used += size
+            return offset
+
+    def seal_buffer(self, object_id: ObjectID) -> None:
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None:
+                raise ObjectLostError(object_id, "seal of unknown buffer")
+            entry.sealed = True
+
+    def abort_buffer(self, object_id: ObjectID) -> None:
+        """Drop an unsealed grant (failed pull / crashed producer)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is not None and not entry.sealed:
+                self._delete_locked(object_id)
+
+    def grant_age(self, object_id: ObjectID) -> float:
+        """Seconds since an *unsealed* grant was created; +inf when the
+        entry is missing or sealed.  Lets the daemon distinguish a live
+        producer mid-write from a grant orphaned by a crash."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or entry.sealed:
+                return float("inf")
+            return time.monotonic() - entry.created_at
+
+    def view_unsealed(self, object_id: ObjectID) -> memoryview:
+        """Writable view of an unsealed arena grant (daemon-side sink for
+        pulls; keeps _arena private to this class)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or entry.sealed or entry.offset is None:
+                raise ObjectLostError(object_id, "no unsealed arena grant")
+            return self._arena.view(entry.offset, entry.size)
+
+    def _arena_alloc(self, size: int) -> int:
+        while True:
+            try:
+                return self._arena.alloc(max(size, 1))
+            except MemoryError:
+                # Accounting says it fits but fragmentation bites: evict.
+                if not self._evict_one():
+                    raise ObjectStoreFullError(
+                        "arena fragmented and nothing evictable") from None
+
+    def arena_file_offset(self, payload_offset: int) -> int:
+        """Absolute file offset for a payload offset (layout knowledge
+        stays on the native side via the heap_start getter)."""
+        return self._arena.heap_start + payload_offset
+
+    def locate(self, object_id: ObjectID) -> dict | None:
+        """{"path", "offset", "size"} for readers; offset is an absolute
+        file offset (None = file-per-object fallback)."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed:
+                return None
+            self._entries.move_to_end(object_id)
+            if entry.offset is not None:
+                return {"path": self.arena_path,
+                        "offset": self.arena_file_offset(entry.offset),
+                        "size": entry.size}
+            return {"path": self.path_of(object_id), "offset": None,
+                    "size": entry.size}
 
     # ---- paths
 
@@ -81,6 +193,13 @@ class ObjectStore:
             if object_id in self._entries:
                 return self.path_of(object_id)  # idempotent re-put
             self._ensure_space(size)
+            if self._arena is not None:
+                offset = self._arena_alloc(size)
+                self._arena.view(offset, size)[:] = payload
+                self._entries[object_id] = ObjectEntry(
+                    object_id, size, sealed=True, offset=offset)
+                self._used += size
+                return self.arena_path
             path = self.path_of(object_id)
             with open(path, "wb") as f:
                 f.write(payload)
@@ -89,15 +208,24 @@ class ObjectStore:
             return path
 
     def seal_file(self, object_id: ObjectID, tmp_path: str) -> str:
-        """Adopt a fully-written temp file as a sealed object (zero-copy
-        producer path: colocated workers write into the store directory and
-        the daemon renames into place)."""
+        """Adopt a fully-written temp file as a sealed object (producer
+        fallback path; in arena mode the contents move into the arena)."""
         size = os.path.getsize(tmp_path)
         with self._lock:
             if object_id in self._entries:
                 os.unlink(tmp_path)
                 return self.path_of(object_id)
             self._ensure_space(size)
+            if self._arena is not None:
+                offset = self._arena_alloc(size)
+                view = self._arena.view(offset, size)
+                with open(tmp_path, "rb") as f:
+                    f.readinto(view)
+                os.unlink(tmp_path)
+                self._entries[object_id] = ObjectEntry(
+                    object_id, size, sealed=True, offset=offset)
+                self._used += size
+                return self.arena_path
             final = self.path_of(object_id)
             os.rename(tmp_path, final)
             self._entries[object_id] = ObjectEntry(object_id, size, sealed=True)
@@ -118,7 +246,10 @@ class ObjectStore:
 
     def _evict_one(self) -> bool:
         for oid, entry in self._entries.items():
-            if entry.pin_count == 0:
+            # Unsealed grants are producer-owned and never evictable —
+            # freeing their slot while another process writes through its
+            # view would corrupt whatever reuses the memory.
+            if entry.pin_count == 0 and entry.sealed:
                 self._delete_locked(oid)
                 return True
         return False
@@ -128,6 +259,12 @@ class ObjectStore:
         if entry is None:
             return
         self._used -= entry.size
+        if entry.offset is not None:
+            try:
+                self._arena.free(entry.offset)
+            except ValueError:
+                pass
+            return
         try:
             os.unlink(self.path_of(object_id))
         except FileNotFoundError:
@@ -174,9 +311,16 @@ class ObjectStore:
     def read_chunk(self, object_id: ObjectID, offset: int, length: int) -> bytes:
         """Read a chunk for cross-node transfer."""
         with self._lock:
-            if object_id not in self._entries:
+            entry = self._entries.get(object_id)
+            if entry is None:
                 raise ObjectLostError(object_id, "read on missing object")
             self._entries.move_to_end(object_id)
+            if entry.offset is not None:
+                end = min(offset + length, entry.size)
+                if offset >= entry.size:
+                    return b""
+                return bytes(self._arena.view(
+                    entry.offset + offset, end - offset))
         with open(self.path_of(object_id), "rb") as f:
             f.seek(offset)
             return f.read(length)
@@ -185,6 +329,18 @@ class ObjectStore:
         with self._lock:
             for oid in list(self._entries):
                 self._delete_locked(oid)
+            if self._arena is not None:
+                # Do NOT munmap: in-flight daemon coroutines may still
+                # hold raw views into the mapping (native views don't
+                # refcount the arena).  Unlink the file and retire the
+                # mapping instead — tmpfs space is reclaimed when the
+                # last mapping dies at process exit, which is imminent.
+                self._retired_arena = self._arena
+                self._arena = None
+                try:
+                    os.unlink(self.arena_path)
+                except FileNotFoundError:
+                    pass
         try:
             os.rmdir(self._dir)
         except OSError:
@@ -194,6 +350,44 @@ class ObjectStore:
 class ObjectStoreFullError(ObjectLostError):
     def __init__(self, message: str):
         Exception.__init__(self, message)
+
+
+class BufferExistsError(ValueError):
+    def __init__(self, object_id: ObjectID, sealed: bool):
+        super().__init__(f"buffer for {object_id.hex()[:12]} exists "
+                         f"(sealed={sealed})")
+        self.object_id = object_id
+        self.sealed = sealed
+
+
+class ArenaClient:
+    """Client-side zero-copy windows into node arena files.  One shared
+    mapping per arena path; windows are plain memoryview slices, so reads
+    and producer writes never copy through an RPC."""
+
+    def __init__(self):
+        self._maps: dict[str, memoryview] = {}
+        self._lock = threading.Lock()
+
+    def _mapping(self, path: str) -> memoryview:
+        with self._lock:
+            view = self._maps.get(path)
+            if view is None:
+                with open(path, "r+b") as f:
+                    size = os.fstat(f.fileno()).st_size
+                    m = mmap.mmap(f.fileno(), size)
+                view = memoryview(m)
+                self._maps[path] = view
+            return view
+
+    def view(self, path: str, offset: int, size: int) -> memoryview:
+        """Window at an *absolute* file offset (the daemon converts from
+        payload offsets; clients never know the arena layout)."""
+        return self._mapping(path)[offset:offset + size]
+
+    def close(self):
+        with self._lock:
+            self._maps.clear()
 
 
 def open_object(path: str) -> memoryview:

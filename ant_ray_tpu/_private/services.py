@@ -135,7 +135,7 @@ def stop_processes(procs: list) -> None:
     for proc in procs:
         if proc.poll() is None:
             proc.terminate()
-    deadline = time.monotonic() + 5
+    deadline = time.monotonic() + 15
     for proc in procs:
         remaining = max(0.1, deadline - time.monotonic())
         try:

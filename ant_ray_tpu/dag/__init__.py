@@ -1,0 +1,11 @@
+"""DAG layer: build static task/actor graphs with ``.bind()`` and execute
+them (ref capability: ray.dag / compiled graphs, SURVEY §2.3 aDAG)."""
+
+from ant_ray_tpu.dag.nodes import (
+    ActorMethodNode,
+    DAGNode,
+    FunctionNode,
+    InputNode,
+)
+
+__all__ = ["ActorMethodNode", "DAGNode", "FunctionNode", "InputNode"]

@@ -1,0 +1,20 @@
+"""Model serving (ref capability: ray.serve — controller/replica
+reconciliation, deployment handles, HTTP ingress)."""
+
+from ant_ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    DeploymentHandle,
+    deployment,
+    run,
+    shutdown,
+)
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "deployment",
+    "run",
+    "shutdown",
+]

@@ -1,0 +1,313 @@
+"""Serve: deployments, replicas, routing, HTTP ingress.
+
+Scaled-down mirror of the reference architecture (SURVEY §2.4 Serve /
+§3.6): ``serve.run`` starts a named **controller actor** that reconciles
+desired deployment state into **replica actors**; **handles** route calls
+to replicas (round-robin with pending-count preference — the seed of
+power-of-two-choices, ref: serve/_private/router.py:472); an optional
+aiohttp **proxy actor** exposes deployments over HTTP
+(ref: serve/_private/proxy.py).
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+CONTROLLER_NAME = "_serve_controller"
+
+
+def _art():
+    import ant_ray_tpu as art  # noqa: PLC0415
+
+    return art
+
+
+# ---------------------------------------------------------------- public
+
+@dataclass
+class Deployment:
+    cls_or_fn: Any
+    name: str
+    num_replicas: int = 1
+    route_prefix: str | None = None
+    ray_actor_options: dict = field(default_factory=dict)
+    init_args: tuple = ()
+    init_kwargs: dict = field(default_factory=dict)
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def options(self, *, num_replicas: int | None = None,
+                route_prefix: str | None = None,
+                name: str | None = None) -> "Deployment":
+        return Deployment(
+            cls_or_fn=self.cls_or_fn,
+            name=name or self.name,
+            num_replicas=num_replicas or self.num_replicas,
+            route_prefix=(route_prefix if route_prefix is not None
+                          else self.route_prefix),
+            ray_actor_options=dict(self.ray_actor_options),
+            init_args=self.init_args,
+            init_kwargs=dict(self.init_kwargs),
+        )
+
+
+@dataclass
+class Application:
+    deployment: Deployment
+    args: tuple
+    kwargs: dict
+
+
+def deployment(_cls=None, *, name: str | None = None, num_replicas: int = 1,
+               route_prefix: str | None = None,
+               ray_actor_options: dict | None = None):
+    """``@serve.deployment`` decorator (ref: serve/api.py)."""
+
+    def wrap(cls_or_fn):
+        return Deployment(
+            cls_or_fn=cls_or_fn,
+            name=name or getattr(cls_or_fn, "__name__", "deployment"),
+            num_replicas=num_replicas,
+            route_prefix=route_prefix,
+            ray_actor_options=dict(ray_actor_options or {}),
+        )
+
+    if _cls is not None:
+        return wrap(_cls)
+    return wrap
+
+
+class DeploymentHandle:
+    """Client handle routing calls across a deployment's replicas."""
+
+    def __init__(self, deployment_name: str, replicas: list,
+                 method_name: str = "__call__"):
+        self._name = deployment_name
+        self._replicas = list(replicas)
+        self._method = method_name
+        self._rr = itertools.count()
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self._name, self._replicas, method_name)
+
+    def remote(self, *args, **kwargs):
+        if not self._replicas:
+            raise RuntimeError(f"deployment {self._name} has no replicas")
+        index = next(self._rr) % len(self._replicas)
+        replica = self._replicas[index]
+        return replica.handle_request.remote(self._method, args, kwargs)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self._name, self._replicas, self._method))
+
+
+# ---------------------------------------------------------------- actors
+
+class Replica:
+    """One replica actor wrapping the user's callable/class
+    (ref: serve/_private/replica.py:1124)."""
+
+    def __init__(self, cls_or_fn, args, kwargs):
+        if isinstance(cls_or_fn, type):
+            self._instance = cls_or_fn(*args, **kwargs)
+        else:
+            self._instance = cls_or_fn  # plain function deployment
+
+    def handle_request(self, method_name: str, args, kwargs):
+        if method_name == "__call__":
+            return self._instance(*args, **kwargs)
+        return getattr(self._instance, method_name)(*args, **kwargs)
+
+    def health(self):
+        return "ok"
+
+
+class ServeController:
+    """Reconciles deployments → replica actors
+    (ref: serve/_private/controller.py:105)."""
+
+    def __init__(self):
+        self._deployments: dict[str, dict] = {}
+        self._proxy = None
+
+    def deploy(self, deployment: Deployment, args, kwargs) -> dict:
+        art = _art()
+        replica_cls = art.remote(Replica).options(
+            **{"num_cpus": deployment.ray_actor_options.get("num_cpus", 0)})
+        existing = self._deployments.get(deployment.name)
+        if existing is not None:
+            for r in existing["replicas"]:
+                try:
+                    art.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+        replicas = [
+            replica_cls.remote(deployment.cls_or_fn, args, kwargs)
+            for _ in range(deployment.num_replicas)
+        ]
+        art.get([r.health.remote() for r in replicas])  # readiness gate
+        self._deployments[deployment.name] = {
+            "deployment": deployment,
+            "replicas": replicas,
+            "route_prefix": deployment.route_prefix,
+        }
+        return {"name": deployment.name}
+
+    def get_handle_info(self, name: str):
+        entry = self._deployments.get(name)
+        if entry is None:
+            return None
+        return {"replicas": entry["replicas"]}
+
+    def list_deployments(self):
+        return {
+            name: {
+                "num_replicas": len(e["replicas"]),
+                "route_prefix": e["route_prefix"],
+            }
+            for name, e in self._deployments.items()
+        }
+
+    def routes(self):
+        return {
+            e["route_prefix"]: name
+            for name, e in self._deployments.items()
+            if e["route_prefix"]
+        }
+
+    def start_http_proxy(self, port: int) -> int:
+        art = _art()
+        if self._proxy is None:
+            proxy_cls = art.remote(HttpProxy).options(
+                max_concurrency=32, num_cpus=0)
+            controller = art.get_actor(CONTROLLER_NAME,
+                                       namespace="_serve")
+            self._proxy = proxy_cls.remote(controller)
+        return art.get(self._proxy.start.remote(port))
+
+    def shutdown_all(self):
+        art = _art()
+        for entry in self._deployments.values():
+            for r in entry["replicas"]:
+                try:
+                    art.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+        if self._proxy is not None:
+            try:
+                art.kill(self._proxy)
+            except Exception:  # noqa: BLE001
+                pass
+        self._deployments.clear()
+        return True
+
+
+class HttpProxy:
+    """aiohttp ingress routing requests to deployments by route prefix
+    (ref: serve/_private/proxy.py)."""
+
+    def __init__(self, controller):
+        self._controller = controller
+        self._port = None
+        self._runner = None
+
+    def start(self, port: int) -> int:
+        import asyncio  # noqa: PLC0415
+        import threading  # noqa: PLC0415
+
+        from aiohttp import web  # noqa: PLC0415
+
+        art = _art()
+        loop = asyncio.new_event_loop()
+
+        def dispatch(path: str, body):
+            """Blocking route+call (runs on an executor thread so the
+            aiohttp loop stays free)."""
+            routes = art.get(self._controller.routes.remote())
+            for prefix, name in routes.items():
+                if path.startswith(prefix):
+                    info = art.get(
+                        self._controller.get_handle_info.remote(name))
+                    handle = DeploymentHandle(name, info["replicas"])
+                    return {"result": art.get(handle.remote(body))}, 200
+            return {"error": f"no route for {path}"}, 404
+
+        async def handler(request: "web.Request"):
+            try:
+                body = await request.json() if request.can_read_body else {}
+            except Exception:  # noqa: BLE001
+                body = {}
+            loop_ = asyncio.get_running_loop()
+            payload, status = await loop_.run_in_executor(
+                None, dispatch, request.path, body)
+            return web.json_response(payload, status=status)
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", handler)
+        started = threading.Event()
+        port_holder = {}
+
+        def _serve():
+            asyncio.set_event_loop(loop)
+            runner = web.AppRunner(app)
+            loop.run_until_complete(runner.setup())
+            site = web.TCPSite(runner, "127.0.0.1", port)
+            loop.run_until_complete(site.start())
+            port_holder["port"] = site._server.sockets[0].getsockname()[1]
+            self._runner = runner
+            started.set()
+            loop.run_forever()
+
+        threading.Thread(target=_serve, daemon=True).start()
+        started.wait(10)
+        self._port = port_holder.get("port")
+        return self._port
+
+
+# ---------------------------------------------------------------- run api
+
+def _get_or_create_controller():
+    art = _art()
+    try:
+        return art.get_actor(CONTROLLER_NAME, namespace="_serve")
+    except ValueError:
+        controller_cls = art.remote(ServeController).options(
+            name=CONTROLLER_NAME, namespace="_serve", get_if_exists=True,
+            max_concurrency=16, num_cpus=0, lifetime="detached")
+        return controller_cls.remote()
+
+
+def run(app: Application, *, port: int | None = None) -> DeploymentHandle:
+    """Deploy an application; returns its handle (ref: serve.run)."""
+    art = _art()
+    if not art.is_initialized():
+        art.init()
+    controller = _get_or_create_controller()
+    art.get(controller.deploy.remote(app.deployment, app.args, app.kwargs))
+    if port is not None or app.deployment.route_prefix:
+        actual = art.get(controller.start_http_proxy.remote(
+            8000 if port is None else port))
+        run.last_http_port = actual  # discoverable for tests/clients
+    info = art.get(
+        controller.get_handle_info.remote(app.deployment.name))
+    return DeploymentHandle(app.deployment.name, info["replicas"])
+
+
+run.last_http_port = None
+
+
+def shutdown():
+    art = _art()
+    try:
+        controller = art.get_actor(CONTROLLER_NAME, namespace="_serve")
+    except ValueError:
+        return
+    try:
+        art.get(controller.shutdown_all.remote())
+        art.kill(controller)
+    except Exception:  # noqa: BLE001
+        pass

@@ -1,0 +1,28 @@
+"""Hyperparameter tuning (ref capability: ray.tune — Tuner over trial
+tasks with search spaces)."""
+
+from ant_ray_tpu.tune.tuner import (
+    Result,
+    ResultGrid,
+    TuneConfig,
+    Tuner,
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    report,
+    uniform,
+)
+
+__all__ = [
+    "Result",
+    "ResultGrid",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "uniform",
+]

@@ -1,0 +1,211 @@
+"""Tuner: trial generation (grid × random search spaces) + bounded-
+concurrency execution of trials as cluster tasks.
+
+Scaled-down mirror of the reference (SURVEY §2.4 Tune: Tuner →
+TuneController event loop over trial actors, searchers, schedulers): trial
+configs expand from the param space, each trial runs the trainable as a
+task, in-trial ``tune.report`` streams metric rows back with the result,
+and the ResultGrid picks winners.  ASHA-style early stopping and trial
+checkpointing layer on later.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random as _random
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+# ------------------------------------------------------------ search space
+
+@dataclass(frozen=True)
+class _GridSearch:
+    values: tuple
+
+
+@dataclass(frozen=True)
+class _Sampler:
+    kind: str
+    a: float
+    b: float
+    values: tuple = ()
+
+    def sample(self, rng: _random.Random):
+        if self.kind == "uniform":
+            return rng.uniform(self.a, self.b)
+        if self.kind == "loguniform":
+            import math
+
+            return math.exp(rng.uniform(math.log(self.a), math.log(self.b)))
+        if self.kind == "randint":
+            return rng.randint(int(self.a), int(self.b) - 1)
+        if self.kind == "choice":
+            return rng.choice(list(self.values))
+        raise ValueError(self.kind)
+
+
+def grid_search(values) -> _GridSearch:
+    return _GridSearch(tuple(values))
+
+
+def uniform(low: float, high: float) -> _Sampler:
+    return _Sampler("uniform", low, high)
+
+
+def loguniform(low: float, high: float) -> _Sampler:
+    return _Sampler("loguniform", low, high)
+
+
+def randint(low: int, high: int) -> _Sampler:
+    return _Sampler("randint", low, high)
+
+
+def choice(values) -> _Sampler:
+    return _Sampler("choice", 0, 0, tuple(values))
+
+
+def expand_param_space(space: dict, num_samples: int,
+                       seed: int | None = None) -> list[dict]:
+    """Grid dims form the cross product; samplers draw per sample."""
+    rng = _random.Random(seed)
+    grid_keys = [k for k, v in space.items() if isinstance(v, _GridSearch)]
+    grid_values = [space[k].values for k in grid_keys]
+    grids = list(itertools.product(*grid_values)) if grid_keys else [()]
+    configs = []
+    for _ in range(num_samples):
+        for combo in grids:
+            config = {}
+            for key, value in space.items():
+                if isinstance(value, _GridSearch):
+                    config[key] = combo[grid_keys.index(key)]
+                elif isinstance(value, _Sampler):
+                    config[key] = value.sample(rng)
+                else:
+                    config[key] = value
+            configs.append(config)
+    return configs
+
+
+# ------------------------------------------------------------ reporting
+
+_trial_reports = threading.local()
+
+
+def report(metrics: dict) -> None:
+    """In-trial metric reporting (ref: tune.report / session.report)."""
+    sink = getattr(_trial_reports, "sink", None)
+    if sink is None:
+        raise RuntimeError("tune.report() called outside a trial")
+    sink.append(dict(metrics))
+
+
+def _run_trial(trainable: Callable, config: dict) -> dict:
+    _trial_reports.sink = []
+    try:
+        returned = trainable(config)
+        reports = _trial_reports.sink
+    finally:
+        _trial_reports.sink = None
+    last = dict(reports[-1]) if reports else {}
+    if isinstance(returned, dict):
+        last.update(returned)
+    return {"config": config, "metrics": last, "history": reports}
+
+
+# ------------------------------------------------------------ results
+
+@dataclass
+class Result:
+    config: dict
+    metrics: dict
+    history: list = field(default_factory=list)
+    error: Exception | None = None
+
+
+class ResultGrid:
+    def __init__(self, results: list[Result]):
+        self._results = results
+
+    def __len__(self):
+        return len(self._results)
+
+    def __iter__(self):
+        return iter(self._results)
+
+    def __getitem__(self, i):
+        return self._results[i]
+
+    @property
+    def errors(self) -> list[Exception]:
+        return [r.error for r in self._results if r.error is not None]
+
+    def get_best_result(self, metric: str, mode: str = "min") -> Result:
+        scored = [r for r in self._results
+                  if r.error is None and metric in r.metrics]
+        if not scored:
+            raise ValueError(f"no successful trial reported {metric!r}")
+        key = lambda r: r.metrics[metric]  # noqa: E731
+        return min(scored, key=key) if mode == "min" else max(scored,
+                                                              key=key)
+
+    def get_dataframe(self):
+        rows = [{**r.config, **r.metrics} for r in self._results
+                if r.error is None]
+        return rows
+
+
+# ------------------------------------------------------------ tuner
+
+@dataclass
+class TuneConfig:
+    num_samples: int = 1
+    max_concurrent_trials: int = 0       # 0 = unbounded
+    metric: str | None = None
+    mode: str = "min"
+    seed: int | None = None
+    resources_per_trial: dict = field(default_factory=dict)
+
+
+class Tuner:
+    """(ref: python/ray/tune/tuner.py:43)"""
+
+    def __init__(self, trainable: Callable, *, param_space: dict,
+                 tune_config: TuneConfig | None = None):
+        self._trainable = trainable
+        self._param_space = dict(param_space)
+        self._config = tune_config or TuneConfig()
+
+    def fit(self) -> ResultGrid:
+        import ant_ray_tpu as art  # noqa: PLC0415
+
+        if not art.is_initialized():
+            art.init()
+        configs = expand_param_space(
+            self._param_space, self._config.num_samples, self._config.seed)
+        run_remote = art.remote(_run_trial).options(
+            **({"resources": self._config.resources_per_trial}
+               if self._config.resources_per_trial else {}))
+
+        max_conc = self._config.max_concurrent_trials or len(configs)
+        pending = list(configs)
+        running: dict = {}
+        results: list[Result] = []
+        while pending or running:
+            while pending and len(running) < max_conc:
+                config = pending.pop(0)
+                ref = run_remote.remote(self._trainable, config)
+                running[ref] = config
+            ready, _ = art.wait(list(running), num_returns=1, timeout=300)
+            for ref in ready:
+                config = running.pop(ref)
+                try:
+                    out = art.get(ref)
+                    results.append(Result(config=out["config"],
+                                          metrics=out["metrics"],
+                                          history=out["history"]))
+                except Exception as e:  # noqa: BLE001 — trial failure
+                    results.append(Result(config=config, metrics={},
+                                          error=e))
+        return ResultGrid(results)

@@ -1,0 +1,340 @@
+// art_native — native core of the shared-memory object store.
+//
+// Role of the reference's plasma allocator (ref: src/ray/object_manager/
+// plasma/plasma_allocator.h + dlmalloc arenas), redesigned for the
+// tmpfs-arena model: one mmap'd file per node holds all objects; the node
+// daemon owns allocation (single-writer), workers/drivers map the same
+// file and read/write zero-copy through granted [offset, size) windows.
+//
+// Allocator: boundary-tag first-fit free list with coalescing.  Block
+// layout: [u64 header][payload][u64 footer], header/footer = size | free
+// bit.  Single-threaded by design (the owning daemon serializes), so no
+// locks live in the arena itself.
+//
+// Python API (module art_native):
+//   Arena(path, capacity, create)      — create/open an arena file
+//   a.alloc(nbytes) -> offset          — raises MemoryError when full
+//   a.free(offset)
+//   a.view(offset, nbytes) -> memoryview (zero-copy, writable)
+//   a.used, a.capacity, a.num_blocks
+//   a.close()
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x41525453484d3031ull;  // "ARTSHM01"
+constexpr uint64_t kFreeBit = 1ull << 63;
+constexpr uint64_t kAlign = 64;  // cache-line aligned payloads
+
+struct ArenaHeader {
+  uint64_t magic;
+  uint64_t capacity;   // usable bytes after the header
+  uint64_t used;       // payload bytes currently allocated
+  uint64_t num_blocks; // live allocations
+};
+
+inline uint64_t align_up(uint64_t v, uint64_t a) {
+  return (v + a - 1) & ~(a - 1);
+}
+
+struct Arena {
+  PyObject_HEAD
+  int fd;
+  uint8_t* base;       // mmap base
+  uint64_t file_size;
+  bool owner;          // created (vs opened) — owner runs the allocator
+
+  ArenaHeader* header() { return reinterpret_cast<ArenaHeader*>(base); }
+  uint8_t* heap() { return base + align_up(sizeof(ArenaHeader), kAlign); }
+  uint64_t heap_size() { return header()->capacity; }
+
+  uint64_t read_tag(uint64_t off) {
+    uint64_t v;
+    std::memcpy(&v, heap() + off, sizeof(v));
+    return v;
+  }
+  void write_tag(uint64_t off, uint64_t v) {
+    std::memcpy(heap() + off, &v, sizeof(v));
+  }
+  // Block: [header u64][payload][footer u64]; size counts the whole block.
+  void set_block(uint64_t off, uint64_t size, bool free_flag) {
+    uint64_t tag = size | (free_flag ? kFreeBit : 0);
+    write_tag(off, tag);
+    write_tag(off + size - sizeof(uint64_t), tag);
+  }
+  static uint64_t tag_size(uint64_t tag) { return tag & ~kFreeBit; }
+  static bool tag_free(uint64_t tag) { return tag & kFreeBit; }
+};
+
+int arena_init_file(Arena* self, const char* path, uint64_t capacity,
+                    bool create) {
+  int flags = create ? (O_RDWR | O_CREAT | O_EXCL) : O_RDWR;
+  self->fd = open(path, flags, 0600);
+  if (self->fd < 0) {
+    PyErr_SetFromErrnoWithFilename(PyExc_OSError, path);
+    return -1;
+  }
+  uint64_t heap_off = align_up(sizeof(ArenaHeader), kAlign);
+  if (create) {
+    self->file_size = heap_off + capacity;
+    if (ftruncate(self->fd, static_cast<off_t>(self->file_size)) != 0) {
+      PyErr_SetFromErrno(PyExc_OSError);
+      return -1;
+    }
+  } else {
+    struct stat st;
+    if (fstat(self->fd, &st) != 0) {
+      PyErr_SetFromErrno(PyExc_OSError);
+      return -1;
+    }
+    self->file_size = static_cast<uint64_t>(st.st_size);
+  }
+  self->base = static_cast<uint8_t*>(
+      mmap(nullptr, self->file_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+           self->fd, 0));
+  if (self->base == MAP_FAILED) {
+    self->base = nullptr;
+    PyErr_SetFromErrno(PyExc_OSError);
+    return -1;
+  }
+  if (create) {
+    ArenaHeader* h = self->header();
+    h->magic = kMagic;
+    h->capacity = capacity;
+    h->used = 0;
+    h->num_blocks = 0;
+    // One giant free block spanning the heap.
+    self->set_block(0, capacity, /*free=*/true);
+  } else if (self->header()->magic != kMagic) {
+    PyErr_SetString(PyExc_ValueError, "not an art arena file");
+    return -1;
+  }
+  self->owner = create;
+  return 0;
+}
+
+// ------------------------------------------------------------------ methods
+
+PyObject* arena_alloc(Arena* self, PyObject* arg) {
+  if (self->base == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "arena is closed");
+    return nullptr;
+  }
+  unsigned long long nbytes_in = PyLong_AsUnsignedLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  // Payload + header/footer tags, aligned.
+  uint64_t need = align_up(nbytes_in + 2 * sizeof(uint64_t), kAlign);
+  uint64_t off = 0;
+  uint64_t cap = self->heap_size();
+  while (off < cap) {
+    uint64_t tag = self->read_tag(off);
+    uint64_t size = Arena::tag_size(tag);
+    if (size == 0 || size > cap - off) {
+      PyErr_SetString(PyExc_RuntimeError, "arena corruption detected");
+      return nullptr;
+    }
+    if (Arena::tag_free(tag) && size >= need) {
+      uint64_t remainder = size - need;
+      if (remainder >= kAlign * 2) {
+        self->set_block(off, need, false);
+        self->set_block(off + need, remainder, true);
+      } else {
+        need = size;  // absorb the sliver
+        self->set_block(off, size, false);
+      }
+      self->header()->used += need;
+      self->header()->num_blocks += 1;
+      // Payload begins after the header tag.
+      return PyLong_FromUnsignedLongLong(off + sizeof(uint64_t));
+    }
+    off += size;
+  }
+  PyErr_SetString(PyExc_MemoryError, "arena full");
+  return nullptr;
+}
+
+PyObject* arena_free(Arena* self, PyObject* arg) {
+  if (self->base == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "arena is closed");
+    return nullptr;
+  }
+  unsigned long long payload_off = PyLong_AsUnsignedLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  if (payload_off < sizeof(uint64_t)) {
+    PyErr_SetString(PyExc_ValueError, "bad offset");
+    return nullptr;
+  }
+  uint64_t off = payload_off - sizeof(uint64_t);
+  uint64_t cap = self->heap_size();
+  if (off >= cap) {
+    PyErr_SetString(PyExc_ValueError, "offset out of range");
+    return nullptr;
+  }
+  uint64_t tag = self->read_tag(off);
+  if (Arena::tag_free(tag)) {
+    PyErr_SetString(PyExc_ValueError, "double free");
+    return nullptr;
+  }
+  uint64_t size = Arena::tag_size(tag);
+  self->header()->used -= size;
+  self->header()->num_blocks -= 1;
+
+  // Coalesce with next block.
+  uint64_t next = off + size;
+  if (next < cap) {
+    uint64_t ntag = self->read_tag(next);
+    if (Arena::tag_free(ntag)) size += Arena::tag_size(ntag);
+  }
+  // Coalesce with previous block (via its footer).
+  if (off >= kAlign) {
+    uint64_t ptag = self->read_tag(off - sizeof(uint64_t));
+    if (Arena::tag_free(ptag)) {
+      uint64_t psize = Arena::tag_size(ptag);
+      off -= psize;
+      size += psize;
+    }
+  }
+  self->set_block(off, size, true);
+  Py_RETURN_NONE;
+}
+
+PyObject* arena_view(Arena* self, PyObject* args) {
+  unsigned long long off, nbytes;
+  if (!PyArg_ParseTuple(args, "KK", &off, &nbytes)) return nullptr;
+  if (self->base == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "arena is closed");
+    return nullptr;
+  }
+  uint64_t heap_start = align_up(sizeof(ArenaHeader), kAlign);
+  if (off + nbytes > self->file_size - heap_start) {
+    PyErr_SetString(PyExc_ValueError, "view out of range");
+    return nullptr;
+  }
+  return PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(self->heap() + off),
+      static_cast<Py_ssize_t>(nbytes), PyBUF_WRITE);
+}
+
+PyObject* arena_close(Arena* self, PyObject*) {
+  if (self->base != nullptr) {
+    munmap(self->base, self->file_size);
+    self->base = nullptr;
+  }
+  if (self->fd >= 0) {
+    close(self->fd);
+    self->fd = -1;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* arena_get_used(Arena* self, void*) {
+  if (self->base == nullptr) return PyLong_FromLong(0);
+  return PyLong_FromUnsignedLongLong(self->header()->used);
+}
+
+PyObject* arena_get_capacity(Arena* self, void*) {
+  if (self->base == nullptr) return PyLong_FromLong(0);
+  return PyLong_FromUnsignedLongLong(self->header()->capacity);
+}
+
+PyObject* arena_get_num_blocks(Arena* self, void*) {
+  if (self->base == nullptr) return PyLong_FromLong(0);
+  return PyLong_FromUnsignedLongLong(self->header()->num_blocks);
+}
+
+PyObject* arena_get_heap_start(Arena* self, void*) {
+  // Absolute file offset where payload offsets are rooted; clients add
+  // this instead of duplicating the header layout.
+  return PyLong_FromUnsignedLongLong(
+      align_up(sizeof(ArenaHeader), kAlign));
+}
+
+int arena_tp_init(PyObject* self_obj, PyObject* args, PyObject* kwargs) {
+  Arena* self = reinterpret_cast<Arena*>(self_obj);
+  self->fd = -1;
+  self->base = nullptr;
+  const char* path;
+  unsigned long long capacity = 0;
+  int create = 0;
+  static const char* kwlist[] = {"path", "capacity", "create", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(
+          args, kwargs, "s|Kp", const_cast<char**>(kwlist), &path,
+          &capacity, &create)) {
+    return -1;
+  }
+  if (create && capacity < kAlign * 4) {
+    PyErr_SetString(PyExc_ValueError, "capacity too small");
+    return -1;
+  }
+  return arena_init_file(self, path, align_up(capacity, kAlign),
+                         create != 0);
+}
+
+void arena_dealloc(PyObject* self_obj) {
+  Arena* self = reinterpret_cast<Arena*>(self_obj);
+  if (self->base != nullptr) munmap(self->base, self->file_size);
+  if (self->fd >= 0) close(self->fd);
+  Py_TYPE(self_obj)->tp_free(self_obj);
+}
+
+PyMethodDef arena_methods[] = {
+    {"alloc", reinterpret_cast<PyCFunction>(arena_alloc), METH_O,
+     "alloc(nbytes) -> payload offset"},
+    {"free", reinterpret_cast<PyCFunction>(arena_free), METH_O,
+     "free(offset)"},
+    {"view", reinterpret_cast<PyCFunction>(arena_view), METH_VARARGS,
+     "view(offset, nbytes) -> writable memoryview"},
+    {"close", reinterpret_cast<PyCFunction>(arena_close), METH_NOARGS,
+     "unmap and close"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyGetSetDef arena_getset[] = {
+    {"used", reinterpret_cast<getter>(arena_get_used), nullptr, nullptr,
+     nullptr},
+    {"capacity", reinterpret_cast<getter>(arena_get_capacity), nullptr,
+     nullptr, nullptr},
+    {"num_blocks", reinterpret_cast<getter>(arena_get_num_blocks), nullptr,
+     nullptr, nullptr},
+    {"heap_start", reinterpret_cast<getter>(arena_get_heap_start), nullptr,
+     nullptr, nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr}};
+
+PyTypeObject ArenaType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+PyModuleDef art_native_module = {
+    PyModuleDef_HEAD_INIT, "art_native",
+    "native shared-memory arena for the object store", -1,
+    nullptr, nullptr, nullptr, nullptr, nullptr};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_art_native(void) {
+  ArenaType.tp_name = "art_native.Arena";
+  ArenaType.tp_basicsize = sizeof(Arena);
+  ArenaType.tp_flags = Py_TPFLAGS_DEFAULT;
+  ArenaType.tp_new = PyType_GenericNew;
+  ArenaType.tp_init = arena_tp_init;
+  ArenaType.tp_dealloc = arena_dealloc;
+  ArenaType.tp_methods = arena_methods;
+  ArenaType.tp_getset = arena_getset;
+  if (PyType_Ready(&ArenaType) < 0) return nullptr;
+  PyObject* m = PyModule_Create(&art_native_module);
+  if (m == nullptr) return nullptr;
+  Py_INCREF(&ArenaType);
+  PyModule_AddObject(m, "Arena",
+                     reinterpret_cast<PyObject*>(&ArenaType));
+  return m;
+}

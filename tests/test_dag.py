@@ -1,0 +1,97 @@
+"""DAG layer tests (ref test model: dag/tests)."""
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu.dag import InputNode
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    art.init(num_cpus=4, num_tpus=0)
+    yield None
+    art.shutdown()
+
+
+def test_function_dag(cluster):
+    @art.remote
+    def add(a, b):
+        return a + b
+
+    @art.remote
+    def mul(a, b):
+        return a * b
+
+    with InputNode() as inp:
+        dag = mul.bind(add.bind(inp, 1), 10)
+    assert art.get(dag.execute(4)) == 50
+
+
+def test_diamond_dag(cluster):
+    @art.remote
+    def left(x):
+        return x + 1
+
+    @art.remote
+    def right(x):
+        return x * 2
+
+    @art.remote
+    def join(a, b):
+        return (a, b)
+
+    with InputNode() as inp:
+        dag = join.bind(left.bind(inp), right.bind(inp))
+    assert art.get(dag.execute(10)) == (11, 20)
+
+
+def test_actor_dag(cluster):
+    @art.remote
+    class Accum:
+        def __init__(self):
+            self.total = 0
+
+        def add(self, x):
+            self.total += x
+            return self.total
+
+    a = Accum.remote()
+    with InputNode() as inp:
+        dag = a.add.bind(inp)
+    assert art.get(dag.execute(5)) == 5
+    assert art.get(dag.execute(7)) == 12  # same actor, stateful
+
+
+def test_compiled_dag_reuse(cluster):
+    @art.remote
+    def square(x):
+        return x * x
+
+    with InputNode() as inp:
+        dag = square.bind(square.bind(inp))
+    compiled = dag.experimental_compile()
+    assert art.get(compiled.execute(2)) == 16
+    assert art.get(compiled.execute(3)) == 81
+    compiled.teardown()
+
+
+def test_dag_cycle_detection(cluster):
+    @art.remote
+    def f(x):
+        return x
+
+    node = f.bind(1)
+    node._bound_args = (node,)  # forge a cycle
+    with pytest.raises(ValueError, match="cycle"):
+        node.execute()
+
+
+def test_missing_input_errors(cluster):
+    @art.remote
+    def f(x):
+        return x
+
+    with InputNode() as inp:
+        dag = f.bind(inp)
+    with pytest.raises(ValueError, match="input"):
+        dag.execute()

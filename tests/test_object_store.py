@@ -1,0 +1,145 @@
+"""Object store unit tests: arena allocator + store bookkeeping
+(ref test model: plasma store/allocator tests)."""
+
+import os
+
+import pytest
+
+from ant_ray_tpu._private.ids import ObjectID
+from ant_ray_tpu._private.native import load_native
+from ant_ray_tpu._private.object_store import (
+    ArenaClient,
+    ObjectStore,
+    ObjectStoreFullError,
+    open_object,
+)
+
+native = load_native()
+
+
+@pytest.fixture
+def store(tmp_path):
+    s = ObjectStore(str(tmp_path / "store"), capacity_bytes=1 << 20)
+    yield s
+    s.destroy()
+
+
+def test_native_available():
+    assert native is not None, "C++ extension must build in CI"
+
+
+def test_arena_alloc_free_coalesce(tmp_path):
+    arena = native.Arena(str(tmp_path / "a.buf"), capacity=1 << 16,
+                         create=True)
+    offsets = [arena.alloc(1000) for _ in range(10)]
+    assert arena.num_blocks == 10
+    for off in offsets:
+        arena.free(off)
+    assert arena.used == 0
+    # After full coalescing a near-capacity alloc succeeds.
+    big = arena.alloc((1 << 16) - 256)
+    assert big >= 0
+    arena.close()
+
+
+def test_arena_cross_mapping(tmp_path):
+    path = str(tmp_path / "a.buf")
+    a = native.Arena(path, capacity=1 << 16, create=True)
+    off = a.alloc(64)
+    a.view(off, 64)[:5] = b"12345"
+    b = native.Arena(path)
+    assert bytes(b.view(off, 5)) == b"12345"
+    a.close(); b.close()
+
+
+def test_store_create_and_locate(store):
+    oid = ObjectID.from_random()
+    payload = os.urandom(4096)
+    store.create(oid, payload)
+    info = store.locate(oid)
+    assert info is not None and info["size"] == 4096
+    if store.uses_arena:
+        client = ArenaClient()
+        assert bytes(client.view(info["path"], info["offset"], 4096)) == \
+            payload
+    else:
+        assert bytes(open_object(info["path"])) == payload
+
+
+def test_store_create_seal_protocol(store):
+    if not store.uses_arena:
+        pytest.skip("arena-only protocol")
+    oid = ObjectID.from_random()
+    offset = store.create_buffer(oid, 128)
+    assert store.locate(oid) is None  # unsealed: invisible to readers
+    store.view_unsealed(oid)[:3] = b"abc"
+    store.seal_buffer(oid)
+    info = store.locate(oid)
+    assert info["offset"] == store.arena_file_offset(offset)
+
+
+def test_store_eviction_lru(store):
+    # Fill beyond capacity with unpinned objects; oldest get evicted.
+    oids = []
+    for _ in range(6):
+        oid = ObjectID.from_random()
+        store.create(oid, os.urandom(256 * 1024))
+        oids.append(oid)
+    assert not store.contains(oids[0])
+    assert store.contains(oids[-1])
+    assert store.used <= store.capacity
+
+
+def test_store_pinned_objects_not_evicted(store):
+    pinned = ObjectID.from_random()
+    store.create(pinned, os.urandom(256 * 1024))
+    store.pin(pinned)
+    for _ in range(6):
+        store.create(ObjectID.from_random(), os.urandom(200 * 1024))
+    assert store.contains(pinned)
+    store.unpin(pinned)
+
+
+def test_store_full_when_all_pinned(store):
+    oid = ObjectID.from_random()
+    store.create(oid, os.urandom(900 * 1024))
+    store.pin(oid)
+    with pytest.raises(ObjectStoreFullError):
+        store.create(ObjectID.from_random(), os.urandom(900 * 1024))
+    store.unpin(oid)
+
+
+def test_read_chunk(store):
+    oid = ObjectID.from_random()
+    payload = bytes(range(256)) * 64
+    store.create(oid, payload)
+    assert store.read_chunk(oid, 0, 100) == payload[:100]
+    assert store.read_chunk(oid, 1000, 100) == payload[1000:1100]
+    assert store.read_chunk(oid, len(payload), 10) == b""
+
+
+def test_unsealed_grants_never_evicted(store):
+    if not store.uses_arena:
+        pytest.skip("arena-only")
+    grant = ObjectID.from_random()
+    store.create_buffer(grant, 256 * 1024)  # producer still writing
+    for _ in range(8):
+        store.create(ObjectID.from_random(), os.urandom(100 * 1024))
+    assert store.contains(grant)  # survived the eviction pressure
+    store.abort_buffer(grant)
+    assert not store.contains(grant)
+
+
+def test_abort_buffer_allows_retry(store):
+    if not store.uses_arena:
+        pytest.skip("arena-only")
+    from ant_ray_tpu._private.object_store import BufferExistsError
+
+    oid = ObjectID.from_random()
+    store.create_buffer(oid, 64)
+    with pytest.raises(BufferExistsError) as e:
+        store.create_buffer(oid, 64)
+    assert e.value.sealed is False
+    store.abort_buffer(oid)
+    store.create_buffer(oid, 64)  # retriable after abort
+    store.seal_buffer(oid)

@@ -1,0 +1,102 @@
+"""Serve layer tests (ref test model: serve/tests)."""
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu import serve
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    art.init(num_cpus=4, num_tpus=0)
+    yield None
+    serve.shutdown()
+    art.shutdown()
+
+
+def test_function_deployment(cluster):
+    @serve.deployment
+    def double(x):
+        return x * 2
+
+    handle = serve.run(double.bind())
+    assert art.get(handle.remote(21)) == 42
+
+
+def test_class_deployment_with_state(cluster):
+    @serve.deployment(name="counter")
+    class Counter:
+        def __init__(self, start):
+            self.n = start
+
+        def __call__(self, k):
+            self.n += k
+            return self.n
+
+        def peek(self):
+            return self.n
+
+    handle = serve.run(Counter.bind(100))
+    assert art.get(handle.remote(5)) == 105
+    assert art.get(handle.options(method_name="peek").remote()) == 105
+
+
+def test_multi_replica_distribution(cluster):
+    @serve.deployment(name="who", num_replicas=2)
+    class Who:
+        def __call__(self):
+            import os
+
+            return os.getpid()
+
+    handle = serve.run(Who.bind())
+    pids = set(art.get([handle.remote() for _ in range(8)]))
+    assert len(pids) == 2
+
+
+def test_redeploy_replaces_replicas(cluster):
+    @serve.deployment(name="ver")
+    class V1:
+        def __call__(self):
+            return "v1"
+
+    @serve.deployment(name="ver")
+    class V2:
+        def __call__(self):
+            return "v2"
+
+    h1 = serve.run(V1.bind())
+    assert art.get(h1.remote()) == "v1"
+    h2 = serve.run(V2.bind())
+    assert art.get(h2.remote()) == "v2"
+
+
+def test_http_ingress(cluster):
+    @serve.deployment(name="api", route_prefix="/api")
+    class Api:
+        def __call__(self, body):
+            return {"echo": body.get("msg", ""), "n": body.get("n", 0) + 1}
+
+    serve.run(Api.bind(), port=0)
+    port = serve.api.run.last_http_port
+    assert port
+
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/api",
+        data=json.dumps({"msg": "hi", "n": 41}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    assert out["result"] == {"echo": "hi", "n": 42}
+
+    # 404 for unknown route
+    try:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/nope", timeout=30)
+        raised = False
+    except urllib.error.HTTPError as e:
+        raised = e.code == 404
+    assert raised

@@ -1,0 +1,81 @@
+"""Tune layer tests (ref test model: tune/tests)."""
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu import tune
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    art.init(num_cpus=4, num_tpus=0)
+    yield None
+    art.shutdown()
+
+
+def test_param_space_expansion():
+    space = {"lr": tune.grid_search([0.1, 0.01]),
+             "wd": tune.grid_search([0, 1]),
+             "seed": 7}
+    configs = tune.tuner.expand_param_space(space, num_samples=1)
+    assert len(configs) == 4
+    assert all(c["seed"] == 7 for c in configs)
+
+    space2 = {"lr": tune.loguniform(1e-4, 1e-1)}
+    configs2 = tune.tuner.expand_param_space(space2, num_samples=5, seed=0)
+    assert len(configs2) == 5
+    assert all(1e-4 <= c["lr"] <= 1e-1 for c in configs2)
+
+
+def test_grid_search_finds_optimum(cluster):
+    def trainable(config):
+        loss = (config["x"] - 3) ** 2 + config["y"]
+        tune.report({"loss": loss})
+
+    tuner = tune.Tuner(
+        trainable,
+        param_space={"x": tune.grid_search([1, 2, 3, 4]),
+                     "y": tune.grid_search([0.5, 0.0])},
+        tune_config=tune.TuneConfig(max_concurrent_trials=4))
+    grid = tuner.fit()
+    assert len(grid) == 8
+    best = grid.get_best_result("loss", mode="min")
+    assert best.config["x"] == 3 and best.config["y"] == 0.0
+    assert best.metrics["loss"] == 0.0
+
+
+def test_returned_metrics_and_history(cluster):
+    def trainable(config):
+        for step in range(3):
+            tune.report({"step": step})
+        return {"final": config["k"] * 10}
+
+    grid = tune.Tuner(
+        trainable, param_space={"k": tune.grid_search([1, 2])}).fit()
+    best = grid.get_best_result("final", mode="max")
+    assert best.metrics["final"] == 20
+    assert len(best.history) == 3
+
+
+def test_trial_error_captured(cluster):
+    def trainable(config):
+        if config["x"] == 1:
+            raise ValueError("bad trial")
+        tune.report({"ok": config["x"]})
+
+    grid = tune.Tuner(
+        trainable, param_space={"x": tune.grid_search([0, 1])}).fit()
+    assert len(grid.errors) == 1
+    best = grid.get_best_result("ok", mode="max")
+    assert best.config["x"] == 0
+
+
+def test_random_sampling_num_samples(cluster):
+    def trainable(config):
+        tune.report({"v": config["lr"]})
+
+    grid = tune.Tuner(
+        trainable,
+        param_space={"lr": tune.uniform(0, 1)},
+        tune_config=tune.TuneConfig(num_samples=6, seed=1)).fit()
+    assert len(grid) == 6
