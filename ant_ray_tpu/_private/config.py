@@ -48,6 +48,9 @@ class Config:
     # Arena read pins auto-expire after this long if the reader never
     # sends ReadDone (crashed client), so the slot becomes evictable.
     read_pin_ttl_s: float = 120.0
+    # EnsureLocal fails fast after this many seconds with an empty
+    # holder list, handing control to lineage reconstruction.
+    pull_no_holders_grace_s: float = 2.0
     # LRU-evict unpinned objects when the store is this full.
     object_store_high_watermark: float = 0.8
 

@@ -56,6 +56,15 @@ from ant_ray_tpu.object_ref import ObjectRef, set_refcount_hook
 logger = logging.getLogger(__name__)
 
 
+class _AllCopiesLost(Exception):
+    """Internal: EnsureLocal reported an empty holder list — every copy
+    of the plasma object is gone; try lineage reconstruction."""
+
+    def __init__(self, oid: ObjectID):
+        super().__init__(oid.hex())
+        self.oid = oid
+
+
 @dataclass
 class _ActorSubmitState:
     """Per-actor ordered submission queue
@@ -95,6 +104,7 @@ class ClusterRuntime(CoreRuntime):
             "GetObjectStatus": self._handle_get_object_status,
             "BorrowAdd": self._handle_borrow_add,
             "BorrowRemove": self._handle_borrow_remove,
+            "ReconstructObject": self._handle_reconstruct_object,
         })
         self.address = self.server.start()
 
@@ -112,6 +122,13 @@ class ClusterRuntime(CoreRuntime):
 
         # ---- function/class export
         self._fetch_cache: dict[str, Any] = {}        # kv key -> callable/class
+
+        # ---- lineage (owner side): plasma return -> producing TaskSpec,
+        # re-executed when every copy of the object is lost
+        # (ref: TaskManager lineage + ObjectRecoveryManager,
+        #  src/ray/core_worker/object_recovery_manager.h:98-108)
+        self._lineage: dict[ObjectID, TaskSpec] = {}
+        self._reconstructions: dict[TaskID, asyncio.Future] = {}
 
         self._actor_states: dict[ActorID, _ActorSubmitState] = {}
         self._actor_meta_cache: dict[ActorID, dict] = {}
@@ -202,6 +219,7 @@ class ClusterRuntime(CoreRuntime):
                 and self._pins.get(oid, 0) == 0):
             entry = self.memory.get_entry(oid)
             self.memory.delete(oid)
+            self._lineage.pop(oid, None)  # freed ⇒ lineage released
             if entry is not None and entry[0] == "plasma":
                 self._send_oneway(self.gcs_address, "FreeObject",
                                   {"object_id": oid})
@@ -368,8 +386,11 @@ class ClusterRuntime(CoreRuntime):
                             timeout: float | None) -> memoryview:
         reply = await self._node.call_async(
             "EnsureLocal",
-            {"object_id": oid, "timeout": timeout if timeout else 60.0},
+            {"object_id": oid, "timeout": timeout if timeout else 60.0,
+             "fail_fast_after": global_config().pull_no_holders_grace_s},
             timeout=-1)
+        if reply.get("no_holders"):
+            raise _AllCopiesLost(oid)
         if reply.get("timeout"):
             raise exceptions.GetTimeoutError(
                 f"object {oid.hex()[:12]} not available in time")
@@ -390,33 +411,58 @@ class ClusterRuntime(CoreRuntime):
         return open_object(reply["path"])
 
     async def _get_one(self, ref: ObjectRef, timeout: float | None):
-        """Resolve one ref to (kind, data): kind ∈ value|error."""
+        """Resolve one ref to (kind, data): kind ∈ value|error.
+
+        The outer loop exists for lineage recovery: after a
+        reconstruction round the entry is re-resolved from scratch, so a
+        replay that *errored* surfaces the task error instead of chasing
+        a plasma object that will never reappear."""
         oid = ref.id
-        if self.memory.is_owned(oid):
-            try:
-                kind, value = await self.memory.wait_async(oid, timeout)
-            except asyncio.TimeoutError as e:
-                raise exceptions.GetTimeoutError(
-                    f"get() timed out on {oid.hex()[:12]}") from e
-        else:
-            owner = self._clients.get(ref.owner_address)
-            kind, value = await owner.call_async(
-                "GetObject", {"object_id": oid, "timeout": timeout},
-                timeout=-1 if timeout is None else timeout + 5)
-            if kind == "pending":
-                raise exceptions.GetTimeoutError(
-                    f"get() timed out on {oid.hex()[:12]}")
-            if kind == "unknown":
-                raise exceptions.ObjectLostError(
-                    oid, f"owner {ref.owner_address} does not know this object")
-        if kind == "plasma":
-            view = await self._fetch_plasma(oid, timeout)
-            return ("value", self._deserialize_payload(view))
-        if kind == "inline":
-            return ("value", self._deserialize_payload(value))
-        if kind == "error":
-            return ("error", self._deserialize_payload(value))
-        raise AssertionError(f"unexpected entry kind {kind}")
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        for _round in range(4):
+            remaining = (None if deadline is None
+                         else max(0.1, deadline - time.monotonic()))
+            if self.memory.is_owned(oid):
+                try:
+                    kind, value = await self.memory.wait_async(oid, remaining)
+                except asyncio.TimeoutError as e:
+                    raise exceptions.GetTimeoutError(
+                        f"get() timed out on {oid.hex()[:12]}") from e
+            else:
+                owner = self._clients.get(ref.owner_address)
+                kind, value = await owner.call_async(
+                    "GetObject", {"object_id": oid, "timeout": remaining},
+                    timeout=-1 if remaining is None else remaining + 5)
+                if kind == "pending":
+                    raise exceptions.GetTimeoutError(
+                        f"get() timed out on {oid.hex()[:12]}")
+                if kind == "unknown":
+                    raise exceptions.ObjectLostError(
+                        oid, f"owner {ref.owner_address} does not know "
+                        "this object")
+            if kind == "plasma":
+                try:
+                    view = await self._fetch_plasma(oid, remaining)
+                except _AllCopiesLost:
+                    if not await self._maybe_reconstruct(ref, remaining):
+                        raise exceptions.ObjectLostError(
+                            oid, "all copies were lost and the object has "
+                            "no lineage to reconstruct from") from None
+                    if deadline is not None and \
+                            time.monotonic() >= deadline:
+                        raise exceptions.GetTimeoutError(
+                            f"get() timed out on {oid.hex()[:12]} during "
+                            "reconstruction") from None
+                    continue  # re-resolve: replay may have stored an error
+                return ("value", self._deserialize_payload(view))
+            if kind == "inline":
+                return ("value", self._deserialize_payload(value))
+            if kind == "error":
+                return ("error", self._deserialize_payload(value))
+            raise AssertionError(f"unexpected entry kind {kind}")
+        raise exceptions.ObjectLostError(
+            oid, "object kept disappearing despite reconstruction")
 
     def get(self, refs: Sequence[ObjectRef], timeout: float | None) -> list:
         async def _gather():
@@ -618,6 +664,73 @@ class ClusterRuntime(CoreRuntime):
         for i, (kind, data) in enumerate(returns):
             oid = ObjectID.for_task_return(spec.task_id, i)
             self.memory.put(oid, kind, data)
+            # Normal-task plasma returns are reconstructible by lineage;
+            # actor-task replay is unsafe (state mutations) so actor
+            # returns (function_id == "") are excluded, as are tasks the
+            # user marked non-retryable (at-most-once side effects).
+            if kind == "plasma" and spec.function_id and spec.max_retries:
+                self._lineage[oid] = spec
+
+    # ------------------------------------------------- lineage recovery
+
+    async def _maybe_reconstruct(self, ref: ObjectRef,
+                                 timeout: float | None = None) -> bool:
+        """Recover a lost plasma object: owners re-execute the producing
+        task; borrowers ask the owner to (bounded by the caller's
+        remaining get() timeout)."""
+        oid = ref.id
+        if self.memory.is_owned(oid):
+            return await self._reconstruct_owned(oid)
+        try:
+            owner = self._clients.get(ref.owner_address)
+            return bool(await owner.call_async(
+                "ReconstructObject", {"object_id": oid},
+                timeout=-1 if timeout is None else timeout + 5))
+        except Exception as e:  # noqa: BLE001 — owner gone: unrecoverable
+            logger.warning("owner reconstruction RPC for %s failed: %s",
+                           oid.hex()[:8], e)
+            return False
+
+    async def _handle_reconstruct_object(self, payload):
+        oid = payload["object_id"]
+        if not self.memory.is_owned(oid):
+            return False
+        return await self._reconstruct_owned(oid)
+
+    async def _reconstruct_owned(self, oid: ObjectID) -> bool:
+        spec = self._lineage.get(oid)
+        if spec is None:
+            return False
+        fut = self._reconstructions.get(spec.task_id)
+        if fut is None:
+            # One re-execution covers all of the task's return objects;
+            # concurrent waiters share it.
+            fut = asyncio.ensure_future(self._reexecute_for_lineage(spec))
+            self._reconstructions[spec.task_id] = fut
+            fut.add_done_callback(
+                lambda _f: self._reconstructions.pop(spec.task_id, None))
+        try:
+            await asyncio.shield(fut)
+            return True
+        except Exception as e:  # noqa: BLE001
+            logger.warning("lineage re-execution of %s failed: %s",
+                           spec.function_name, e)
+            return False
+
+    async def _reexecute_for_lineage(self, spec: TaskSpec):
+        logger.info("reconstructing lost outputs of %s by lineage "
+                    "re-execution", spec.function_name)
+        last: Exception | None = None
+        for _attempt in range(3):
+            try:
+                reply = await self._lease_and_push(spec)
+                self._store_returns(spec, reply["returns"])
+                return
+            except (RpcConnectionError, exceptions.WorkerCrashedError) as e:
+                last = e
+        raise exceptions.ObjectLostError(
+            ObjectID.for_task_return(spec.task_id, 0),
+            f"lineage re-execution kept failing: {last}")
 
     def _store_error(self, spec: TaskSpec, err: Exception):
         payload = serialization.serialize_error(err).to_payload()

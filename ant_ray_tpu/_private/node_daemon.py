@@ -27,6 +27,10 @@ from ant_ray_tpu._private.specs import ACTOR_DEAD, ActorSpec, NodeInfo
 
 logger = logging.getLogger(__name__)
 
+
+class _HolderMiss(RuntimeError):
+    """A GCS-listed holder no longer has the object (stale location)."""
+
 IDLE, LEASED, ACTOR, STARTING = "idle", "leased", "actor", "starting"
 
 
@@ -60,7 +64,8 @@ class NodeManager:
         store_dir = os.path.join(
             "/dev/shm" if os.path.isdir("/dev/shm") else session_dir,
             f"art_{uuid.uuid4().hex[:8]}_{self.node_id.hex()[:8]}")
-        self.store = ObjectStore(store_dir, store_capacity)
+        self.store = ObjectStore(store_dir, store_capacity,
+                                 on_delete=self._on_store_delete)
 
         self._total = dict(resources)
         self._available = dict(resources)
@@ -611,6 +616,12 @@ class NodeManager:
         (ref: PullManager, src/ray/object_manager/pull_manager.h:50)."""
         object_id: ObjectID = payload["object_id"]
         deadline = time.monotonic() + payload.get("timeout", 60.0)
+        # After this many seconds of continuously-empty holder lists the
+        # request fails fast with {"no_holders"} so the owner can start
+        # lineage reconstruction instead of burning the full timeout
+        # (ref: ObjectRecoveryManager, object_recovery_manager.h:98).
+        fail_fast_after = payload.get("fail_fast_after")
+        no_holders_since: float | None = None
         located = self._locate_pinned(object_id)
         if located is not None:
             return located
@@ -625,6 +636,17 @@ class NodeManager:
             holders: list[NodeInfo] = await gcs.call_async(
                 "ObjectLocationsGet", {"object_id": object_id}, timeout=10)
             holders = [h for h in holders if h.node_id != self.node_id]
+            if not holders:
+                if fail_fast_after is not None:
+                    now = time.monotonic()
+                    if no_holders_since is None:
+                        no_holders_since = now
+                    elif now - no_holders_since >= fail_fast_after:
+                        located = self._locate_pinned(object_id)
+                        return located if located is not None else {
+                            "no_holders": True}
+            else:
+                no_holders_since = None
             for holder in holders:
                 try:
                     remote = self._clients.get(holder.address)
@@ -635,6 +657,11 @@ class NodeManager:
                             "object_id": object_id,
                             "node_id": self.node_id}, timeout=10)
                         return located
+                except _HolderMiss:
+                    # Stale location (holder evicted it): retract so the
+                    # next round sees an honest holder list.
+                    await gcs.oneway_async("ObjectLocationRemove", {
+                        "object_id": object_id, "node_id": holder.node_id})
                 except Exception as e:  # noqa: BLE001 — try next holder
                     logger.debug("pull of %s from %s failed: %s",
                                  object_id.hex()[:8], holder.address, e)
@@ -647,7 +674,7 @@ class NodeManager:
         info = await remote.call_async(
             "LocateObject", {"object_id": object_id}, timeout=10)
         if info is None:
-            raise RuntimeError("holder no longer has the object")
+            raise _HolderMiss("holder no longer has the object")
         size = info["size"]
 
         async def fetch_into(write):
@@ -701,12 +728,29 @@ class NodeManager:
             raise
         self.store.seal_file(object_id, tmp)
 
+    def _on_store_delete(self, object_id: ObjectID):
+        """Store eviction hook: retract this node's GCS location record
+        so pullers don't chase stale holders (and owners can trigger
+        lineage reconstruction promptly).  May fire on any thread."""
+        if self._stopping or not self.address:
+            return
+        try:
+            gcs = self._clients.get(self._gcs_address)
+            self._io.loop.call_soon_threadsafe(
+                asyncio.ensure_future,
+                gcs.oneway_async("ObjectLocationRemove", {
+                    "object_id": object_id, "node_id": self.node_id}))
+        except Exception:  # noqa: BLE001 — best-effort during teardown
+            pass
+
     async def _read_chunk(self, payload):
         return self.store.read_chunk(
             payload["object_id"], payload["offset"], payload["length"])
 
     async def _delete_object(self, payload):
-        self.store.delete(payload["object_id"])
+        # GCS-driven delete: its location record is already retracted,
+        # so skip the on_delete location-remove echo.
+        self.store.delete(payload["object_id"], notify=False)
         return True
 
 

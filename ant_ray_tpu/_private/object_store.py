@@ -57,11 +57,15 @@ class ObjectStore:
     """
 
     def __init__(self, directory: str, capacity_bytes: int,
-                 use_arena: bool = True):
+                 use_arena: bool = True, on_delete=None):
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
         self._capacity = capacity_bytes
         self._used = 0
+        # Called (outside no lock guarantees — keep it cheap/thread-safe)
+        # with each ObjectID removed by eviction or deletion, so the
+        # daemon can retract the node's GCS location record.
+        self._on_delete = on_delete
         self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
         self._lock = threading.RLock()
         self._arena = None
@@ -259,6 +263,8 @@ class ObjectStore:
         if entry is None:
             return
         self._used -= entry.size
+        if self._on_delete is not None and entry.sealed:
+            self._on_delete(object_id)
         if entry.offset is not None:
             try:
                 self._arena.free(entry.offset)
@@ -300,8 +306,17 @@ class ObjectStore:
             if entry is not None and entry.pin_count > 0:
                 entry.pin_count -= 1
 
-    def delete(self, object_id: ObjectID) -> None:
+    def delete(self, object_id: ObjectID, notify: bool = True) -> None:
+        """notify=False suppresses the on_delete hook — used for GCS-
+        driven deletes, where the location record is already gone."""
         with self._lock:
+            if not notify:
+                saved, self._on_delete = self._on_delete, None
+                try:
+                    self._delete_locked(object_id)
+                finally:
+                    self._on_delete = saved
+                return
             self._delete_locked(object_id)
 
     def list_objects(self) -> list[ObjectID]:
