@@ -1,0 +1,48 @@
+"""Offline batch inference over Datasets (capability mirror of the
+reference's ``ray.data.llm`` batch stages, ref: llm/_internal/batch/
+stages/vllm_engine_stage.py).
+
+``build_llm_processor`` returns a Dataset→Dataset callable that routes
+every block through actor-held engines, so model weights load once per
+actor rather than once per block.
+"""
+
+from __future__ import annotations
+
+from ant_ray_tpu.llm.sampling import SamplingParams
+
+
+def build_llm_processor(model="tiny", *, concurrency: int = 1,
+                        slots: int = 8, max_seq: int | None = None,
+                        sampling: SamplingParams | None = None,
+                        prompt_key: str = "prompt",
+                        output_key: str = "generated_text"):
+    """rows: dicts with ``prompt_key`` → adds ``output_key``."""
+    import ant_ray_tpu as art  # noqa: PLC0415
+
+    sampling = sampling or SamplingParams()
+
+    @art.remote
+    class _EngineActor:
+        def __init__(self):
+            from ant_ray_tpu.llm.engine import LLMEngine  # noqa: PLC0415
+
+            self.engine = LLMEngine(model, slots=slots, max_seq=max_seq)
+
+        def run(self, rows: list) -> list:
+            outs = self.engine.generate(
+                [r[prompt_key] for r in rows], sampling)
+            return [{**row, output_key: out.text}
+                    for row, out in zip(rows, outs)]
+
+    actors = [_EngineActor.remote() for _ in range(concurrency)]
+
+    def process(dataset):
+        blocks = dataset.materialize()._block_refs
+        out_refs = [actors[i % concurrency].run.remote(block)
+                    for i, block in enumerate(blocks)]
+        from ant_ray_tpu.data.dataset import Dataset  # noqa: PLC0415
+
+        return Dataset(out_refs)
+
+    return process

@@ -1,0 +1,72 @@
+"""Serve deployment wrapping the LLM engine (capability mirror of the
+reference's OpenAI-compatible serving layer, ref: llm/_internal/serve/
+deployments/ + serve/llm/).
+
+``build_llm_deployment`` returns a serve Application; each replica owns
+one engine and drains it per request batch.  The request/response dicts
+follow the OpenAI completions shape (``prompt`` → ``choices[].text``)
+so a client of the reference's `ray.serve.llm` finds the same surface.
+"""
+
+from __future__ import annotations
+
+from ant_ray_tpu.llm.engine import LLMEngine
+from ant_ray_tpu.llm.sampling import SamplingParams
+
+
+class LLMServer:
+    """Replica class: one engine per replica."""
+
+    def __init__(self, model="tiny", *, slots: int = 8,
+                 max_seq: int | None = None, tokenizer_name: str | None =
+                 None, seed: int = 0):
+        from ant_ray_tpu.llm.tokenizer import get_tokenizer  # noqa: PLC0415
+
+        self.engine = LLMEngine(
+            model, slots=slots, max_seq=max_seq,
+            tokenizer=get_tokenizer(tokenizer_name), seed=seed)
+
+    def __call__(self, request: dict) -> dict:
+        """OpenAI-completions-shaped request: {"prompt": str|list,
+        "max_tokens", "temperature", "top_k", "top_p", "stop_token_ids"}.
+        """
+        prompts = request.get("prompt", "")
+        many = isinstance(prompts, list) and prompts and not isinstance(
+            prompts[0], int)
+        batch = prompts if many else [prompts]
+        sampling = SamplingParams(
+            max_tokens=int(request.get("max_tokens", 64)),
+            temperature=float(request.get("temperature", 0.0)),
+            top_k=int(request.get("top_k", 0)),
+            top_p=float(request.get("top_p", 1.0)),
+            stop_token_ids=tuple(request.get("stop_token_ids", ())),
+            seed=request.get("seed"),
+        )
+        outs = self.engine.generate(batch, sampling)
+        return {
+            "object": "text_completion",
+            "choices": [
+                {"index": i, "text": o.text,
+                 "token_ids": o.token_ids,
+                 "finish_reason": o.finish_reason}
+                for i, o in enumerate(outs)
+            ],
+        }
+
+    def health(self):
+        return "ok"
+
+
+def build_llm_deployment(model="tiny", *, name: str = "llm",
+                         num_replicas: int = 1, slots: int = 8,
+                         max_seq: int | None = None,
+                         tokenizer_name: str | None = None,
+                         route_prefix: str | None = "/v1/completions"):
+    """Application for ``serve.run`` exposing the engine."""
+    from ant_ray_tpu import serve  # noqa: PLC0415
+
+    dep = serve.deployment(
+        LLMServer, name=name, num_replicas=num_replicas,
+        route_prefix=route_prefix)
+    return dep.bind(model, slots=slots, max_seq=max_seq,
+                    tokenizer_name=tokenizer_name)

@@ -175,12 +175,19 @@ def param_shardings(config: LlamaConfig, mesh) -> dict:
 # ---------------------------------------------------------------- forward
 
 def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
-            attn_impl: str = "auto", positions=None):
+            attn_impl: str = "auto", positions=None,
+            return_kv: bool = False, logits_at=None):
     """tokens: (batch, seq) int32 → logits (batch, seq, vocab) fp32.
 
     When ``mesh`` is provided, activations get sharding constraints
     (batch over dp/fsdp, seq over sp, heads over tp) and sequence-sharded
     meshes use ring attention.
+
+    ``return_kv=True`` additionally returns the per-layer K/V
+    (layers, b, s, kv_heads, hd) for cache insertion (serving prefill);
+    ``logits_at`` (traced scalar position) computes logits for that one
+    position only — (b, vocab) — skipping the full-sequence lm-head
+    matmul.
     """
     c = config
     cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta,
@@ -221,16 +228,24 @@ def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
         h = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
         gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
         x = x + (gated @ layer["w_down"]).astype(x.dtype)
-        return constrain_act(x, ("batch", "seq", "embed"))
+        x = constrain_act(x, ("batch", "seq", "embed"))
+        kv = (xk.astype(c.dtype), xv.astype(c.dtype)) if return_kv else None
+        return x, kv
 
     x = params["embed"][tokens].astype(c.dtype)
     x = constrain_act(x, ("batch", "seq", "embed"))
-    x, _ = lax.scan(lambda h_, layer: (block(h_, layer), None),
-                    x, params["layers"])
+    x, kv = lax.scan(block, x, params["layers"])
     x = rmsnorm(x, params["norm_f"], c.norm_eps)
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
-    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
-    return constrain_act(logits, ("batch", "seq", None))
+    if logits_at is not None:
+        x = jnp.take(x, logits_at, axis=1)          # (b, dim)
+        logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+    else:
+        logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+        logits = constrain_act(logits, ("batch", "seq", None))
+    if return_kv:
+        return logits, kv[0], kv[1]
+    return logits
 
 
 def loss_fn(params: dict, batch: dict, config: LlamaConfig, *, mesh=None,
@@ -255,6 +270,120 @@ def flops_per_token(config: LlamaConfig, seq_len: int) -> float:
     matmul = 6 * c.num_params()
     attn = 12 * c.n_layers * c.head_dim * c.n_heads * seq_len
     return matmul + attn
+
+
+# ------------------------------------------------------------- kv cache
+# Serving-path primitives (ref capability: llm/_internal/serve/engines/
+# vllm — re-designed TPU-first: dense per-slot KV slabs with static
+# shapes instead of paged indirection, because XLA wants static shapes
+# and HBM slabs keep the decode matmuls MXU-friendly).
+
+def init_kv_cache(config: LlamaConfig, slots: int,
+                  max_seq: int | None = None) -> dict:
+    """Per-slot dense KV slabs: (layers, slots, max_seq, kv_heads, hd)."""
+    c = config
+    ms = max_seq or c.max_seq
+    shape = (c.n_layers, slots, ms, c.n_kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, c.dtype),
+        "v": jnp.zeros(shape, c.dtype),
+        # tokens already written per slot (== next write position)
+        "length": jnp.zeros((slots,), jnp.int32),
+    }
+
+
+def prefill_into_cache(params: dict, tokens, cache: dict, slot,
+                       length, config: LlamaConfig, *, mesh=None):
+    """Run prefill on one padded prompt (1, s) and write its K/V into
+    ``slot``; returns (last-token logits (vocab,), new cache).
+
+    ``slot`` and ``length`` may be traced (one compile per prompt
+    bucket, none per slot); logits are computed for the last real token
+    only — the padded tail writes garbage K/V that decode masks (and
+    later overwrites)."""
+    last_pos = jnp.maximum(length - 1, 0)
+    logits, ks, vs = forward(params, tokens, config, mesh=mesh,
+                             return_kv=True, logits_at=last_pos)
+    cache = dict(cache)
+    slot = jnp.asarray(slot, jnp.int32)
+    cache["k"] = lax.dynamic_update_slice(
+        cache["k"], ks, (0, slot, 0, 0, 0))
+    cache["v"] = lax.dynamic_update_slice(
+        cache["v"], vs, (0, slot, 0, 0, 0))
+    cache["length"] = cache["length"].at[slot].set(length)
+    return logits[0], cache
+
+
+def decode_step(params: dict, last_tokens, cache: dict,
+                config: LlamaConfig):
+    """One token for every slot, attending against the KV cache.
+
+    last_tokens: (slots,) int32 — the most recent token per slot.
+    Returns (logits (slots, vocab) fp32, new cache with +1 lengths).
+    """
+    c = config
+    slots = last_tokens.shape[0]
+    max_seq = cache["k"].shape[2]
+    pos = cache["length"]                       # (slots,) write position
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta,
+                                jnp.float32)
+    group = c.n_heads // c.n_kv_heads
+
+    def block(x, scanned):
+        layer, ck, cv = scanned                 # ck/cv: (slots, ms, kvh, hd)
+        h = rmsnorm(x, layer["ln_attn"], c.norm_eps)
+        xq = (h @ layer["wq"]).reshape(slots, c.n_heads, c.head_dim)
+        xk = (h @ layer["wk"]).reshape(slots, c.n_kv_heads, c.head_dim)
+        xv = (h @ layer["wv"]).reshape(slots, c.n_kv_heads, c.head_dim)
+        # rope at each slot's own position
+        pc = cos[pos][:, None, :]               # (slots, 1, hd/2)
+        ps = sin[pos][:, None, :]
+        xq = _rope_one(xq, pc, ps)
+        xk = _rope_one(xk, pc, ps)
+        ck = ck.at[jnp.arange(slots), pos].set(xk.astype(ck.dtype))
+        cv = cv.at[jnp.arange(slots), pos].set(xv.astype(cv.dtype))
+        # GQA attention against the slab, masked beyond each length.
+        # bf16 inputs with fp32 accumulation keep the matmuls at full
+        # MXU rate without an fp32 copy of the slab (see ops/attention).
+        q = xq.reshape(slots, c.n_kv_heads, group, c.head_dim)
+        scores = jnp.einsum("skgd,stkd->skgt", q, ck,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(c.head_dim))
+        valid = jnp.arange(max_seq)[None, :] <= pos[:, None]  # (slots, ms)
+        scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("skgt,stkd->skgd", probs.astype(ck.dtype), cv,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(slots, c.n_heads * c.head_dim).astype(x.dtype)
+        x = x + (out @ layer["wo"]).astype(x.dtype)
+        h = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
+        gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+        x = x + (gated @ layer["w_down"]).astype(x.dtype)
+        return x, (ck, cv)
+
+    x = params["embed"][last_tokens].astype(c.dtype)   # (slots, dim)
+    x, (new_k, new_v) = lax.scan(
+        block, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["norm_f"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+    # Clamp so idle slots (which keep stepping) never index past the
+    # slab; their scatter writes drop out of bounds harmlessly.
+    new_len = jnp.minimum(cache["length"] + 1, jnp.int32(max_seq))
+    cache = {"k": new_k, "v": new_v, "length": new_len}
+    return logits, cache
+
+
+def _rope_one(x, cos, sin):
+    """Rotate (slots, heads, hd) at per-slot positions (cos/sin already
+    gathered: (slots, 1, hd/2))."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    xf1 = x1.astype(jnp.float32)
+    xf2 = x2.astype(jnp.float32)
+    return jnp.concatenate(
+        [xf1 * cos - xf2 * sin, xf2 * cos + xf1 * sin],
+        axis=-1).astype(x.dtype)
 
 
 # ---------------------------------------------------------------- generate
