@@ -279,15 +279,20 @@ class ClusterRuntime(CoreRuntime):
         CPython reuses addresses of collected objects, which would hand a
         new function a dead function's export key).
         """
-        key = getattr(obj, "__art_export_key__", None)
-        if key is not None:
-            return key
+        memo = getattr(obj, "__art_export_key__", None)
+        if memo is not None:
+            memo_cluster, key = memo
+            # The memo is only valid for the cluster it was exported to —
+            # a driver that init()s a second cluster must re-upload or
+            # workers there will miss the definition.
+            if memo_cluster == self.gcs_address:
+                return key
         blob = serialization.dumps_code(obj)
         key = f"{kind}:{hashlib.sha256(blob).hexdigest()[:24]}"
         self._gcs.call("KVPut", {"key": key, "value": blob,
                                  "overwrite": False}, retries=3)
         try:
-            obj.__art_export_key__ = key
+            obj.__art_export_key__ = (self.gcs_address, key)
         except (AttributeError, TypeError):
             pass  # unmemoizable (e.g. builtin): re-pickle next time
         return key

@@ -1,0 +1,15 @@
+"""ant_ray_tpu.rllib — distributed reinforcement learning.
+
+Capability mirror of the reference's RLlib architecture (ref:
+rllib/algorithms/algorithm.py, rllib/env/env_runner_group.py,
+rllib/core/learner/learner_group.py:101): sampling **EnvRunner actors**
+feed a **LearnerGroup** whose update step is a jitted JAX function —
+the learner's DDP gradient averaging becomes a mesh/`pmean` program on
+TPU instead of torch DDP.
+"""
+
+from ant_ray_tpu.rllib.algorithm import Algorithm, PPOConfig
+from ant_ray_tpu.rllib.env import CartPoleEnv, make_env, register_env
+
+__all__ = ["Algorithm", "CartPoleEnv", "PPOConfig", "make_env",
+           "register_env"]

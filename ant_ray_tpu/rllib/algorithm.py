@@ -1,0 +1,272 @@
+"""Algorithm façade: config builder → EnvRunner actors + learner
+(ref: rllib/algorithms/algorithm.py config/build/train pattern,
+EnvRunnerGroup rllib/env/env_runner_group.py, LearnerGroup
+rllib/core/learner/learner_group.py:101).
+
+``Algorithm.train()`` is one iteration: gather rollouts from the
+runner actors in parallel, compute GAE, run minibatch PPO epochs in the
+jitted learner step, broadcast new weights back to the runners.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class PPOConfig:
+    env: str = "CartPole-v1"
+    num_env_runners: int = 2
+    num_envs_per_runner: int = 8
+    rollout_fragment_length: int = 64
+    lr: float = 3e-4
+    gamma: float = 0.99
+    lambda_: float = 0.95
+    clip_param: float = 0.2
+    vf_loss_coeff: float = 0.5
+    entropy_coeff: float = 0.01
+    num_epochs: int = 4
+    minibatch_size: int = 256
+    hidden: int = 64
+    seed: int = 0
+    extra: dict = field(default_factory=dict)
+
+    # builder-style mutators (RLlib API shape)
+    def environment(self, env: str) -> "PPOConfig":
+        return replace(self, env=env)
+
+    def env_runners(self, *, num_env_runners: int | None = None,
+                    num_envs_per_env_runner: int | None = None,
+                    rollout_fragment_length: int | None = None
+                    ) -> "PPOConfig":
+        out = self
+        if num_env_runners is not None:
+            out = replace(out, num_env_runners=num_env_runners)
+        if num_envs_per_env_runner is not None:
+            out = replace(out, num_envs_per_runner=num_envs_per_env_runner)
+        if rollout_fragment_length is not None:
+            out = replace(out,
+                          rollout_fragment_length=rollout_fragment_length)
+        return out
+
+    def training(self, **kwargs) -> "PPOConfig":
+        unknown = [k for k in kwargs
+                   if k not in type(self).__dataclass_fields__]
+        if unknown:
+            raise ValueError(
+                f"unknown training option(s) {unknown}; valid: "
+                f"{sorted(type(self).__dataclass_fields__)}")
+        return replace(self, **kwargs)
+
+    def build(self) -> "Algorithm":
+        return Algorithm(self)
+
+
+class _EnvRunner:
+    """Actor: owns env copies + a policy snapshot; samples fragments
+    (ref: rllib/env/single_agent_env_runner.py)."""
+
+    def __init__(self, config: PPOConfig, index: int, env_ctor=None):
+        from ant_ray_tpu.rllib import env as env_mod  # noqa: PLC0415
+        from ant_ray_tpu.rllib import ppo  # noqa: PLC0415
+
+        self._ppo = ppo
+        self.config = config
+        # env_ctor travels from the driver so custom register_env()
+        # entries work inside actor processes too.
+        ctor = env_ctor or env_mod.resolve_env(config.env)
+        self.env = ctor(num_envs=config.num_envs_per_runner,
+                        seed=config.seed * 1000 + index)
+        self.obs = self.env.reset()
+        self.params = None
+        self._key = ppo.jax.random.PRNGKey(config.seed * 77 + index)
+        self._episode_returns = np.zeros(
+            config.num_envs_per_runner, np.float32)
+        self._completed: list[float] = []
+
+    def set_weights(self, params):
+        self.params = params
+
+    def sample(self) -> dict:
+        """One fragment: (T, N) arrays + completed-episode returns."""
+        ppo, cfg = self._ppo, self.config
+        T = cfg.rollout_fragment_length
+        N = cfg.num_envs_per_runner
+        obs_buf = np.zeros((T, N, self.env.obs_dim), np.float32)
+        act_buf = np.zeros((T, N), np.int64)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        for t in range(T):
+            self._key, sub = ppo.jax.random.split(self._key)
+            actions, logp, vals = ppo.act(self.params, self.obs, sub)
+            actions = np.asarray(actions)
+            obs_buf[t] = self.obs
+            act_buf[t] = actions
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(vals)
+            self.obs, raw_reward, done, truncated, final_obs = \
+                self.env.step(actions)
+            reward = raw_reward
+            if truncated.any():
+                # Time-limit truncation is not termination: bootstrap
+                # the cut-off return with V(final state) so the value
+                # targets stay consistent (ref: RLlib truncation
+                # handling in GAE).
+                boot = np.asarray(ppo.value(self.params, final_obs))
+                reward = raw_reward + cfg.gamma * boot * truncated
+            rew_buf[t] = reward
+            done_buf[t] = done
+            self._episode_returns += raw_reward
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._episode_returns[i]))
+                self._episode_returns[i] = 0.0
+        last_values = np.asarray(ppo.value(self.params, self.obs))
+        completed, self._completed = self._completed, []
+        return {"obs": obs_buf, "actions": act_buf, "logp": logp_buf,
+                "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+                "last_values": last_values,
+                "episode_returns": completed}
+
+
+class Algorithm:
+    """Driver-side controller (one learner; EnvRunners as actors when a
+    cluster is up, inline otherwise — mirroring RLlib local mode)."""
+
+    def __init__(self, config: PPOConfig):
+        from ant_ray_tpu.rllib import env as env_mod  # noqa: PLC0415
+        from ant_ray_tpu.rllib import ppo  # noqa: PLC0415
+        import optax  # noqa: PLC0415
+
+        self._ppo = ppo
+        self.config = config
+        probe = env_mod.make_env(config.env, num_envs=1)
+        self._obs_dim, self._n_actions = probe.obs_dim, probe.n_actions
+        key = ppo.jax.random.PRNGKey(config.seed)
+        self.params = ppo.init_policy(key, self._obs_dim, self._n_actions,
+                                      config.hidden)
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(self.params)
+        self._update = ppo.make_update_step(
+            self._optimizer, clip=config.clip_param,
+            vf_coeff=config.vf_loss_coeff,
+            ent_coeff=config.entropy_coeff)
+        self._iteration = 0
+        self._rng = np.random.RandomState(config.seed)
+
+        self._runners = self._make_runners()
+
+    def _make_runners(self):
+        import ant_ray_tpu as art  # noqa: PLC0415
+
+        from ant_ray_tpu.rllib import env as env_mod  # noqa: PLC0415
+
+        cfg = self.config
+        ctor = env_mod.resolve_env(cfg.env)
+        if art.is_initialized():
+            runner_cls = art.remote(_EnvRunner)
+            return [runner_cls.remote(cfg, i, ctor)
+                    for i in range(cfg.num_env_runners)]
+        return [_EnvRunner(cfg, i, ctor)
+                for i in range(cfg.num_env_runners)]
+
+    def _runner_call(self, method: str, *args):
+        import ant_ray_tpu as art  # noqa: PLC0415
+
+        if art.is_initialized():
+            return art.get([getattr(r, method).remote(*args)
+                            for r in self._runners], timeout=600)
+        return [getattr(r, method)(*args) for r in self._runners]
+
+    def train(self) -> dict:
+        """One iteration; returns an RLlib-shaped result dict."""
+        ppo, cfg = self._ppo, self.config
+        self._runner_call("set_weights", self.params)
+        samples = self._runner_call("sample")
+
+        # concat runner fragments along the env axis: (T, N_total)
+        def cat(key_):
+            return np.concatenate([s[key_] for s in samples], axis=1)
+
+        rewards, values, dones = cat("rewards"), cat("values"), cat("dones")
+        last_values = np.concatenate(
+            [s["last_values"] for s in samples], axis=0)
+        adv, returns = ppo.compute_gae(
+            rewards, values, dones, last_values,
+            gamma=cfg.gamma, lam=cfg.lambda_)
+        flat = {
+            "obs": cat("obs").reshape(-1, self._obs_dim),
+            "actions": cat("actions").reshape(-1),
+            "logp_old": cat("logp").reshape(-1),
+            "advantages": adv.reshape(-1),
+            "returns": returns.reshape(-1),
+        }
+        n = flat["obs"].shape[0]
+        metrics = {}
+        for _epoch in range(cfg.num_epochs):
+            perm = self._rng.permutation(n)
+            for lo in range(0, n, cfg.minibatch_size):
+                idx = perm[lo:lo + cfg.minibatch_size]
+                if len(idx) < cfg.minibatch_size and n > cfg.minibatch_size:
+                    continue  # ragged tail would recompile the step
+                batch = {k: ppo.jnp.asarray(v[idx])
+                         for k, v in flat.items()}
+                self.params, self._opt_state, metrics = self._update(
+                    self.params, self._opt_state, batch)
+
+        episode_returns = [r for s in samples
+                           for r in s["episode_returns"]]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(episode_returns))
+                                    if episode_returns else float("nan")),
+            "num_episodes": len(episode_returns),
+            "num_env_steps_sampled": n,
+            "learner": {k: float(v) for k, v in metrics.items()},
+        }
+
+    def get_weights(self):
+        """Host copy — the jitted update donates the live param buffers
+        each minibatch, so handing out references would leave callers
+        with deleted arrays on TPU."""
+        return self._ppo.jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, params):
+        self.params = self._ppo.jax.tree.map(
+            self._ppo.jnp.asarray, params)
+
+    def save(self, path: str):
+        import pickle  # noqa: PLC0415
+
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params,
+                         "opt_state": self._opt_state,
+                         "iteration": self._iteration,
+                         "config": self.config}, f)
+
+    @classmethod
+    def restore(cls, path: str) -> "Algorithm":
+        import pickle  # noqa: PLC0415
+
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        algo = cls(state["config"])
+        algo.params = state["params"]
+        algo._opt_state = state["opt_state"]
+        algo._iteration = state["iteration"]
+        return algo
+
+    def stop(self):
+        import ant_ray_tpu as art  # noqa: PLC0415
+
+        if art.is_initialized():
+            for r in self._runners:
+                try:
+                    art.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+        self._runners = []

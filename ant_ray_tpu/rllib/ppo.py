@@ -1,0 +1,113 @@
+"""PPO core: policy/value nets, GAE, clipped-surrogate update — all
+jitted JAX (ref: rllib/algorithms/ppo/; the torch learner's update
+becomes one compiled function, mesh-shardable over a data axis).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ant_ray_tpu._private.jax_utils import import_jax
+
+jax = import_jax()
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+
+def init_policy(key, obs_dim: int, n_actions: int, hidden: int = 64):
+    """Separate policy/value MLP towers (RLlib's default fcnet)."""
+    def dense(k, fan_in, fan_out):
+        w = jax.random.normal(k, (fan_in, fan_out), jnp.float32)
+        return {"w": w * np.sqrt(2.0 / fan_in),
+                "b": jnp.zeros((fan_out,), jnp.float32)}
+
+    ks = jax.random.split(key, 6)
+    return {
+        "pi": [dense(ks[0], obs_dim, hidden), dense(ks[1], hidden, hidden),
+               dense(ks[2], hidden, n_actions)],
+        "vf": [dense(ks[3], obs_dim, hidden), dense(ks[4], hidden, hidden),
+               dense(ks[5], hidden, 1)],
+    }
+
+
+def _mlp(layers, x):
+    for layer in layers[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return x @ last["w"] + last["b"]
+
+
+def policy_logits(params, obs):
+    return _mlp(params["pi"], obs)
+
+
+def value(params, obs):
+    return _mlp(params["vf"], obs)[..., 0]
+
+
+@functools.partial(jax.jit, static_argnames=())
+def act(params, obs, key):
+    """Sample actions + logp + value for a batch of observations."""
+    logits = policy_logits(params, obs)
+    actions = jax.random.categorical(key, logits)
+    logp = jax.nn.log_softmax(logits)[
+        jnp.arange(obs.shape[0]), actions]
+    return actions, logp, value(params, obs)
+
+
+def compute_gae(rewards, values, dones, last_values, *, gamma: float,
+                lam: float):
+    """Generalized advantage estimation over a (T, N) rollout (numpy —
+    rollouts live on host)."""
+    T, N = rewards.shape
+    adv = np.zeros((T, N), np.float32)
+    last_gae = np.zeros(N, np.float32)
+    next_value = last_values
+    for t in range(T - 1, -1, -1):
+        nonterminal = 1.0 - dones[t]
+        delta = rewards[t] + gamma * next_value * nonterminal - values[t]
+        last_gae = delta + gamma * lam * nonterminal * last_gae
+        adv[t] = last_gae
+        next_value = values[t]
+    returns = adv + values
+    return adv, returns
+
+
+def ppo_loss(params, batch, *, clip: float, vf_coeff: float,
+             ent_coeff: float):
+    logits = policy_logits(params, batch["obs"])
+    logp_all = jax.nn.log_softmax(logits)
+    logp = logp_all[jnp.arange(batch["obs"].shape[0]), batch["actions"]]
+    ratio = jnp.exp(logp - batch["logp_old"])
+    adv = batch["advantages"]
+    adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+    surrogate = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv)
+    pi_loss = -surrogate.mean()
+    vf_loss = jnp.mean((value(params, batch["obs"])
+                        - batch["returns"]) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                   "entropy": entropy}
+
+
+def make_update_step(optimizer, *, clip: float, vf_coeff: float,
+                     ent_coeff: float, axis_name: str | None = None):
+    """Jitted minibatch SGD step; with ``axis_name`` the gradients are
+    pmean-averaged across learner shards (DDP → collective)."""
+
+    def step(params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            ppo_loss, has_aux=True)(params, batch, clip=clip,
+                                    vf_coeff=vf_coeff, ent_coeff=ent_coeff)
+        if axis_name is not None:
+            grads = jax.lax.pmean(grads, axis_name)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        metrics = dict(metrics, total_loss=loss)
+        return params, opt_state, metrics
+
+    return jax.jit(step, donate_argnums=(0, 1))

@@ -1,0 +1,114 @@
+"""RLlib-layer tests: env contract, GAE, PPO learning progress, actor
+env-runners, checkpoint round-trip (mirrors the reference's
+rllib test tiers at unit scale)."""
+
+import numpy as np
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu.rllib import CartPoleEnv, PPOConfig
+from ant_ray_tpu.rllib import ppo
+
+
+def test_vector_env_contract():
+    env = CartPoleEnv(num_envs=5, seed=1)
+    obs = env.reset()
+    assert obs.shape == (5, 4)
+    for _ in range(10):
+        obs, reward, done, truncated, final_obs = env.step(
+            np.ones(5, np.int64))
+        assert obs.shape == (5, 4)
+        assert reward.shape == (5,)
+        assert done.dtype == bool
+        assert final_obs.shape == (5, 4)
+        assert not truncated.any()  # too early for time limits
+
+
+def test_gae_matches_manual():
+    rewards = np.array([[1.0], [1.0], [1.0]], np.float32)
+    values = np.array([[0.5], [0.5], [0.5]], np.float32)
+    dones = np.zeros((3, 1), np.float32)
+    last = np.array([0.5], np.float32)
+    adv, ret = ppo.compute_gae(rewards, values, dones, last,
+                               gamma=0.9, lam=1.0)
+    # With lam=1 this is discounted-return minus value.
+    expected_ret3 = 1.0 + 0.9 * 0.5
+    expected_ret2 = 1.0 + 0.9 * expected_ret3 - 0.9 * 0.5 + 0.9 * 0.5
+    assert ret.shape == (3, 1)
+    assert np.isclose(ret[2, 0], expected_ret3, atol=1e-5)
+    assert np.isclose(ret[1, 0], expected_ret2, atol=1e-5)
+
+
+def test_ppo_learns_cartpole_inline():
+    algo = PPOConfig().environment("CartPole-v1").env_runners(
+        num_env_runners=2, num_envs_per_env_runner=8,
+        rollout_fragment_length=128,
+    ).training(lr=1e-3, num_epochs=6, minibatch_size=256,
+               seed=0).build()
+    first = None
+    best = -np.inf
+    for _ in range(12):
+        result = algo.train()
+        ret = result["episode_return_mean"]
+        if first is None and not np.isnan(ret):
+            first = ret
+        if not np.isnan(ret):
+            best = max(best, ret)
+    assert first is not None, "no episodes completed"
+    assert best > first + 20, (first, best)  # clear learning signal
+    assert np.isfinite(result["learner"]["total_loss"])
+
+
+def test_env_runners_as_actors(shutdown_only):
+    art.init(num_cpus=3)
+    algo = PPOConfig().env_runners(
+        num_env_runners=2, num_envs_per_env_runner=4,
+        rollout_fragment_length=16).training(seed=3).build()
+    result = algo.train()
+    assert result["num_env_steps_sampled"] == 16 * 8
+    algo.stop()
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    algo = PPOConfig().env_runners(
+        num_env_runners=1, num_envs_per_env_runner=2,
+        rollout_fragment_length=8).training(seed=5).build()
+    algo.train()
+    path = str(tmp_path / "ckpt.pkl")
+    algo.save(path)
+    restored = type(algo).restore(path)
+    a = ppo.jax.tree.leaves(algo.get_weights())
+    b = ppo.jax.tree.leaves(restored.get_weights())
+    assert all(np.allclose(x, y) for x, y in zip(a, b))
+    assert restored._iteration == 1
+
+
+def test_custom_env_registration_reaches_actors(shutdown_only):
+    art.init(num_cpus=3)
+    from ant_ray_tpu.rllib import register_env
+
+    class TinyCartPole(CartPoleEnv):
+        max_steps = 20
+
+    register_env("TinyCartPole", TinyCartPole)
+    algo = PPOConfig().environment("TinyCartPole").env_runners(
+        num_env_runners=2, num_envs_per_env_runner=2,
+        rollout_fragment_length=8).training(seed=9).build()
+    result = algo.train()  # would ValueError in the actor if name-based
+    assert result["num_env_steps_sampled"] == 8 * 4
+    algo.stop()
+
+
+def test_training_rejects_unknown_kwargs():
+    with pytest.raises(ValueError, match="unknown training option"):
+        PPOConfig().training(entropy_coef=0.0)
+
+
+def test_get_weights_survives_training():
+    algo = PPOConfig().env_runners(
+        num_env_runners=1, num_envs_per_env_runner=2,
+        rollout_fragment_length=8).training(seed=2).build()
+    w = algo.get_weights()
+    algo.train()  # donation must not invalidate the handed-out copy
+    assert all(np.isfinite(x).all() for x in
+               ppo.jax.tree.leaves(w))
