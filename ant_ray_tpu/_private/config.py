@@ -51,6 +51,9 @@ class Config:
     # EnsureLocal fails fast after this many seconds with an empty
     # holder list, handing control to lineage reconstruction.
     pull_no_holders_grace_s: float = 2.0
+    # Start the dashboard head (REST state API + /metrics + job server)
+    # with the cluster.
+    include_dashboard: bool = True
     # LRU-evict unpinned objects when the store is this full.
     object_store_high_watermark: float = 0.8
 

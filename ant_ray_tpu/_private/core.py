@@ -133,6 +133,7 @@ class ClusterRuntime(CoreRuntime):
         self._actor_states: dict[ActorID, _ActorSubmitState] = {}
         self._actor_meta_cache: dict[ActorID, dict] = {}
         self._pg_bundle_cache: dict = {}  # pg_id -> [node addresses]
+        self._renv_cache: dict = {}       # runtime_env -> wire form
         self._arena_client = ArenaClient()
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
@@ -155,15 +156,22 @@ class ClusterRuntime(CoreRuntime):
             store_dir = boot["store_dir"]
             owned = boot["processes"]
             session_dir = boot["session_dir"]
+            dashboard_url = boot.get("dashboard_url", "")
         else:
             gcs_address = address.removeprefix("art://")
             node_address, store_dir = services.find_local_node(gcs_address)
             owned = []
             session_dir = ""
+            dashboard_url = ""
 
         runtime = cls(role="driver", job_id=job_id, gcs_address=gcs_address,
                       node_address=node_address, store_dir=store_dir,
                       owned_processes=owned, session_dir=session_dir)
+        if not dashboard_url:
+            blob = runtime._gcs.call("KVGet", {"key": "dashboard_url"},
+                                     retries=3)
+            dashboard_url = blob.decode() if blob else ""
+        runtime.dashboard_url = dashboard_url
         runtime._gcs.call(
             "RegisterJob",
             {"job_id": job_id, "driver_address": runtime.address},
@@ -565,11 +573,29 @@ class ClusterRuntime(CoreRuntime):
                                 else None),
             placement_group_bundle_index=max(
                 options.placement_group_bundle_index, 0),
+            runtime_env=self._package_runtime_env(options.runtime_env),
         )
         pinned = list(ser.contained_refs)
         asyncio.run_coroutine_threadsafe(
             self._run_normal_task(spec, pinned), self._io.loop)
         return return_refs[0] if num_returns == 1 else return_refs
+
+    def _package_runtime_env(self, runtime_env: dict | None):
+        """Stage a runtime env into GCS KV (cached per content)."""
+        if not runtime_env:
+            return None
+        from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
+
+        cache_key = renv.content_fingerprint(runtime_env)
+        wire = self._renv_cache.get(cache_key)
+        if wire is None:
+            wire = renv.package(
+                runtime_env,
+                lambda key, blob: self._gcs.call(
+                    "KVPut", {"key": key, "value": blob,
+                              "overwrite": False}, retries=3))
+            self._renv_cache[cache_key] = wire
+        return wire
 
     async def _run_normal_task(self, spec: TaskSpec, pinned_args):
         try:
@@ -630,7 +656,8 @@ class ClusterRuntime(CoreRuntime):
     async def _lease_and_push(self, spec: TaskSpec) -> dict:
         """Lease a worker (following spillback redirects), push the task,
         return the worker reply (ref: NormalTaskSubmitter::SubmitTask)."""
-        lease_payload = {"resources": spec.resources}
+        lease_payload = {"resources": spec.resources,
+                         "runtime_env": spec.runtime_env}
         if spec.placement_group_id is not None:
             node = await self._resolve_bundle_node(
                 spec.placement_group_id, spec.placement_group_bundle_index)
@@ -775,6 +802,7 @@ class ClusterRuntime(CoreRuntime):
                                 else None),
             placement_group_bundle_index=max(
                 options.placement_group_bundle_index, 0),
+            runtime_env=self._package_runtime_env(options.runtime_env),
         )
         reply = self._gcs.call("CreateActor", spec, retries=3)
         if "error" in reply:

@@ -1,0 +1,356 @@
+"""Dashboard head process: REST state API, Prometheus /metrics exporter,
+and the job-submission server.
+
+Capability mirror of the reference's dashboard head + job manager
+(ref: python/ray/dashboard/head.py:49, dashboard/modules/job/
+job_manager.py:62, _private/metrics_agent.py Prometheus export), as one
+aiohttp process colocated with the head node.  Endpoints:
+
+    GET  /api/nodes | /api/actors | /api/placement_groups | /api/objects
+    GET  /api/cluster_status
+    GET  /metrics                         (Prometheus text format)
+    POST /api/jobs                        {entrypoint, runtime_env, ...}
+    GET  /api/jobs            /api/jobs/{id}   /api/jobs/{id}/logs
+    POST /api/jobs/{id}/stop
+
+Jobs are driver subprocesses launched with ART_ADDRESS pointing at this
+cluster (the reference's job supervisor pattern without the wrapper
+actor — the dashboard process owns supervision).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import signal
+import subprocess
+import threading
+import time
+import uuid
+
+from ant_ray_tpu._private.protocol import ClientPool
+
+
+class JobManager:
+    """Tracks driver subprocesses (ref: job_manager.py:62)."""
+
+    def __init__(self, gcs_address: str, session_dir: str):
+        self._gcs_address = gcs_address
+        self._session_dir = session_dir
+        self._jobs: dict[str, dict] = {}
+        self._procs: dict[str, subprocess.Popen] = {}
+        # aiohttp dispatches handlers onto executor threads — every
+        # _jobs/_procs mutation must hold this.
+        self._lock = threading.Lock()
+
+    def submit(self, entrypoint: str, runtime_env: dict | None = None,
+               submission_id: str | None = None,
+               metadata: dict | None = None) -> str:
+        from ant_ray_tpu._private.runtime_env import (  # noqa: PLC0415
+            ensure_framework_on_pythonpath)
+
+        job_id = submission_id or f"art-job-{uuid.uuid4().hex[:10]}"
+        with self._lock:
+            if job_id in self._jobs:
+                raise ValueError(f"job {job_id} already exists")
+            # reserve the id before the (slow) spawn so a concurrent
+            # duplicate submit can't double-launch
+            self._jobs[job_id] = self._record(job_id, entrypoint,
+                                              "PENDING",
+                                              metadata=metadata)
+        log_path = os.path.join(self._session_dir, "logs",
+                                f"job-{job_id}.log")
+        os.makedirs(os.path.dirname(log_path), exist_ok=True)
+        env = dict(os.environ)
+        env["ART_ADDRESS"] = self._gcs_address
+        # Drivers must be able to import the framework even when it is
+        # run from a checkout rather than pip-installed.
+        ensure_framework_on_pythonpath(env)
+        renv = runtime_env or {}
+        env.update({str(k): str(v)
+                    for k, v in (renv.get("env_vars") or {}).items()})
+        cwd = renv.get("working_dir") or None
+        log_file = open(log_path, "ab")
+        try:
+            proc = subprocess.Popen(
+                entrypoint, shell=True, env=env, cwd=cwd,
+                stdout=log_file, stderr=subprocess.STDOUT,
+                start_new_session=True)
+        except OSError as e:
+            log_file.close()
+            with self._lock:
+                self._jobs[job_id].update(status="FAILED",
+                                          message=str(e))
+            return job_id
+        log_file.close()
+        with self._lock:
+            self._procs[job_id] = proc
+            self._jobs[job_id].update(status="RUNNING")
+        return job_id
+
+    @staticmethod
+    def _record(job_id, entrypoint, status, message="", metadata=None):
+        return {"submission_id": job_id, "entrypoint": entrypoint,
+                "status": status, "message": message,
+                "metadata": metadata or {},
+                "start_time": time.time(), "end_time": None}
+
+    def _refresh_locked(self, job_id: str):
+        job = self._jobs.get(job_id)
+        proc = self._procs.get(job_id)
+        if job is None or proc is None or job["status"] not in (
+                "RUNNING", "STOPPING"):
+            return
+        code = proc.poll()
+        if code is None:
+            return
+        job["end_time"] = time.time()
+        if job["status"] == "STOPPING":
+            job["status"] = "STOPPED"
+        elif code == 0:
+            job["status"] = "SUCCEEDED"
+        else:
+            job["status"] = "FAILED"
+            job["message"] = f"driver exited with code {code}"
+
+    def get(self, job_id: str) -> dict | None:
+        with self._lock:
+            self._refresh_locked(job_id)
+            job = self._jobs.get(job_id)
+            return dict(job) if job else None
+
+    def list(self) -> list[dict]:
+        with self._lock:
+            for jid in list(self._jobs):
+                self._refresh_locked(jid)
+            return [dict(j) for j in self._jobs.values()]
+
+    def stop(self, job_id: str) -> bool:
+        with self._lock:
+            job = self._jobs.get(job_id)
+            proc = self._procs.get(job_id)
+            if job is None or proc is None or proc.poll() is not None:
+                return False
+            job["status"] = "STOPPING"
+        try:
+            os.killpg(proc.pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            proc.terminate()
+        return True
+
+    def logs(self, job_id: str) -> str:
+        path = os.path.join(self._session_dir, "logs",
+                            f"job-{job_id}.log")
+        try:
+            with open(path, "r", errors="replace") as f:
+                return f.read()
+        except FileNotFoundError:
+            return ""
+
+    def shutdown(self):
+        with self._lock:
+            procs = list(self._procs.values())
+        for proc in procs:
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    proc.terminate()
+
+
+def _escape_label(value) -> str:
+    """Prometheus exposition escaping: backslash, quote, newline."""
+    return (str(value).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+def _prometheus_text(series: list[dict]) -> str:
+    """Render the GCS metrics table in Prometheus exposition format."""
+    lines = []
+    seen_headers = set()
+    for s in series:
+        name = s["name"].replace("-", "_").replace(".", "_")
+        if name not in seen_headers:
+            seen_headers.add(name)
+            if s.get("description"):
+                help_text = (str(s["description"])
+                             .replace("\\", r"\\").replace("\n", r"\n"))
+                lines.append(f"# HELP {name} {help_text}")
+            ptype = {"counter": "counter", "gauge": "gauge"}.get(
+                s["type"], "untyped")
+            lines.append(f"# TYPE {name} {ptype}")
+        tags = ",".join(f'{k}="{_escape_label(v)}"'
+                        for k, v in sorted(s.get("tags", {}).items()))
+        label = f"{{{tags}}}" if tags else ""
+        if s["type"] == "histogram":
+            lines.append(f"{name}_count{label} {s['count']}")
+            lines.append(f"{name}_sum{label} {s['sum']}")
+        else:
+            lines.append(f"{name}{label} {s['value']}")
+    return "\n".join(lines) + "\n"
+
+
+def create_app(gcs_address: str, session_dir: str):
+    from aiohttp import web
+
+    clients = ClientPool()
+    gcs = clients.get(gcs_address)
+    jobs = JobManager(gcs_address, session_dir)
+
+    def _nodes():
+        infos = gcs.call("GetAllNodes", retries=3)
+        return [{
+            "node_id": i.node_id.hex(), "address": i.address,
+            "alive": i.alive, "total_resources": i.total_resources,
+            "available_resources": i.available_resources,
+            "labels": i.labels,
+        } for i in infos.values()]
+
+    async def _call(fn, *args):
+        return await asyncio.get_running_loop().run_in_executor(
+            None, fn, *args)
+
+    async def nodes(_req):
+        return web.json_response(await _call(_nodes))
+
+    async def actors(_req):
+        return web.json_response(
+            await _call(lambda: gcs.call("ListActors", retries=3)))
+
+    async def pgs(_req):
+        return web.json_response(
+            await _call(lambda: gcs.call("ListPlacementGroups",
+                                         retries=3)))
+
+    async def objects(_req):
+        return web.json_response(
+            await _call(lambda: gcs.call("ListObjects", retries=3)))
+
+    async def cluster_status(_req):
+        def build():
+            infos = gcs.call("GetAllNodes", retries=3)
+            total = gcs.call("ClusterResources", retries=3)
+            avail = gcs.call("AvailableResources", retries=3)
+            return {"nodes_alive": sum(i.alive for i in infos.values()),
+                    "nodes_dead": sum(not i.alive
+                                      for i in infos.values()),
+                    "resources_total": total,
+                    "resources_available": avail}
+        return web.json_response(await _call(build))
+
+    async def metrics(_req):
+        def build():
+            series = gcs.call("MetricsGet", retries=3)
+            infos = gcs.call("GetAllNodes", retries=3)
+            avail = gcs.call("AvailableResources", retries=3)
+            total = gcs.call("ClusterResources", retries=3)
+            builtin = [
+                {"name": "art_cluster_nodes_alive", "type": "gauge",
+                 "tags": {}, "value": sum(
+                     i.alive for i in infos.values()),
+                 "description": "alive nodes"},
+            ]
+            for res, tot in total.items():
+                builtin.append({
+                    "name": "art_cluster_resource_total", "type": "gauge",
+                    "tags": {"resource": res}, "value": tot,
+                    "description": "total cluster resources"})
+                builtin.append({
+                    "name": "art_cluster_resource_available",
+                    "type": "gauge", "tags": {"resource": res},
+                    "value": avail.get(res, 0.0),
+                    "description": "available cluster resources"})
+            return _prometheus_text(builtin + series)
+        return web.Response(text=await _call(build),
+                            content_type="text/plain")
+
+    async def submit_job(req):
+        body = await req.json()
+        if "entrypoint" not in body:
+            return web.json_response({"error": "entrypoint required"},
+                                     status=400)
+        try:
+            job_id = await _call(
+                lambda: jobs.submit(
+                    body["entrypoint"], body.get("runtime_env"),
+                    body.get("submission_id"), body.get("metadata")))
+        except ValueError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"submission_id": job_id})
+
+    async def list_jobs(_req):
+        return web.json_response(await _call(jobs.list))
+
+    async def get_job(req):
+        job = await _call(jobs.get, req.match_info["job_id"])
+        if job is None:
+            return web.json_response({"error": "no such job"}, status=404)
+        return web.json_response(job)
+
+    async def job_logs(req):
+        text = await _call(jobs.logs, req.match_info["job_id"])
+        return web.json_response({"logs": text})
+
+    async def stop_job(req):
+        ok = await _call(jobs.stop, req.match_info["job_id"])
+        return web.json_response({"stopped": bool(ok)})
+
+    app = web.Application()
+    app.router.add_get("/api/nodes", nodes)
+    app.router.add_get("/api/actors", actors)
+    app.router.add_get("/api/placement_groups", pgs)
+    app.router.add_get("/api/objects", objects)
+    app.router.add_get("/api/cluster_status", cluster_status)
+    app.router.add_get("/metrics", metrics)
+    app.router.add_post("/api/jobs", submit_job)
+    app.router.add_get("/api/jobs", list_jobs)
+    app.router.add_get("/api/jobs/{job_id}", get_job)
+    app.router.add_get("/api/jobs/{job_id}/logs", job_logs)
+    app.router.add_post("/api/jobs/{job_id}/stop", stop_job)
+    app["job_manager"] = jobs
+    return app
+
+
+def main():  # pragma: no cover — subprocess entry, driven by tests
+    import argparse
+
+    from aiohttp import web
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--monitor-pid", type=int, default=0)
+    args = parser.parse_args()
+
+    app = create_app(args.gcs_address, args.session_dir)
+    loop = asyncio.new_event_loop()
+    asyncio.set_event_loop(loop)
+    runner = web.AppRunner(app)
+    loop.run_until_complete(runner.setup())
+    site = web.TCPSite(runner, "127.0.0.1", args.port)
+    loop.run_until_complete(site.start())
+    port = site._server.sockets[0].getsockname()[1]
+    print(f"DASH_READY http://127.0.0.1:{port}", flush=True)
+
+    async def watch_parent():
+        while True:
+            await asyncio.sleep(1.0)
+            if args.monitor_pid:
+                try:
+                    os.kill(args.monitor_pid, 0)
+                except ProcessLookupError:
+                    app["job_manager"].shutdown()
+                    loop.stop()
+                    return
+
+    loop.create_task(watch_parent())
+    try:
+        loop.run_forever()
+    except KeyboardInterrupt:
+        pass
+    app["job_manager"].shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,7 @@ class GcsServer:
         self._object_locations: dict[ObjectID, set[NodeID]] = {}
         self._jobs: dict[JobID, dict] = {}
         self._placement_groups: dict = {}  # pg_id -> record dict
+        self._metrics: dict[tuple, dict] = {}  # (name, tags) -> series
         self._clients = ClientPool()
         self._io = IoThread.get()
         self._health_task = None
@@ -90,6 +91,8 @@ class GcsServer:
             "ListPlacementGroups": self._list_placement_groups,
             "ListActors": self._list_actors,
             "ListObjects": self._list_objects,
+            "MetricRecord": self._metric_record,
+            "MetricsGet": self._metrics_get,
             "Shutdown": self._shutdown_rpc,
         })
         self.address = self._server.start()
@@ -154,6 +157,38 @@ class GcsServer:
             if record.node_id == node_id and record.state in (
                     ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
                 await self._handle_actor_failure(record, "node died")
+
+    # -------------------------------------------------------- metrics
+    # (ref: src/ray/stats/metric.h registry + the dashboard metrics
+    #  agent python/ray/_private/metrics_agent.py — GCS holds the
+    #  aggregated series; the dashboard renders Prometheus text)
+
+    async def _metric_record(self, payload):
+        """{"name","type","value","tags","description"} — counters
+        accumulate, gauges overwrite, histograms keep running stats."""
+        key = (payload["name"],
+               tuple(sorted((payload.get("tags") or {}).items())))
+        mtype = payload["type"]
+        entry = self._metrics.get(key)
+        if entry is None:
+            entry = {"name": payload["name"], "type": mtype,
+                     "tags": dict(payload.get("tags") or {}),
+                     "description": payload.get("description", ""),
+                     "value": 0.0, "count": 0, "sum": 0.0}
+            self._metrics[key] = entry
+        value = float(payload["value"])
+        if mtype == "counter":
+            entry["value"] += value
+        elif mtype == "gauge":
+            entry["value"] = value
+        else:  # histogram-ish: running count/sum + last
+            entry["count"] += 1
+            entry["sum"] += value
+            entry["value"] = value
+        return True
+
+    async def _metrics_get(self, _payload):
+        return list(self._metrics.values())
 
     # ------------------------------------------------------------- kv
 

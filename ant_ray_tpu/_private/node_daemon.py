@@ -44,6 +44,7 @@ class WorkerHandle:
     lease_pg: tuple | None = None        # (pg_id, bundle_index) if any
     actor_spec: ActorSpec | None = None
     blocked: bool = False
+    env_key: str = ""                    # runtime-env pool identity
     registered: asyncio.Event = field(default_factory=asyncio.Event)
 
 
@@ -80,6 +81,8 @@ class NodeManager:
         # object_id -> sorted lease-expiry times, one per outstanding
         # arena read pin (see _locate_pinned / _reap_expired_pins).
         self._pin_leases: dict[ObjectID, list[float]] = {}
+        # terminated-but-unreaped workers (retired for env mismatch)
+        self._retired_procs: list[subprocess.Popen] = []
         self.address = ""
 
     # ------------------------------------------------------------ lifecycle
@@ -179,6 +182,9 @@ class NodeManager:
                 handle.proc.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
                 handle.proc.kill()
+        for proc in self._retired_procs:
+            if proc.poll() is None:
+                proc.kill()
         self._clients.close_all()
 
     async def _shutdown_rpc(self, _payload):
@@ -187,9 +193,23 @@ class NodeManager:
 
     # ------------------------------------------------------------ workers
 
-    def _spawn_worker(self, actor_spec: ActorSpec | None = None) -> WorkerHandle:
+    def _spawn_worker(self, actor_spec: ActorSpec | None = None,
+                      runtime_env: dict | None = None) -> WorkerHandle:
+        from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
+
+        if actor_spec is not None and runtime_env is None:
+            runtime_env = actor_spec.runtime_env
         worker_id = WorkerID.from_random()
         env = dict(os.environ)
+        cwd = None
+        if runtime_env:
+            # packages were prefetched by _ensure_runtime_env (async);
+            # resolve() is pure path logic, safe on the event loop
+            overlay, cwd = renv.resolve(runtime_env, self._session_dir)
+            env.update(overlay)
+            # A staged cwd loses the implicit cwd-based import of a
+            # checkout-run framework — pin the package root explicitly.
+            renv.ensure_framework_on_pythonpath(env)
         env["ART_NODE_ADDRESS"] = self.address
         env["ART_GCS_ADDRESS"] = self._gcs_address
         env["ART_STORE_DIR"] = self.store.directory
@@ -201,10 +221,11 @@ class NodeManager:
         log_file = open(log_path, "ab")
         proc = subprocess.Popen(
             [sys.executable, "-m", "ant_ray_tpu._private.worker_main"],
-            env=env, stdout=log_file, stderr=subprocess.STDOUT,
+            env=env, cwd=cwd, stdout=log_file, stderr=subprocess.STDOUT,
             start_new_session=True)
         log_file.close()
-        handle = WorkerHandle(worker_id, proc, actor_spec=actor_spec)
+        handle = WorkerHandle(worker_id, proc, actor_spec=actor_spec,
+                              env_key=renv.env_key(runtime_env))
         self._workers[worker_id] = handle
         return handle
 
@@ -231,6 +252,9 @@ class NodeManager:
         gcs = self._clients.get(self._gcs_address)
         while not self._stopping:
             await asyncio.sleep(0.1)
+            if self._retired_procs:
+                self._retired_procs = [p for p in self._retired_procs
+                                       if p.poll() is None]
             for worker_id, handle in list(self._workers.items()):
                 if handle.proc.poll() is None:
                     continue
@@ -280,11 +304,42 @@ class NodeManager:
             self._available[k] = self._available.get(k, 0.0) + v
         self._lease_event.set()
 
-    def _idle_worker(self) -> WorkerHandle | None:
+    async def _ensure_runtime_env(self, wire: dict | None):
+        """Prefetch + extract a runtime env's working_dir package so the
+        (sync) worker spawn only touches local paths."""
+        from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
+
+        key = (wire or {}).get("working_dir_key")
+        if not key or renv.is_extracted(key, self._session_dir):
+            return
+        gcs = self._clients.get(self._gcs_address)
+        blob = await gcs.call_async("KVGet", {"key": key}, timeout=60)
+        if blob is None:
+            raise RuntimeError(
+                f"runtime_env package {key} missing from GCS KV")
+        renv.extract(key, blob, self._session_dir)
+
+    def _idle_worker(self, env_key: str = "") -> WorkerHandle | None:
         for handle in self._workers.values():
-            if handle.state == IDLE and handle.address:
+            if (handle.state == IDLE and handle.address
+                    and handle.env_key == env_key):
                 return handle
         return None
+
+    def _retire_idle_mismatch(self, env_key: str) -> bool:
+        """Kill one idle worker of a *different* runtime env so a full
+        pool can still serve a new env (ref: WorkerPool eviction of
+        idle workers for mismatched runtime envs).  Non-blocking: the
+        monitor loop reaps the terminated process."""
+        for worker_id, handle in list(self._workers.items()):
+            if (handle.state == IDLE and handle.env_key != env_key
+                    and handle.actor_spec is None):
+                del self._workers[worker_id]
+                if handle.proc.poll() is None:
+                    handle.proc.terminate()
+                self._retired_procs.append(handle.proc)
+                return True
+        return False
 
     def _pool_size(self) -> int:
         """Workers counted against the pool cap: task workers that are
@@ -300,6 +355,12 @@ class NodeManager:
         (ref: NodeManager::HandleRequestWorkerLease, node_manager.cc:1794)."""
         demand: dict[str, float] = payload.get("resources", {})
         gcs = self._clients.get(self._gcs_address)
+        from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
+
+        runtime_env = payload.get("runtime_env")
+        env_key = renv.env_key(runtime_env)
+        if runtime_env:
+            await self._ensure_runtime_env(runtime_env)
 
         pg_key = payload.get("pg")
         if pg_key is not None:
@@ -314,10 +375,14 @@ class NodeManager:
                             "reason": f"demand {demand} exceeds bundle "
                                       f"capacity {bundle['resources']}"}
                 if self._bundle_can_allocate(pg_key, demand):
-                    worker = self._idle_worker()
+                    worker = self._idle_worker(env_key)
+                    if worker is None and \
+                            self._pool_size() >= self._max_workers + 4:
+                        self._retire_idle_mismatch(env_key)
                     if worker is None and \
                             self._pool_size() < self._max_workers + 4:
-                        handle = self._spawn_worker()
+                        handle = self._spawn_worker(
+                            runtime_env=runtime_env)
                         await handle.registered.wait()
                         worker = handle if handle.state == IDLE else None
                     if worker is not None:
@@ -349,9 +414,12 @@ class NodeManager:
         spill_deadline = start + global_config().spillback_timeout_s
         while True:
             if self._can_allocate(demand):
-                worker = self._idle_worker()
+                worker = self._idle_worker(env_key)
+                if worker is None and \
+                        self._pool_size() >= self._max_workers:
+                    self._retire_idle_mismatch(env_key)
                 if worker is None and self._pool_size() < self._max_workers:
-                    handle = self._spawn_worker()
+                    handle = self._spawn_worker(runtime_env=runtime_env)
                     await handle.registered.wait()
                     worker = handle if handle.state == IDLE else None
                 if worker is not None:
@@ -481,6 +549,8 @@ class NodeManager:
     # ------------------------------------------------------------ actors
 
     async def _start_actor_worker(self, spec: ActorSpec):
+        if spec.runtime_env:
+            await self._ensure_runtime_env(spec.runtime_env)
         if spec.placement_group_id is not None:
             key = (spec.placement_group_id,
                    spec.placement_group_bundle_index)

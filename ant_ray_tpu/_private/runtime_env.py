@@ -1,0 +1,167 @@
+"""Runtime environments: per-task/actor env_vars + working_dir
+(ref: python/ray/_private/runtime_env/ — the plugin architecture
+reduced to its two load-bearing plugins; URI-cached packages live in
+GCS KV exactly like the reference caches working_dir zips in the GCS'
+internal KV, ref: runtime_env/working_dir.py).
+
+Wire form (what travels in TaskSpec/ActorSpec/lease payloads):
+    {"env_vars": {...}, "working_dir_key": "renv:<sha256-16>"}
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import zipfile
+
+MAX_WORKING_DIR_BYTES = 100 * 1024 * 1024
+
+
+def validate(runtime_env: dict) -> None:
+    unknown = set(runtime_env) - {"env_vars", "working_dir"}
+    if unknown:
+        raise ValueError(
+            f"unsupported runtime_env field(s) {sorted(unknown)}; "
+            "supported: env_vars, working_dir")
+    env_vars = runtime_env.get("env_vars") or {}
+    if not all(isinstance(k, str) and isinstance(v, str)
+               for k, v in env_vars.items()):
+        raise ValueError("runtime_env env_vars must be str->str")
+
+
+def _zip_dir(path: str) -> bytes:
+    buf = io.BytesIO()
+    total = 0
+    with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+        for root, _dirs, files in os.walk(path):
+            for name in files:
+                full = os.path.join(root, name)
+                rel = os.path.relpath(full, path)
+                total += os.path.getsize(full)
+                if total > MAX_WORKING_DIR_BYTES:
+                    raise ValueError(
+                        f"working_dir exceeds "
+                        f"{MAX_WORKING_DIR_BYTES >> 20} MiB")
+                zf.write(full, rel)
+    return buf.getvalue()
+
+
+def ensure_framework_on_pythonpath(env: dict) -> None:
+    """Make child processes able to import a checkout-run framework even
+    after a cwd change (shared by worker spawn and job drivers)."""
+    import ant_ray_tpu  # noqa: PLC0415
+
+    pkg_root = os.path.dirname(os.path.dirname(
+        os.path.abspath(ant_ray_tpu.__file__)))
+    existing = env.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(":"):
+        env["PYTHONPATH"] = (f"{existing}:{pkg_root}" if existing
+                             else pkg_root)
+
+
+def content_fingerprint(runtime_env: dict) -> str:
+    """Cache identity for a runtime env INCLUDING working_dir contents
+    (path, size, mtime per file), so edits re-package instead of
+    silently reusing a stale zip."""
+    parts = [repr(sorted((runtime_env.get("env_vars") or {}).items()))]
+    working_dir = runtime_env.get("working_dir")
+    if working_dir:
+        entries = []
+        for root, _dirs, files in os.walk(working_dir):
+            for name in files:
+                full = os.path.join(root, name)
+                try:
+                    st = os.stat(full)
+                    entries.append((os.path.relpath(full, working_dir),
+                                    st.st_size, st.st_mtime_ns))
+                except OSError:
+                    entries.append((os.path.relpath(full, working_dir),
+                                    -1, -1))
+        parts.append(repr(sorted(entries)))
+        parts.append(working_dir)
+    return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def package(runtime_env: dict | None, kv_put) -> dict | None:
+    """Driver side: validate and stage into GCS KV; returns wire form.
+
+    ``kv_put(key, value_bytes)`` uploads content-addressed blobs."""
+    if not runtime_env:
+        return None
+    validate(runtime_env)
+    wire: dict = {}
+    env_vars = runtime_env.get("env_vars")
+    if env_vars:
+        wire["env_vars"] = dict(env_vars)
+    working_dir = runtime_env.get("working_dir")
+    if working_dir:
+        if not os.path.isdir(working_dir):
+            raise ValueError(f"working_dir {working_dir!r} is not a "
+                             "directory")
+        blob = _zip_dir(working_dir)
+        key = f"renv:{hashlib.sha256(blob).hexdigest()[:16]}"
+        kv_put(key, blob)
+        wire["working_dir_key"] = key
+    return wire or None
+
+
+def env_key(wire: dict | None) -> str:
+    """Stable identity for worker-pool matching: workers are only
+    reused for tasks with the same runtime env."""
+    if not wire:
+        return ""
+    return json.dumps(wire, sort_keys=True)
+
+
+def package_dir(key: str, session_dir: str) -> str:
+    return os.path.join(session_dir, "runtime_envs", key.split(":", 1)[1])
+
+
+def is_extracted(key: str, session_dir: str) -> bool:
+    return os.path.exists(os.path.join(package_dir(key, session_dir),
+                                       ".art_ready"))
+
+
+def extract(key: str, blob: bytes, session_dir: str) -> str:
+    """Idempotent, race-safe zip extraction; returns the package dir."""
+    target = package_dir(key, session_dir)
+    if is_extracted(key, session_dir):
+        return target
+    tmp = target + f".tmp.{os.getpid()}"
+    os.makedirs(tmp, exist_ok=True)
+    with zipfile.ZipFile(io.BytesIO(blob)) as zf:
+        zf.extractall(tmp)
+    open(os.path.join(tmp, ".art_ready"), "w").close()
+    try:
+        os.rename(tmp, target)
+    except OSError:
+        # lost the race to another extractor — use theirs
+        import shutil  # noqa: PLC0415
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return target
+
+
+def resolve(wire: dict | None, session_dir: str) -> tuple[dict, str | None]:
+    """(env_overlay, cwd) for a wire env whose packages are already
+    extracted (see ``extract``); pure path/dict logic, safe to call on
+    an event loop."""
+    if not wire:
+        return {}, None
+    overlay = dict(wire.get("env_vars") or {})
+    cwd = None
+    key = wire.get("working_dir_key")
+    if key:
+        if not is_extracted(key, session_dir):
+            raise RuntimeError(
+                f"runtime_env package {key} not extracted — prefetch it "
+                "before spawning")
+        cwd = package_dir(key, session_dir)
+        # The reference puts working_dir on sys.path of the worker.
+        existing = overlay.get("PYTHONPATH", os.environ.get(
+            "PYTHONPATH", ""))
+        overlay["PYTHONPATH"] = (f"{cwd}:{existing}" if existing
+                                 else cwd)
+    return overlay, cwd

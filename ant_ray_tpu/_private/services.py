@@ -87,17 +87,62 @@ def new_session_dir() -> str:
     return session_dir
 
 
+def start_dashboard(gcs_address: str, session_dir: str
+                    ) -> tuple[subprocess.Popen, str]:
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ant_ray_tpu._private.dashboard",
+         "--gcs-address", gcs_address,
+         "--session-dir", session_dir,
+         "--monitor-pid", str(os.getpid())],
+        stdout=subprocess.PIPE, stderr=_log_file(session_dir, "dash.err"),
+        start_new_session=True)
+    url = _wait_ready(proc, "DASH_READY")
+    return proc, url
+
+
 def start_cluster(num_cpus: int | None = None, num_tpus: int | None = None,
-                  resources: dict | None = None) -> dict:
-    """Start head (GCS) + one node daemon; returns addresses + procs."""
+                  resources: dict | None = None,
+                  include_dashboard: bool | None = None) -> dict:
+    """Start head (GCS) + one node daemon (+ dashboard); returns
+    addresses + procs."""
+    from ant_ray_tpu._private.config import global_config  # noqa: PLC0415
+
     session_dir = new_session_dir()
     gcs_proc, gcs_address = start_gcs(session_dir)
+    procs = [gcs_proc]
     try:
         node_proc, node_address = start_node(
             gcs_address, default_resources(num_cpus, num_tpus, resources),
             session_dir)
+        procs.insert(0, node_proc)
+        dashboard_url = ""
+        want_dashboard = (include_dashboard if include_dashboard is not None
+                          else global_config().include_dashboard)
+        if want_dashboard:
+            try:
+                import aiohttp  # noqa: F401, PLC0415
+            except ImportError:
+                logger.warning("aiohttp not installed; dashboard (state "
+                               "API, /metrics, job server) disabled")
+                want_dashboard = False
+        if want_dashboard:
+            try:
+                dash_proc, dashboard_url = start_dashboard(
+                    gcs_address, session_dir)
+            except Exception as e:  # noqa: BLE001 — dashboard is optional
+                logger.warning("dashboard failed to start: %s", e)
+            else:
+                procs.insert(0, dash_proc)
+                # Publish for late-joining drivers / the jobs SDK.
+                pool = ClientPool()
+                try:
+                    pool.get(gcs_address).call("KVPut", {
+                        "key": "dashboard_url",
+                        "value": dashboard_url.encode()}, retries=3)
+                finally:
+                    pool.close_all()
     except Exception:
-        gcs_proc.terminate()
+        stop_processes(procs)
         raise
     store_dir = _store_dir_of(node_address)
     return {
@@ -105,7 +150,8 @@ def start_cluster(num_cpus: int | None = None, num_tpus: int | None = None,
         "node_address": node_address,
         "store_dir": store_dir,
         "session_dir": session_dir,
-        "processes": [node_proc, gcs_proc],
+        "dashboard_url": dashboard_url,
+        "processes": procs,
     }
 
 

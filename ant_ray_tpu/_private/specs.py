@@ -26,6 +26,8 @@ class TaskSpec:
     # Placement-group routing
     placement_group_id: "object | None" = None
     placement_group_bundle_index: int = -1
+    # Wire-form runtime env (see _private/runtime_env.py)
+    runtime_env: dict | None = None
 
 
 @dataclass
@@ -47,6 +49,7 @@ class ActorSpec:
     job_id: JobID | None = None
     placement_group_id: "object | None" = None
     placement_group_bundle_index: int = -1
+    runtime_env: dict | None = None
 
 
 @dataclass

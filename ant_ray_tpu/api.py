@@ -78,7 +78,9 @@ def init(
     )
     global_worker.mode = CLUSTER_MODE
     _register_atexit_once()
-    return ClientContext(CLUSTER_MODE)
+    return ClientContext(
+        CLUSTER_MODE,
+        dashboard_url=getattr(global_worker.runtime, "dashboard_url", ""))
 
 
 _atexit_registered = False
@@ -94,8 +96,9 @@ def _register_atexit_once():
 
 
 class ClientContext:
-    def __init__(self, mode: str):
+    def __init__(self, mode: str, dashboard_url: str = ""):
         self.mode = mode
+        self.dashboard_url = dashboard_url
 
     def __enter__(self):
         return self

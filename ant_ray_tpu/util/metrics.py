@@ -1,0 +1,68 @@
+"""User-defined metrics (ref: python/ray/util/metrics.py Counter/Gauge/
+Histogram) recorded to the GCS metrics table and exported as Prometheus
+text by the dashboard's /metrics endpoint."""
+
+from __future__ import annotations
+
+
+def _record(payload: dict):
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    if not global_worker.connected:
+        return  # metrics are best-effort outside a cluster
+    runtime = global_worker.runtime
+    gcs = getattr(runtime, "_gcs", None)
+    if gcs is None:
+        return  # local mode
+    runtime._send_oneway(runtime.gcs_address, "MetricRecord", payload)
+
+
+class _Metric:
+    _type = ""
+
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: tuple = ()):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: dict = {}
+
+    def set_default_tags(self, tags: dict):
+        self._default_tags = dict(tags)
+        return self
+
+    def _emit(self, value: float, tags: dict | None):
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        _record({"name": self._name, "type": self._type,
+                 "value": float(value), "tags": merged,
+                 "description": self._description})
+
+
+class Counter(_Metric):
+    _type = "counter"
+
+    def inc(self, value: float = 1.0, tags: dict | None = None):
+        if value < 0:
+            raise ValueError("counters only increase")
+        self._emit(value, tags)
+
+
+class Gauge(_Metric):
+    _type = "gauge"
+
+    def set(self, value: float, tags: dict | None = None):
+        self._emit(value, tags)
+
+
+class Histogram(_Metric):
+    _type = "histogram"
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: list | None = None, tag_keys: tuple = ()):
+        super().__init__(name, description, tag_keys)
+        self._boundaries = list(boundaries or [])
+
+    def observe(self, value: float, tags: dict | None = None):
+        self._emit(value, tags)
