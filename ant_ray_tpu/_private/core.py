@@ -105,7 +105,15 @@ class ClusterRuntime(CoreRuntime):
             "BorrowAdd": self._handle_borrow_add,
             "BorrowRemove": self._handle_borrow_remove,
             "ReconstructObject": self._handle_reconstruct_object,
+            "DeviceTensorFetch": self._handle_device_tensor_fetch,
+            "DeviceTensorFree": self._handle_device_tensor_free,
         })
+        # HBM-resident objects held by this worker, keyed by holder
+        # token, plus the metadata-oid → token map that ties payload
+        # lifetime to the metadata object's refcount
+        # (see experimental/device_objects.py)
+        self._device_objects: dict[str, Any] = {}
+        self._device_tokens_by_oid: dict[ObjectID, str] = {}
         self.address = self.server.start()
 
         self._driver_task_id = TaskID.for_driver_task(job_id)
@@ -228,6 +236,9 @@ class ClusterRuntime(CoreRuntime):
             entry = self.memory.get_entry(oid)
             self.memory.delete(oid)
             self._lineage.pop(oid, None)  # freed ⇒ lineage released
+            token = self._device_tokens_by_oid.pop(oid, None)
+            if token is not None:
+                self._device_objects.pop(token, None)  # HBM released
             if entry is not None and entry[0] == "plasma":
                 self._send_oneway(self.gcs_address, "FreeObject",
                                   {"object_id": oid})
@@ -702,6 +713,60 @@ class ClusterRuntime(CoreRuntime):
             # user marked non-retryable (at-most-once side effects).
             if kind == "plasma" and spec.function_id and spec.max_retries:
                 self._lineage[oid] = spec
+
+    # --------------------------------------------------- device objects
+    # (ref capability: GPUObjectStore per actor + tensor transports,
+    #  experimental/gpu_object_manager/ — here the transport is
+    #  host↔HBM DMA + RPC; see experimental/device_objects.py)
+
+    async def _handle_device_tensor_fetch(self, payload):
+        array = self._device_objects.get(payload["token"])
+        if array is None:
+            return None
+
+        def dma_out():
+            import numpy as np  # noqa: PLC0415
+
+            # device→host DMA (blocks); the RPC layer pickles the
+            # ndarray (protocol 5 handles ml_dtypes like bfloat16)
+            return np.asarray(array)
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, dma_out)
+
+    async def _handle_device_tensor_free(self, payload):
+        self._device_objects.pop(payload["token"], None)
+        return True
+
+    def _fetch_device_tensor(self, holder: str, token: str,
+                             timeout: float | None):
+        client = self._clients.get(holder)
+        with self._blocked():
+            return self._io.run_coro(client.call_async(
+                "DeviceTensorFetch", {"token": token},
+                timeout=-1 if timeout is None else timeout))
+
+    def pin_for_grace(self, ref: ObjectRef, grace_s: float = 60.0):
+        """Hold an extra pin on an owned object for a grace window —
+        covers the gap between returning a ref from a task and the
+        consumer's BorrowAdd registration, after which normal
+        refcounting governs."""
+        oid = ref.id
+        with self._ref_lock:
+            self._pins[oid] = self._pins.get(oid, 0) + 1
+
+        def _expire():
+            with self._ref_lock:
+                count = self._pins.get(oid, 0) - 1
+                if count <= 0:
+                    self._pins.pop(oid, None)
+                else:
+                    self._pins[oid] = count
+                if self.memory.is_owned(oid):
+                    self._maybe_free_locked(oid)
+
+        self._io.loop.call_soon_threadsafe(
+            self._io.loop.call_later, grace_s, _expire)
 
     # ------------------------------------------------- lineage recovery
 

@@ -180,6 +180,16 @@ def main():  # pragma: no cover — exercised via subprocess in tests
         level=global_config().log_level,
         format="[worker %(levelname)s %(asctime)s] %(message)s")
 
+    if os.environ.get("ART_JAX_PLATFORM"):
+        # Apply the platform pin at the jax.config level BEFORE any user
+        # code's raw `import jax` triggers backend resolution: in envs
+        # with an eagerly-initializing TPU plugin (e.g. a down tunnel),
+        # JAX_PLATFORMS alone doesn't prevent a minutes-long stall on
+        # the first op.
+        from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
+
+        import_jax()
+
     node_address = os.environ["ART_NODE_ADDRESS"]
     gcs_address = os.environ["ART_GCS_ADDRESS"]
     store_dir = os.environ["ART_STORE_DIR"]

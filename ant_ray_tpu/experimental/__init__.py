@@ -1,0 +1,1 @@
+"""Experimental subsystems (mirrors python/ray/experimental/)."""

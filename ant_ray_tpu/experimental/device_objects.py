@@ -1,0 +1,118 @@
+"""Device-resident objects: HBM tensors referenced by ObjectRef.
+
+Capability mirror of the reference's "GPU objects" (ref: python/ray/
+experimental/gpu_object_manager/gpu_object_manager.py:85,
+gpu_object_store.py:170, tensor_transport_manager.py:14), re-designed
+for TPU: instead of NCCL/NIXL P2P, the payload stays in the producing
+worker's HBM and moves on demand over the **host↔HBM DMA path** —
+device→host (one DMA) → RPC → host→device (`jax.device_put`, one DMA)
+on the consumer.  The object plane only ever carries small metadata;
+big tensors never transit plasma unless fetched.
+
+    ref = device_objects.put(hbm_array)        # metadata ObjectRef
+    arr = device_objects.get(ref)              # zero-copy if local
+
+Same-process gets return the identical buffer (no copy at all).  An
+in-slice ICI transport (XLA collective send/recv between jitted mesh
+programs) is the planned fast path for sharded arrays; the DMA path is
+the general fallback exactly like the reference's object-store
+transport.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+def _runtime():
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    global_worker._check_connected()
+    runtime = global_worker.runtime
+    if not hasattr(runtime, "_device_objects"):
+        raise RuntimeError(
+            "device objects need cluster mode (local_mode has no "
+            "per-worker device store)")
+    return runtime
+
+
+def put(array: Any) -> "object":
+    """Register a device array in this worker's device-object store;
+    returns an ObjectRef whose payload is just metadata.
+
+    The metadata carries a holder token (not the ObjectRef id): when the
+    ref is passed as a task arg, the arg resolves to the metadata dict —
+    which remains a fetchable handle, exactly like the reference's
+    deserialized GPU-object values."""
+    import uuid  # noqa: PLC0415
+
+    runtime = _runtime()
+    token = uuid.uuid4().hex
+    meta = {
+        "__art_device_object__": True,
+        "holder": runtime.address,
+        "token": token,
+        "shape": tuple(getattr(array, "shape", ())),
+        "dtype": str(getattr(array, "dtype", "")),
+    }
+    ref = runtime.put(meta)
+    runtime._device_objects[token] = array
+    # Payload lifetime rides the metadata object's refcount: when the
+    # owner frees the metadata (all refs/borrows gone), the HBM entry
+    # is dropped too.  A grace pin covers the window between returning
+    # the ref from a task and the consumer's borrow registration.
+    runtime._device_tokens_by_oid[ref.id] = token
+    runtime.pin_for_grace(ref)
+    return ref
+
+
+def get(ref_or_meta, timeout: float | None = None) -> Any:
+    """Resolve a device ObjectRef (or its resolved metadata dict, as
+    seen inside a task that received the ref as an argument) to an
+    array on this process' device.
+
+    Local hit → the original buffer (zero copy).  Remote → holder DMAs
+    to host, bytes travel by RPC, and the result is `device_put` here.
+    """
+    runtime = _runtime()
+    from ant_ray_tpu import exceptions  # noqa: PLC0415
+
+    meta = _resolve_meta(runtime, ref_or_meta, timeout)
+    local = runtime._device_objects.get(meta["token"])
+    if local is not None:
+        return local
+    try:
+        host = runtime._fetch_device_tensor(meta["holder"], meta["token"],
+                                            timeout)
+    except Exception as e:  # noqa: BLE001 — holder died / unreachable
+        raise exceptions.ObjectLostError(
+            None, f"holder of device object {meta['token'][:12]} is "
+            f"unreachable: {e}") from e
+    if host is None:
+        raise exceptions.ObjectLostError(
+            None, f"holder no longer has device object "
+            f"{meta['token'][:12]}")
+    from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
+
+    jax = import_jax()
+    return jax.device_put(host)
+
+
+def free(ref_or_meta) -> None:
+    """Drop the device payload (metadata object follows normal ref
+    counting)."""
+    runtime = _runtime()
+    meta = _resolve_meta(runtime, ref_or_meta, 5)
+    if runtime._device_objects.pop(meta["token"], None) is not None:
+        return
+    runtime._send_oneway(meta["holder"], "DeviceTensorFree",
+                         {"token": meta["token"]})
+
+
+def _resolve_meta(runtime, ref_or_meta, timeout) -> dict:
+    meta = ref_or_meta
+    if not isinstance(meta, dict):
+        meta = runtime.get([ref_or_meta], timeout)[0]
+    if not (isinstance(meta, dict) and meta.get("__art_device_object__")):
+        raise TypeError("not a device ObjectRef / device-object metadata")
+    return meta
