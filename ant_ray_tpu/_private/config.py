@@ -54,6 +54,8 @@ class Config:
     # Start the dashboard head (REST state API + /metrics + job server)
     # with the cluster.
     include_dashboard: bool = True
+    # Emit flow-insight call-graph events (ant-fork util/insight).
+    enable_insight: bool = False
     # LRU-evict unpinned objects when the store is this full.
     object_store_high_watermark: float = 0.8
 

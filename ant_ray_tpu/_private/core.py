@@ -587,6 +587,11 @@ class ClusterRuntime(CoreRuntime):
             runtime_env=self._package_runtime_env(options.runtime_env),
         )
         pinned = list(ser.contained_refs)
+        if cfg.enable_insight:
+            from ant_ray_tpu.util import insight  # noqa: PLC0415
+
+            insight.record_call_submit(spec.function_name,
+                                       task_id.hex(), self.role)
         asyncio.run_coroutine_threadsafe(
             self._run_normal_task(spec, pinned), self._io.loop)
         return return_refs[0] if num_returns == 1 else return_refs
@@ -668,7 +673,8 @@ class ClusterRuntime(CoreRuntime):
         """Lease a worker (following spillback redirects), push the task,
         return the worker reply (ref: NormalTaskSubmitter::SubmitTask)."""
         lease_payload = {"resources": spec.resources,
-                         "runtime_env": spec.runtime_env}
+                         "runtime_env": spec.runtime_env,
+                         "job_id": self.job_id}
         if spec.placement_group_id is not None:
             node = await self._resolve_bundle_node(
                 spec.placement_group_id, spec.placement_group_bundle_index)

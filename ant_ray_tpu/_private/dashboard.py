@@ -238,6 +238,15 @@ def create_app(gcs_address: str, session_dir: str):
                     "resources_available": avail}
         return web.json_response(await _call(build))
 
+    async def insight(_req):
+        def build():
+            from ant_ray_tpu.util.insight import build_call_graph  # noqa: PLC0415
+
+            events = gcs.call("InsightGet", {"limit": 10000}, retries=3)
+            return {"events": events[-1000:],
+                    "graph": build_call_graph(events)}
+        return web.json_response(await _call(build))
+
     async def metrics(_req):
         def build():
             series = gcs.call("MetricsGet", retries=3)
@@ -301,6 +310,7 @@ def create_app(gcs_address: str, session_dir: str):
     app.router.add_get("/api/placement_groups", pgs)
     app.router.add_get("/api/objects", objects)
     app.router.add_get("/api/cluster_status", cluster_status)
+    app.router.add_get("/api/insight", insight)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/api/jobs", submit_job)
     app.router.add_get("/api/jobs", list_jobs)

@@ -54,6 +54,15 @@ class GcsServer:
         self._jobs: dict[JobID, dict] = {}
         self._placement_groups: dict = {}  # pg_id -> record dict
         self._metrics: dict[tuple, dict] = {}  # (name, tags) -> series
+        # vc_id -> {"node_ids": set[NodeID], "divisible": bool, ...}
+        # (ant-fork capability: GcsVirtualClusterManager,
+        #  src/ray/gcs/gcs_virtual_cluster_manager.h:30)
+        self._virtual_clusters: dict[str, dict] = {}
+        self._job_vc: dict[JobID, str] = {}
+        # bounded ring of flow-insight events (ant-fork, util/insight)
+        from collections import deque  # noqa: PLC0415
+
+        self._insight_events: deque = deque(maxlen=10000)
         self._clients = ClientPool()
         self._io = IoThread.get()
         self._health_task = None
@@ -93,6 +102,14 @@ class GcsServer:
             "ListObjects": self._list_objects,
             "MetricRecord": self._metric_record,
             "MetricsGet": self._metrics_get,
+            "CreateVirtualCluster": self._create_virtual_cluster,
+            "RemoveVirtualCluster": self._remove_virtual_cluster,
+            "UpdateVirtualCluster": self._update_virtual_cluster,
+            "ListVirtualClusters": self._list_virtual_clusters,
+            "SetJobVirtualCluster": self._set_job_virtual_cluster,
+            "GetJobVirtualCluster": self._get_job_virtual_cluster,
+            "InsightRecord": self._insight_record,
+            "InsightGet": self._insight_get,
             "Shutdown": self._shutdown_rpc,
         })
         self.address = self._server.start()
@@ -157,6 +174,123 @@ class GcsServer:
             if record.node_id == node_id and record.state in (
                     ACTOR_ALIVE, ACTOR_PENDING, ACTOR_RESTARTING):
                 await self._handle_actor_failure(record, "node died")
+
+    # ----------------------------------------------- virtual clusters
+    # Multi-tenant partitioning of the physical cluster (ant-fork
+    # capability, ref: gcs_virtual_cluster.h:154 DivisibleCluster /
+    # IndivisibleCluster; the unassigned remainder acts as the
+    # PrimaryCluster).  Jobs bound to a VC schedule only on its nodes;
+    # unbound jobs schedule only on unassigned nodes.
+
+    def _assigned_node_ids(self) -> set:
+        out: set = set()
+        for record in self._virtual_clusters.values():
+            out |= record["node_ids"]
+        return out
+
+    def _allowed_nodes_for_job(self, job_id) -> set | None:
+        """Node-id set a job may use, or None for 'no restriction'
+        (no VCs exist at all)."""
+        if not self._virtual_clusters:
+            return None
+        vc_id = self._job_vc.get(job_id) if job_id is not None else None
+        if vc_id is not None and vc_id in self._virtual_clusters:
+            return set(self._virtual_clusters[vc_id]["node_ids"])
+        alive = {n.node_id for n in self._nodes.values() if n.alive}
+        return alive - self._assigned_node_ids()
+
+    async def _create_virtual_cluster(self, payload):
+        vc_id = payload["vc_id"]
+        if vc_id in self._virtual_clusters:
+            return {"error": f"virtual cluster {vc_id!r} exists"}
+        node_ids = set(payload.get("node_ids") or [])
+        num_nodes = payload.get("num_nodes")
+        taken = self._assigned_node_ids()
+        if num_nodes is not None and not node_ids:
+            free = [n.node_id for n in self._nodes.values()
+                    if n.alive and n.node_id not in taken]
+            if len(free) < num_nodes:
+                return {"error": f"only {len(free)} unassigned nodes "
+                                 f"available, need {num_nodes}"}
+            node_ids = set(free[:num_nodes])
+        conflicts = node_ids & taken
+        if conflicts:
+            return {"error": "node(s) already assigned to another "
+                             "virtual cluster"}
+        bad = {n for n in node_ids
+               if n not in self._nodes or not self._nodes[n].alive}
+        if bad:
+            return {"error": f"unknown or dead node id(s): "
+                             f"{[n.hex()[:8] for n in bad]}"}
+        self._virtual_clusters[vc_id] = {
+            "node_ids": node_ids,
+            "divisible": bool(payload.get("divisible", False)),
+            "created_at": time.time(),
+        }
+        return {"vc_id": vc_id,
+                "node_ids": [n.hex() for n in node_ids]}
+
+    async def _remove_virtual_cluster(self, payload):
+        removed = self._virtual_clusters.pop(payload["vc_id"], None)
+        for job_id, vc in list(self._job_vc.items()):
+            if vc == payload["vc_id"]:
+                del self._job_vc[job_id]
+        return removed is not None
+
+    async def _update_virtual_cluster(self, payload):
+        record = self._virtual_clusters.get(payload["vc_id"])
+        if record is None:
+            return {"error": "no such virtual cluster"}
+        add = set(payload.get("add_nodes") or [])
+        conflicts = add & (self._assigned_node_ids() - record["node_ids"])
+        if conflicts:
+            return {"error": "node(s) already assigned elsewhere"}
+        bad = {n for n in add
+               if n not in self._nodes or not self._nodes[n].alive}
+        if bad:
+            return {"error": f"unknown or dead node id(s): "
+                             f"{[n.hex()[:8] for n in bad]}"}
+        record["node_ids"] |= add
+        record["node_ids"] -= set(payload.get("remove_nodes") or [])
+        return {"node_ids": [n.hex() for n in record["node_ids"]]}
+
+    async def _list_virtual_clusters(self, _payload):
+        return {
+            vc_id: {"node_ids": [n.hex() for n in r["node_ids"]],
+                    "divisible": r["divisible"],
+                    "jobs": [j.hex() for j, v in self._job_vc.items()
+                             if v == vc_id]}
+            for vc_id, r in self._virtual_clusters.items()
+        }
+
+    async def _set_job_virtual_cluster(self, payload):
+        vc_id = payload.get("vc_id")
+        if vc_id is None:
+            self._job_vc.pop(payload["job_id"], None)
+            return True
+        if vc_id not in self._virtual_clusters:
+            return {"error": f"no virtual cluster {vc_id!r}"}
+        self._job_vc[payload["job_id"]] = vc_id
+        return True
+
+    async def _get_job_virtual_cluster(self, payload):
+        allowed = self._allowed_nodes_for_job(payload["job_id"])
+        return {
+            "vc_id": self._job_vc.get(payload["job_id"]),
+            "allowed_node_ids": (None if allowed is None
+                                 else [n.hex() for n in allowed]),
+        }
+
+    # --------------------------------------------------- flow insight
+
+    async def _insight_record(self, payload):
+        self._insight_events.append(payload)
+        return True
+
+    async def _insight_get(self, payload):
+        limit = int(payload.get("limit", 1000))
+        events = list(self._insight_events)
+        return events[-limit:]
 
     # -------------------------------------------------------- metrics
     # (ref: src/ray/stats/metric.h registry + the dashboard metrics
@@ -255,7 +389,9 @@ class GcsServer:
                     spec.placement_group_id,
                     spec.placement_group_bundle_index)
             else:
-                node = self._pick_node(placement)
+                node = self._pick_node(
+                    placement,
+                    allowed=self._allowed_nodes_for_job(spec.job_id))
             if node is not None:
                 record.node_id = node.node_id
                 client = self._clients.get(node.address)
@@ -273,17 +409,21 @@ class GcsServer:
         record.state_event.set()
 
     def _pick_node(self, resources: dict[str, float],
-                   by_available: bool = True) -> NodeInfo | None:
+                   by_available: bool = True,
+                   allowed: set | None = None) -> NodeInfo | None:
         """Least-loaded feasible node (hybrid policy seed).
 
         by_available=True matches against the (heartbeat-fed, possibly
         stale) availability view; by_available=False against total
         capacity — used to distinguish "busy right now" from "can never
         run" (ref: ClusterResourceScheduler feasibility vs availability).
+        ``allowed`` restricts candidates (virtual-cluster membership).
         """
         best, best_score = None, -1.0
         for info in self._nodes.values():
             if not info.alive:
+                continue
+            if allowed is not None and info.node_id not in allowed:
                 continue
             view = (info.available_resources if by_available
                     else info.total_resources)
@@ -476,6 +616,7 @@ class GcsServer:
             "bundles": payload["bundles"],
             "strategy": payload["strategy"],
             "name": payload.get("name", ""),
+            "job_id": payload.get("job_id"),
             "state": "PENDING",
             "bundle_nodes": [None] * len(payload["bundles"]),
             "reason": "",
@@ -484,10 +625,14 @@ class GcsServer:
         asyncio.ensure_future(self._schedule_placement_group(record))
         return True
 
-    def _plan_bundles(self, bundles, strategy) -> list[NodeInfo] | None:
+    def _plan_bundles(self, bundles, strategy,
+                      job_id=None) -> list[NodeInfo] | None:
         """Choose a node per bundle against the availability view; None if
-        no valid assignment right now."""
-        alive = [n for n in self._nodes.values() if n.alive]
+        no valid assignment right now.  Candidates respect the job's
+        virtual cluster."""
+        allowed = self._allowed_nodes_for_job(job_id)
+        alive = [n for n in self._nodes.values() if n.alive
+                 and (allowed is None or n.node_id in allowed)]
         remaining = {n.node_id: dict(n.available_resources) for n in alive}
 
         def fits(node_id, bundle):
@@ -540,7 +685,8 @@ class GcsServer:
         for _attempt in range(120):
             if record["state"] == "REMOVED":
                 return
-            plan = self._plan_bundles(bundles, record["strategy"])
+            plan = self._plan_bundles(bundles, record["strategy"],
+                                      record.get("job_id"))
             if plan is not None:
                 prepared = []
                 ok = True
@@ -647,13 +793,15 @@ class GcsServer:
     async def _select_node(self, payload):
         resources = payload.get("resources", {})
         exclude = payload.get("exclude")
+        allowed = self._allowed_nodes_for_job(payload.get("job_id"))
 
         def _excluding(by_available: bool) -> NodeInfo | None:
-            node = self._pick_node(resources, by_available)
+            node = self._pick_node(resources, by_available, allowed)
             if node is not None and node.node_id == exclude:
                 others = [
                     n for n in self._nodes.values()
-                    if n.alive and n.node_id != exclude and all(
+                    if n.alive and n.node_id != exclude and (
+                        allowed is None or n.node_id in allowed) and all(
                         (n.available_resources if by_available
                          else n.total_resources).get(k, 0) >= v
                         for k, v in resources.items())

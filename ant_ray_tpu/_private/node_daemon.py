@@ -83,6 +83,8 @@ class NodeManager:
         self._pin_leases: dict[ObjectID, list[float]] = {}
         # terminated-but-unreaped workers (retired for env mismatch)
         self._retired_procs: list[subprocess.Popen] = []
+        # job_id -> (allowed_here, expires_at): virtual-cluster fencing
+        self._vc_cache: dict = {}
         self.address = ""
 
     # ------------------------------------------------------------ lifecycle
@@ -319,6 +321,29 @@ class NodeManager:
                 f"runtime_env package {key} missing from GCS KV")
         renv.extract(key, blob, self._session_dir)
 
+    async def _job_allowed_here(self, job_id) -> bool:
+        """Virtual-cluster membership of this node for a job, cached
+        briefly (VC edits are rare; a 5s-stale view only delays
+        re-fencing, never correctness of results)."""
+        now = time.monotonic()
+        cached = self._vc_cache.get(job_id)
+        if cached is not None and cached[1] > now:
+            return cached[0]
+        gcs = self._clients.get(self._gcs_address)
+        try:
+            reply = await gcs.call_async(
+                "GetJobVirtualCluster", {"job_id": job_id}, timeout=10)
+            allowed_hex = reply.get("allowed_node_ids")
+            allowed = (allowed_hex is None
+                       or self.node_id.hex() in allowed_hex)
+        except Exception:  # noqa: BLE001 — fail open on GCS hiccups
+            allowed = True
+        if len(self._vc_cache) > 256:
+            self._vc_cache = {k: v for k, v in self._vc_cache.items()
+                              if v[1] > now}
+        self._vc_cache[job_id] = (allowed, now + 5.0)
+        return allowed
+
     def _idle_worker(self, env_key: str = "") -> WorkerHandle | None:
         for handle in self._workers.values():
             if (handle.state == IDLE and handle.address
@@ -357,12 +382,28 @@ class NodeManager:
         gcs = self._clients.get(self._gcs_address)
         from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
 
+        pg_key = payload.get("pg")
+        job_id = payload.get("job_id")
+        # Virtual-cluster fencing: if this node isn't in the job's
+        # allowed set, redirect before doing any work here (ant-fork
+        # ref: node_manager.ant.cc cancels mismatched leases).  PG
+        # leases are exempt — the bundle reservation (placed under the
+        # VC filter at creation time) is the authority.
+        if pg_key is None and job_id is not None and \
+                not await self._job_allowed_here(job_id):
+            node = await gcs.call_async(
+                "SelectNode", {"resources": demand, "job_id": job_id,
+                               "exclude": self.node_id}, timeout=10)
+            if node is not None and node.node_id != self.node_id:
+                return {"spill": node.address}
+            return {"infeasible": True,
+                    "reason": "no node in this job's virtual cluster "
+                              "can satisfy the request"}
+
         runtime_env = payload.get("runtime_env")
         env_key = renv.env_key(runtime_env)
         if runtime_env:
             await self._ensure_runtime_env(runtime_env)
-
-        pg_key = payload.get("pg")
         if pg_key is not None:
             # Lease against a committed placement-group bundle: resources
             # come out of the reservation, never the general pool.
@@ -404,7 +445,8 @@ class NodeManager:
 
         if not self._feasible(demand):
             node = await gcs.call_async(
-                "SelectNode", {"resources": demand, "exclude": self.node_id},
+                "SelectNode", {"resources": demand, "job_id": job_id,
+                               "exclude": self.node_id},
                 timeout=10)
             if node is not None:
                 return {"spill": node.address}
@@ -431,7 +473,8 @@ class NodeManager:
             elif time.monotonic() > spill_deadline:
                 node = await gcs.call_async(
                     "SelectNode",
-                    {"resources": demand, "exclude": self.node_id},
+                    {"resources": demand, "job_id": job_id,
+                     "exclude": self.node_id},
                     timeout=10)
                 if node is not None and node.node_id != self.node_id:
                     return {"spill": node.address}

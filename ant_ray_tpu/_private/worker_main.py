@@ -16,6 +16,7 @@ import logging
 import os
 import queue
 import threading
+import time
 import traceback
 
 from ant_ray_tpu import exceptions
@@ -88,12 +89,23 @@ class TaskExecutor:
     # ---- execution
 
     def _execute(self, spec: TaskSpec) -> dict:
+        # Adopt the submitting job's identity: nested submits from this
+        # task must carry the job's id (virtual-cluster fencing and
+        # task-id lineage key off it).
+        self.runtime.job_id = spec.task_id.job_id()
         try:
             args, kwargs = self._load_args(spec)
         except exceptions.ArtError as e:
             # A dependency failed: propagate the *original* error through
             # this task's returns (error lineage, ref: RayTaskError chains).
             return self._error_returns(spec, e)
+        insight = None
+        if global_config().enable_insight:
+            from ant_ray_tpu.util import insight  # noqa: PLC0415
+
+            insight.record_call_begin(spec.function_name,
+                                      spec.task_id.hex())
+            started = time.monotonic()
         try:
             if spec.actor_id is not None:
                 if self.actor_instance is None:
@@ -112,7 +124,15 @@ class TaskExecutor:
             err_cls = (exceptions.ActorError if spec.actor_id is not None
                        else exceptions.TaskError)
             err = err_cls.from_exception(spec.function_name, e)
+            if insight is not None:
+                insight.record_call_end(
+                    spec.function_name, spec.task_id.hex(),
+                    time.monotonic() - started, error=True)
             return self._error_returns(spec, err)
+        if insight is not None:
+            insight.record_call_end(spec.function_name,
+                                    spec.task_id.hex(),
+                                    time.monotonic() - started)
         values = [result] if spec.num_returns == 1 else list(result)
         if len(values) != spec.num_returns:
             err = exceptions.TaskError(
@@ -216,6 +236,8 @@ def main():  # pragma: no cover — exercised via subprocess in tests
 
     async def handle_instantiate(spec: ActorSpec):
         executor.actor_spec = spec
+        if spec.job_id is not None:
+            runtime.job_id = spec.job_id  # actor belongs to its job
         fut = asyncio.get_running_loop().create_future()
 
         def _do_instantiate():

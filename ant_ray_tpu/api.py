@@ -8,6 +8,7 @@ ray.put, :3487 ray.remote).
 from __future__ import annotations
 
 import inspect
+import os
 from typing import Any, Sequence
 
 from ant_ray_tpu._private import worker as worker_mod
@@ -47,6 +48,19 @@ def init(
                            "pass ignore_reinit_error=True to allow")
 
     config = Config().apply_env_overrides().apply_dict(_system_config)
+    # Propagate _system_config to the daemons/workers this driver will
+    # spawn: flags travel as ART_<NAME> env vars, the same channel the
+    # reference uses to embed _system_config into raylet launch
+    # (ref: services.py:1518).
+    if _system_config:
+        import json as _json  # noqa: PLC0415
+
+        for key, value in _system_config.items():
+            name = f"ART_{key.upper()}"
+            _exported_config_env.append((name, os.environ.get(name)))
+            os.environ[name] = (
+                _json.dumps(value) if isinstance(value, (dict, list))
+                else str(value))
     if object_store_memory:
         config.object_store_memory = object_store_memory
     set_global_config(config)
@@ -110,8 +124,19 @@ class ClientContext:
         shutdown()
 
 
+_exported_config_env: list = []
+
+
 def shutdown() -> None:
     global_worker.shutdown()
+    # Undo _system_config env exports (restoring any pre-existing user
+    # value) so the next init() in this process starts clean.
+    while _exported_config_env:
+        name, prior = _exported_config_env.pop()
+        if prior is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = prior
 
 
 def is_initialized() -> bool:

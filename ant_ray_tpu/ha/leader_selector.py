@@ -1,0 +1,160 @@
+"""Leader election for head-node HA.
+
+Capability mirror of the ant fork's Redis-lease leader election
+(ref: python/ray/ha/leader_selector.py:8 HeadNodeLeaderSelector ABC,
+redis_leader_selector.py:90 RedisBasedLeaderSelector): standby heads
+poll a lease; the holder renews it; a holder that misses renewals is
+fenced out by expiry and a standby takes over.
+
+The default backend is a shared-filesystem lease (atomic O_EXCL create
++ mtime-based expiry + fencing token), which covers single-host HA
+tests and NFS deployments without a Redis dependency; the protocol —
+acquire / renew / expire / fence — matches the Redis variant, and a
+Redis backend can implement the same ABC where redis is available.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+
+
+class HeadNodeLeaderSelector:
+    """ABC (ref: ha/leader_selector.py:8)."""
+
+    role = "standby"
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        raise NotImplementedError
+
+    def is_leader(self) -> bool:
+        return self.role == "leader"
+
+    def wait_until_leader(self, timeout: float | None = None) -> bool:
+        raise NotImplementedError
+
+
+class FileBasedLeaderSelector(HeadNodeLeaderSelector):
+    """Lease file on a shared filesystem.
+
+    The lease is a JSON file {holder, token, renewed_at}, held by
+    renewing ``renewed_at`` and considered expired ``lease_ttl_s``
+    after the last renewal.  Acquisition is serialized through an
+    atomic ``mkdir`` mutex (only one contender enters the
+    check-expiry-then-write critical section, so there is no
+    dual-leader window); a mutex dir older than the TTL is treated as
+    the debris of a crashed contender and removed.  ``fencing_token()``
+    returns the holder's token so fenced writes can be rejected
+    downstream (same role as the Redis key's value in the reference).
+    """
+
+    def __init__(self, lease_path: str, *, holder_id: str | None = None,
+                 lease_ttl_s: float = 3.0, renew_period_s: float = 1.0):
+        self._path = lease_path
+        self._holder = holder_id or f"head-{os.getpid()}"
+        self._token = uuid.uuid4().hex
+        self._ttl = lease_ttl_s
+        self._renew_period = renew_period_s
+        self._stop = threading.Event()
+        self._became_leader = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # ---- lease file primitives
+
+    def _read_lease(self) -> dict | None:
+        try:
+            with open(self._path) as f:
+                return json.load(f)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return None
+
+    def _write_lease(self) -> None:
+        tmp = f"{self._path}.tmp.{os.getpid()}.{self._token[:8]}"
+        with open(tmp, "w") as f:
+            json.dump({"holder": self._holder, "token": self._token,
+                       "renewed_at": time.time()}, f)
+        os.rename(tmp, self._path)
+
+    def _try_acquire(self) -> bool:
+        lease = self._read_lease()
+        if lease is not None:
+            if lease.get("token") == self._token:
+                return True
+            if time.time() - lease.get("renewed_at", 0) < self._ttl:
+                return False
+        # Expired (or absent) — take the acquisition mutex so exactly
+        # one contender fences the old holder.
+        mutex = f"{self._path}.acquiring"
+        try:
+            os.mkdir(mutex)
+        except FileExistsError:
+            try:
+                if time.time() - os.path.getmtime(mutex) > self._ttl:
+                    os.rmdir(mutex)  # crashed contender's debris
+            except OSError:
+                pass
+            return False
+        try:
+            lease = self._read_lease()  # re-check under the mutex
+            if lease is not None and lease.get("token") != self._token \
+                    and time.time() - lease.get("renewed_at", 0) < \
+                    self._ttl:
+                return False
+            self._write_lease()
+            return True
+        finally:
+            try:
+                os.rmdir(mutex)
+            except OSError:
+                pass
+
+    # ---- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self._try_acquire():
+                if self.role != "leader":
+                    self.role = "leader"
+                    self._became_leader.set()
+                self._stop.wait(self._renew_period)
+                if not self._stop.is_set():
+                    lease = self._read_lease()
+                    if lease is None or lease.get("token") != self._token:
+                        # we were fenced — step down
+                        self.role = "standby"
+                        self._became_leader.clear()
+                    else:
+                        self._write_lease()  # renew
+            else:
+                self.role = "standby"
+                self._stop.wait(self._renew_period)
+
+    def wait_until_leader(self, timeout: float | None = None) -> bool:
+        return self._became_leader.wait(timeout)
+
+    def fencing_token(self) -> str:
+        return self._token
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        # Release the lease if we still hold it so standbys fail over
+        # immediately instead of waiting out the TTL.
+        lease = self._read_lease()
+        if lease is not None and lease.get("token") == self._token:
+            try:
+                os.unlink(self._path)
+            except FileNotFoundError:
+                pass
+        self.role = "standby"

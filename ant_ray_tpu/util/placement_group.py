@@ -75,6 +75,7 @@ def placement_group(bundles: list[dict], strategy: str = "PACK",
         "bundles": [dict(b) for b in bundles],
         "strategy": strategy,
         "name": name,
+        "job_id": runtime.job_id,  # VC-aware bundle placement
     }, retries=3)
     return PlacementGroup(pg_id, tuple(tuple(sorted(b.items()))
                                        for b in bundles), strategy)

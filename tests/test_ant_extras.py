@@ -1,0 +1,160 @@
+"""Ant-fork extras: virtual clusters, HA leader election, flow insight
+(ref capabilities: gcs_virtual_cluster_manager.h, python/ray/ha/,
+python/ray/util/insight.py)."""
+
+import os
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu.cluster_utils import Cluster
+from ant_ray_tpu.ha import FileBasedLeaderSelector
+from ant_ray_tpu.util import virtual_cluster as vc
+
+
+@pytest.fixture
+def three_nodes():
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"tagA": 1})
+    cluster.add_node(num_cpus=2, resources={"tagB": 1})
+    cluster.connect()
+    yield cluster
+    art.shutdown()
+    cluster.shutdown()
+
+
+def _node_id_with(resource):
+    for n in art.nodes():
+        if resource in n["Resources"]:
+            return n["NodeID"]
+    raise AssertionError(f"no node with {resource}")
+
+
+def test_virtual_cluster_fences_unbound_jobs(three_nodes):
+    tenant_node = _node_id_with("tagA")
+    vc.create_virtual_cluster("tenant", node_ids=[tenant_node])
+    assert "tenant" in vc.list_virtual_clusters()
+
+    @art.remote
+    def where():
+        return os.environ["ART_NODE_ID"]
+
+    # Unbound job: many tasks, none may land on the tenant's node.
+    spots = set(art.get([where.remote() for _ in range(8)], timeout=120))
+    assert tenant_node not in spots
+
+
+def test_virtual_cluster_binds_job(three_nodes):
+    tenant_node = _node_id_with("tagB")
+    vc.create_virtual_cluster("t2", node_ids=[tenant_node])
+    vc.bind_job("t2")
+    time.sleep(5.5)  # node-side fencing cache (5s TTL) expires
+
+    @art.remote
+    def where():
+        return os.environ["ART_NODE_ID"]
+
+    spots = set(art.get([where.remote() for _ in range(6)], timeout=120))
+    assert spots == {tenant_node}
+
+    vc.bind_job(None)
+    vc.remove_virtual_cluster("t2")
+    assert "t2" not in vc.list_virtual_clusters()
+
+
+def test_virtual_cluster_validation(three_nodes):
+    node = _node_id_with("tagA")
+    vc.create_virtual_cluster("v1", node_ids=[node])
+    with pytest.raises(ValueError, match="already assigned"):
+        vc.create_virtual_cluster("v2", node_ids=[node])
+    with pytest.raises(ValueError, match="exists"):
+        vc.create_virtual_cluster("v1", num_nodes=1)
+    with pytest.raises(ValueError, match="no virtual cluster"):
+        vc.bind_job("nope")
+
+
+def test_ha_leader_election_and_failover(tmp_path):
+    lease = str(tmp_path / "head.lease")
+    a = FileBasedLeaderSelector(lease, holder_id="a",
+                                lease_ttl_s=1.0, renew_period_s=0.2)
+    b = FileBasedLeaderSelector(lease, holder_id="b",
+                                lease_ttl_s=1.0, renew_period_s=0.2)
+    a.start()
+    assert a.wait_until_leader(5)
+    b.start()
+    time.sleep(0.8)
+    assert a.is_leader() and not b.is_leader()
+
+    a.stop()  # releases the lease → standby takes over fast
+    assert b.wait_until_leader(5)
+    assert b.is_leader()
+    b.stop()
+
+
+def test_ha_expired_lease_is_fenced(tmp_path):
+    lease = str(tmp_path / "head.lease")
+    a = FileBasedLeaderSelector(lease, holder_id="a",
+                                lease_ttl_s=0.6, renew_period_s=0.2)
+    a.start()
+    assert a.wait_until_leader(5)
+    # Simulate a frozen leader: stop renewing without releasing.
+    a._stop.set()
+    a._thread.join()
+    b = FileBasedLeaderSelector(lease, holder_id="b",
+                                lease_ttl_s=0.6, renew_period_s=0.2)
+    b.start()
+    assert b.wait_until_leader(5)
+    b.stop()
+
+
+def test_flow_insight_call_graph(shutdown_only):
+    art.init(num_cpus=2, _system_config={"enable_insight": True})
+    from ant_ray_tpu.util import insight
+
+    @art.remote
+    def traced(x):
+        return x + 1
+
+    @art.remote
+    def failing():
+        raise ValueError("nope")
+
+    art.get([traced.remote(i) for i in range(3)], timeout=120)
+    with pytest.raises(Exception):
+        art.get(failing.remote(), timeout=120)
+    time.sleep(0.5)  # oneway events drain
+
+    events = insight.get_flow_events()
+    kinds = {e["type"] for e in events}
+    assert {"call_submit", "call_begin", "call_end"} <= kinds
+    graph = insight.build_call_graph(events)
+    fn_stats = {name.split(".")[-1]: s
+                for name, s in graph["functions"].items()}
+    assert fn_stats["traced"]["calls"] == 3
+    assert fn_stats["failing"]["errors"] == 1
+    assert any(e["count"] >= 3 for e in graph["edges"])
+
+
+def test_virtual_cluster_nested_tasks_stay_fenced(three_nodes):
+    """Nested submits carry the parent job's identity, so children stay
+    inside the tenant's virtual cluster."""
+    tenant_node = _node_id_with("tagA")
+    vc.create_virtual_cluster("nest", node_ids=[tenant_node])
+    vc.bind_job("nest")
+    time.sleep(5.5)  # fencing caches expire
+
+    @art.remote
+    def child():
+        return os.environ["ART_NODE_ID"]
+
+    @art.remote
+    def parent():
+        import ant_ray_tpu as art_inner
+
+        return art_inner.get([child.remote() for _ in range(3)],
+                             timeout=90)
+
+    spots = set(art.get(parent.remote(), timeout=180))
+    assert spots == {tenant_node}
+    vc.bind_job(None)
