@@ -56,6 +56,11 @@ class Config:
     include_dashboard: bool = True
     # Emit flow-insight call-graph events (ant-fork util/insight).
     enable_insight: bool = False
+    # Evicted sealed objects spill to disk (session dir) and restore on
+    # access instead of being dropped (ref: LocalObjectManager).
+    enable_object_spilling: bool = True
+    # Per-node spill budget; past it, evictions drop instead of spill.
+    max_spill_bytes: int = 10 * 1024 * 1024 * 1024
     # LRU-evict unpinned objects when the store is this full.
     object_store_high_watermark: float = 0.8
 

@@ -65,8 +65,12 @@ class NodeManager:
         store_dir = os.path.join(
             "/dev/shm" if os.path.isdir("/dev/shm") else session_dir,
             f"art_{uuid.uuid4().hex[:8]}_{self.node_id.hex()[:8]}")
+        spill_dir = (os.path.join(session_dir,
+                                  f"spill_{self.node_id.hex()[:8]}")
+                     if cfg.enable_object_spilling else None)
         self.store = ObjectStore(store_dir, store_capacity,
-                                 on_delete=self._on_store_delete)
+                                 on_delete=self._on_store_delete,
+                                 spill_dir=spill_dir)
 
         self._total = dict(resources)
         self._available = dict(resources)

@@ -25,6 +25,7 @@ import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
+from ant_ray_tpu._private.config import global_config
 from ant_ray_tpu._private.ids import ObjectID
 from ant_ray_tpu.exceptions import ObjectLostError
 
@@ -57,7 +58,8 @@ class ObjectStore:
     """
 
     def __init__(self, directory: str, capacity_bytes: int,
-                 use_arena: bool = True, on_delete=None):
+                 use_arena: bool = True, on_delete=None,
+                 spill_dir: str | None = None):
         self._dir = directory
         os.makedirs(directory, exist_ok=True)
         self._capacity = capacity_bytes
@@ -66,6 +68,14 @@ class ObjectStore:
         # with each ObjectID removed by eviction or deletion, so the
         # daemon can retract the node's GCS location record.
         self._on_delete = on_delete
+        # Spill-on-evict to disk (ref: LocalObjectManager,
+        # local_object_manager.h:44): evicted sealed objects move to
+        # spill_dir and restore transparently on next access, so the
+        # node keeps serving them and no location retraction happens.
+        self._spill_dir = spill_dir
+        self._spilled: dict[ObjectID, int] = {}   # oid -> size
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
         self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
         self._lock = threading.RLock()
         self._arena = None
@@ -157,6 +167,8 @@ class ObjectStore:
         file offset (None = file-per-object fallback)."""
         with self._lock:
             entry = self._entries.get(object_id)
+            if entry is None and self._restore_locked(object_id):
+                entry = self._entries.get(object_id)
             if entry is None or not entry.sealed:
                 return None
             self._entries.move_to_end(object_id)
@@ -254,16 +266,83 @@ class ObjectStore:
             # freeing their slot while another process writes through its
             # view would corrupt whatever reuses the memory.
             if entry.pin_count == 0 and entry.sealed:
-                self._delete_locked(oid)
+                if self._spill_dir is not None:
+                    self._spill_locked(oid, entry)
+                else:
+                    self._delete_locked(oid)
                 return True
         return False
 
-    def _delete_locked(self, object_id: ObjectID) -> None:
+    def _spill_path(self, object_id: ObjectID) -> str:
+        return os.path.join(self._spill_dir, object_id.hex())
+
+    def _spill_locked(self, object_id: ObjectID, entry: ObjectEntry):
+        """Move a sealed object's payload to disk, then drop it from
+        memory WITHOUT retracting its location (this node still serves
+        it, via restore).
+
+        The disk write happens under the store lock — synchronous-spill
+        simplicity traded against the reference's async spill IO
+        workers (local_object_manager.h:109); revisit if eviction of
+        very large objects shows up on daemon latency."""
+        if self._spilled_bytes() + entry.size > \
+                global_config().max_spill_bytes:
+            logger.warning("spill capacity exhausted; dropping %s",
+                           object_id.hex()[:8])
+            self._delete_locked(object_id)
+            return
+        path = self._spill_path(object_id)
+        try:
+            with open(path, "wb") as f:
+                if entry.offset is not None:
+                    f.write(self._arena.view(entry.offset, entry.size))
+                else:
+                    with open(self.path_of(object_id), "rb") as src:
+                        f.write(src.read())
+        except OSError as e:
+            logger.warning("spill of %s failed (%s); dropping",
+                           object_id.hex()[:8], e)
+            self._delete_locked(object_id)
+            return
+        self._spilled[object_id] = entry.size
+        self._delete_locked(object_id, notify=False)
+
+    def _spilled_bytes(self) -> int:
+        return sum(self._spilled.values())
+
+    def _restore_locked(self, object_id: ObjectID) -> bool:
+        """Bring a spilled object back into the store (ref:
+        AsyncRestoreSpilledObject, local_object_manager.h:130).  The
+        spill record survives a failed restore (e.g. store full of
+        pinned entries) so a later access can retry."""
+        size = self._spilled.get(object_id)
+        if size is None:
+            return False
+        path = self._spill_path(object_id)
+        try:
+            with open(path, "rb") as f:
+                payload = f.read()
+        except FileNotFoundError:
+            self._spilled.pop(object_id, None)
+            return False
+        try:
+            self.create(object_id, payload)
+        except ObjectStoreFullError:
+            return False               # record kept; retry later
+        del self._spilled[object_id]
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        return True
+
+    def _delete_locked(self, object_id: ObjectID,
+                       notify: bool = True) -> None:
         entry = self._entries.pop(object_id, None)
         if entry is None:
             return
         self._used -= entry.size
-        if self._on_delete is not None and entry.sealed:
+        if notify and self._on_delete is not None and entry.sealed:
             self._on_delete(object_id)
         if entry.offset is not None:
             try:
@@ -280,7 +359,8 @@ class ObjectStore:
 
     def contains(self, object_id: ObjectID) -> bool:
         with self._lock:
-            return object_id in self._entries
+            return (object_id in self._entries
+                    or object_id in self._spilled)
 
     def size_of(self, object_id: ObjectID) -> int | None:
         with self._lock:
@@ -310,14 +390,12 @@ class ObjectStore:
         """notify=False suppresses the on_delete hook — used for GCS-
         driven deletes, where the location record is already gone."""
         with self._lock:
-            if not notify:
-                saved, self._on_delete = self._on_delete, None
+            if self._spilled.pop(object_id, None) is not None:
                 try:
-                    self._delete_locked(object_id)
-                finally:
-                    self._on_delete = saved
-                return
-            self._delete_locked(object_id)
+                    os.unlink(self._spill_path(object_id))
+                except FileNotFoundError:
+                    pass
+            self._delete_locked(object_id, notify=notify)
 
     def list_objects(self) -> list[ObjectID]:
         with self._lock:
@@ -327,6 +405,8 @@ class ObjectStore:
         """Read a chunk for cross-node transfer."""
         with self._lock:
             entry = self._entries.get(object_id)
+            if entry is None and self._restore_locked(object_id):
+                entry = self._entries.get(object_id)
             if entry is None:
                 raise ObjectLostError(object_id, "read on missing object")
             self._entries.move_to_end(object_id)
