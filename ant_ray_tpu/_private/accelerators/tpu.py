@@ -3,37 +3,155 @@
 Parity target: the reference's TPUAcceleratorManager
 (ref: python/ray/_private/accelerators/tpu.py:267 — GKE/GCE metadata
 detection :105, TPU_VISIBLE_CHIPS :36, valid types v2–v6e :65, topology
-tables :88, pod-type inference :151).  Redesigned: detection prefers cheap
-environment/sysfs signals over importing jax (daemon processes must stay
-light); jax is only consulted when explicitly requested.
+tables :88, pod-type inference :151, chips-per-host rule :184).
+Redesigned: detection prefers cheap environment/sysfs signals over
+importing jax (daemon processes must stay light); the GCE metadata server
+is consulted behind a short timeout when env vars are absent (plain GCE
+TPU-VMs set no TPU_* env vars — only GKE does); jax is only consulted
+when explicitly requested.
 """
 
 from __future__ import annotations
 
 import functools
 import glob
+import logging
 import os
+import threading
+import time
+import urllib.error
+import urllib.request
 
 from ant_ray_tpu._private.config import global_config
+
+logger = logging.getLogger(__name__)
 
 # Accelerator-type names (resource label values), v2 → v6e.
 VALID_TPU_TYPES = (
     "TPU-V2", "TPU-V3", "TPU-V4", "TPU-V5E", "TPU-V5P", "TPU-V6E",
 )
 
-# generation → (chips per host, peak bf16 TFLOP/s per chip, HBM GiB per chip)
+# generation → (max chips on a single-host node, peak bf16 TFLOP/s per
+# chip, HBM GiB per chip).  v5e/v6e are the 8-chip single-host
+# generations (ref: SINGLE_HOST_8_CHIPS_TPU_TYPES, tpu.py:59); all
+# others host 4 chips.
 TPU_HARDWARE_TABLE: dict[str, tuple[int, float, float]] = {
     "v2": (4, 45.0, 8),
     "v3": (4, 123.0, 16),
     "v4": (4, 275.0, 32),
-    "v5e": (4, 197.0, 16),
+    "v5e": (8, 197.0, 16),
     "v5p": (4, 459.0, 95),
-    "v6e": (4, 918.0, 32),
+    "v6e": (8, 918.0, 32),
 }
 
-# pod type → ICI torus topology strings the scheduler understands; a slice
-# topology "AxB" or "AxBxC" multiplies to the chip count.
+_EIGHT_CHIP_GENERATIONS = ("v5e", "v6e")
+
+# GCE instance-metadata server (ref: GCE_TPU_ACCELERATOR_ENDPOINT,
+# tpu.py:27-34).  The host is overridable so tests can stand up a local
+# mock; real TPU-VMs resolve metadata.google.internal instantly and
+# everything else fails DNS fast.
+_METADATA_ATTRIBUTES_URL = (
+    "http://metadata.google.internal/computeMetadata/v1/instance/attributes/"
+)
+_METADATA_KEY_ACCELERATOR_TYPE = "accelerator-type"
+_METADATA_KEY_INSTANCE_ID = "instance-id"
+_METADATA_KEY_WORKER_ID = "agent-worker-number"
+_METADATA_KEY_TPU_ENV = "tpu-env"
+
+
+def _metadata_base_url() -> str:
+    return os.environ.get("ART_GCE_METADATA_URL", _METADATA_ATTRIBUTES_URL)
+
+
+def _sysfs_chip_count() -> int:
+    """TPU devices visible in /dev — the cheap "am I a TPU-VM" signal
+    that gates metadata-server lookups (CPU hosts must never pay a DNS
+    stall in daemon startup)."""
+    vfio = glob.glob("/dev/vfio/*")
+    accel = glob.glob("/dev/accel*")
+    return (len([p for p in vfio if os.path.basename(p) != "vfio"])
+            or len(accel))
+
+
+def _may_query_metadata() -> bool:
+    if os.environ.get("ART_GCE_METADATA_URL"):
+        return True  # test mock is wired up
+    return _sysfs_chip_count() > 0
+
+
+# Successful lookups (incl. genuine 404 "attribute absent") are cached;
+# transient failures are NOT — a metadata server that is briefly slow at
+# boot must not pin None for the process lifetime.  After a failure the
+# server is considered unreachable for a grace window so the remaining
+# keys don't each pay the stall.
+_metadata_cache: dict[str, str | None] = {}
+_metadata_backoff_until = 0.0
+_METADATA_BACKOFF_S = 30.0
+_METADATA_DEADLINE_S = 1.0
+
+
+def _fetch_metadata_once(url: str) -> tuple[bool, str | None]:
+    """(ok, value) — run in a worker thread; ok=False means transient."""
+    req = urllib.request.Request(url, headers={"Metadata-Flavor": "Google"})
+    try:
+        with urllib.request.urlopen(
+                req, timeout=_METADATA_DEADLINE_S) as resp:
+            return True, (resp.read().decode() or None)
+    except urllib.error.HTTPError as e:
+        if e.code == 404:
+            return True, None  # attribute genuinely absent — cacheable
+        return False, None
+    except (urllib.error.URLError, OSError, ValueError) as e:
+        logger.debug("GCE metadata unavailable: %s", e)
+        return False, None
+
+
+def get_tpu_metadata(key: str) -> str | None:
+    """One instance-metadata attribute, or None.  The whole lookup —
+    including DNS resolution, which urlopen's timeout does not bound —
+    runs in a daemon thread joined with a hard deadline, so non-GCE
+    hosts (even VFIO-bearing ones with a dead resolver) can't stall
+    daemon startup."""
+    global _metadata_backoff_until
+    if os.environ.get("ART_DISABLE_GCE_METADATA") or \
+            not _may_query_metadata():
+        return None
+    if key in _metadata_cache:
+        return _metadata_cache[key]
+    if time.monotonic() < _metadata_backoff_until:
+        return None
+    result: list[tuple[bool, str | None]] = []
+    url = _metadata_base_url() + key
+    t = threading.Thread(
+        target=lambda: result.append(_fetch_metadata_once(url)), daemon=True)
+    t.start()
+    t.join(_METADATA_DEADLINE_S + 0.3)
+    if not result or not result[0][0]:
+        _metadata_backoff_until = time.monotonic() + _METADATA_BACKOFF_S
+        return None
+    value = result[0][1]
+    _metadata_cache[key] = value
+    return value
+
+
+def _metadata_cache_clear() -> None:
+    global _metadata_backoff_until
+    _metadata_cache.clear()
+    _metadata_backoff_until = 0.0
+
+
+get_tpu_metadata.cache_clear = _metadata_cache_clear  # test hook
+
+
+def normalize_generation(name: str) -> str:
+    """"v5litepod-16" / "TPU-V5E" / "v5e" → "v5e"."""
+    name = name.lower().replace("tpu-", "")
+    prefix = name.split("-")[0]
+    return {"v5litepod": "v5e"}.get(prefix, prefix)
+
+
 def topology_chip_count(topology: str) -> int:
+    """"AxB" / "AxBxC" slice topology → total chips."""
     dims = [int(d) for d in topology.lower().split("x")]
     count = 1
     for d in dims:
@@ -41,17 +159,41 @@ def topology_chip_count(topology: str) -> int:
     return count
 
 
+def chips_per_host(topology: str, generation: str) -> int:
+    """Chips per VM in a slice (ref rule: get_chips_per_host, tpu.py:184):
+    multi-host slices pack 4 chips per VM on every generation; v5e/v6e
+    slices of ≤8 chips fit on one VM holding all of them."""
+    total = topology_chip_count(topology)
+    if total <= 8 and normalize_generation(generation) in \
+            _EIGHT_CHIP_GENERATIONS:
+        return total
+    return 4
+
+
+def hosts_in_slice(topology: str, generation: str) -> int:
+    total = topology_chip_count(topology)
+    per_host = chips_per_host(topology, generation)
+    return max(1, (total + per_host - 1) // per_host)
+
+
+def infer_pod_type(topology: str, generation: str) -> str:
+    """("4x4", "v5e") → "v5e-16" (ref: infer_tpu_pod_type_from_topology)."""
+    return (f"{normalize_generation(generation)}-"
+            f"{topology_chip_count(topology)}")
+
+
 @functools.lru_cache(maxsize=1)
 def detect_generation() -> str | None:
-    """TPU generation of this host ("v5e", ...), or None."""
+    """TPU generation of this host ("v5e", ...), or None.  Order: explicit
+    override → GKE env var → GCE metadata server."""
     env = os.environ.get("ART_TPU_GENERATION")
     if env:
-        return env
+        return normalize_generation(env)
     accel_type = os.environ.get("TPU_ACCELERATOR_TYPE")  # GKE sets this
+    if not accel_type:
+        accel_type = get_tpu_metadata(_METADATA_KEY_ACCELERATOR_TYPE)
     if accel_type:  # e.g. "v5litepod-16"
-        prefix = accel_type.split("-")[0]
-        return {"v5litepod": "v5e", "v5p": "v5p", "v6e": "v6e"}.get(
-            prefix, prefix)
+        return normalize_generation(accel_type)
     return None
 
 
@@ -64,10 +206,7 @@ def num_tpu_chips() -> int:
     visible = os.environ.get("TPU_VISIBLE_CHIPS")
     if visible:
         return len([c for c in visible.split(",") if c.strip()])
-    # vfio devices exposed by the TPU driver
-    vfio = glob.glob("/dev/vfio/*")
-    accel = glob.glob("/dev/accel*")
-    count = len([p for p in vfio if os.path.basename(p) != "vfio"]) or len(accel)
+    count = _sysfs_chip_count()  # vfio/accel devices from the TPU driver
     if count:
         return count
     if os.environ.get("JAX_PLATFORMS", "").lower() in ("tpu", "axon"):
@@ -82,20 +221,50 @@ def num_tpu_chips() -> int:
 
 
 def current_pod_name() -> str | None:
-    return os.environ.get("TPU_NAME") or None
+    """Name of the TPU slice this host belongs to: GKE TPU_NAME env, else
+    the GCE instance id (ref: get_current_node_tpu_name, tpu.py:453)."""
+    name = os.environ.get("TPU_NAME")
+    if name:
+        return name
+    return get_tpu_metadata(_METADATA_KEY_INSTANCE_ID)
 
 
 def current_worker_id() -> int:
-    return int(os.environ.get("TPU_WORKER_ID", "0"))
+    """This host's index within its slice: GKE TPU_WORKER_ID env, else the
+    GCE agent-worker-number (ref: get_current_node_tpu_worker_id)."""
+    wid = os.environ.get("TPU_WORKER_ID")
+    if not wid:
+        wid = get_tpu_metadata(_METADATA_KEY_WORKER_ID)
+    try:
+        return int(wid) if wid else 0
+    except ValueError:
+        return 0
+
+
+def current_topology() -> str | None:
+    topology = os.environ.get("TPU_TOPOLOGY")
+    if topology:
+        return topology
+    # Plain GCE VMs carry the slice env in the `tpu-env` metadata blob
+    # (lines of KEY: 'value' pairs, ref: GCE_TPU_ENV_KEY usage).
+    blob = get_tpu_metadata(_METADATA_KEY_TPU_ENV)
+    if blob:
+        for line in blob.splitlines():
+            key, _, value = line.partition(":")
+            if key.strip() == "TOPOLOGY":
+                return value.strip().strip("'\"") or None
+    return None
 
 
 def peak_bf16_tflops(generation: str | None = None) -> float:
-    gen = generation or detect_generation() or "v5e"
+    gen = normalize_generation(generation) if generation \
+        else (detect_generation() or "v5e")
     return TPU_HARDWARE_TABLE.get(gen, TPU_HARDWARE_TABLE["v5e"])[1]
 
 
 def hbm_gib_per_chip(generation: str | None = None) -> float:
-    gen = generation or detect_generation() or "v5e"
+    gen = normalize_generation(generation) if generation \
+        else (detect_generation() or "v5e")
     return TPU_HARDWARE_TABLE.get(gen, TPU_HARDWARE_TABLE["v5e"])[2]
 
 
@@ -110,7 +279,9 @@ def node_labels() -> dict[str, str]:
     if pod:
         labels["tpu-pod-name"] = pod
         labels["tpu-worker-id"] = str(current_worker_id())
-    topology = os.environ.get("TPU_TOPOLOGY")
+    topology = current_topology()
     if topology:
         labels["tpu-topology"] = topology
+        if gen:
+            labels["tpu-pod-type"] = infer_pod_type(topology, gen)
     return labels

@@ -47,6 +47,7 @@ from ant_ray_tpu._private.specs import (
     ACTOR_ALIVE,
     ACTOR_DEAD,
     ActorSpec,
+    PromotedArgs,
     TaskSpec,
 )
 from ant_ray_tpu._private.task_options import ActorOptions, TaskOptions
@@ -124,6 +125,14 @@ class ClusterRuntime(CoreRuntime):
         self._local_refs: dict[ObjectID, int] = {}
         self._borrows: dict[ObjectID, int] = {}       # borrows of objects I own
         self._pins: dict[ObjectID, int] = {}          # in-flight task args
+        # nested refs pinned for the lifetime of an owned outer object
+        # (put() of a value containing refs) — released when the outer
+        # object is freed, so inner objects don't leak (ref: nested-ref
+        # release in ReferenceCounter, reference_counter.h:44)
+        self._contained_pins: dict[ObjectID, list] = {}
+        # refs pinned inside actor-constructor args — released when the
+        # actor can no longer restart (killed or permanently dead)
+        self._actor_ctor_pins: dict[ActorID, list] = {}
         self._borrowed_from: dict[ObjectID, str] = {} # owner addr of my borrows
         self._ref_lock = threading.Lock()
         set_refcount_hook(self._refcount_event)
@@ -242,6 +251,11 @@ class ClusterRuntime(CoreRuntime):
             if entry is not None and entry[0] == "plasma":
                 self._send_oneway(self.gcs_address, "FreeObject",
                                   {"object_id": oid})
+            # Freeing the outer object releases its nested-ref pins
+            # (may cascade into freeing the inner objects too).
+            inner = self._contained_pins.pop(oid, None)
+            if inner:
+                self._unpin_locked(inner)
 
     def _send_oneway(self, address: str, method: str, payload):
         if not address or address == "local":
@@ -275,19 +289,25 @@ class ClusterRuntime(CoreRuntime):
 
     def _pin(self, refs: Sequence[ObjectRef]):
         with self._ref_lock:
-            for ref in refs:
-                self._pins[ref.id] = self._pins.get(ref.id, 0) + 1
+            self._pin_locked(refs)
+
+    def _pin_locked(self, refs: Sequence[ObjectRef]):
+        for ref in refs:
+            self._pins[ref.id] = self._pins.get(ref.id, 0) + 1
 
     def _unpin(self, refs: Sequence[ObjectRef]):
         with self._ref_lock:
-            for ref in refs:
-                count = self._pins.get(ref.id, 0) - 1
-                if count <= 0:
-                    self._pins.pop(ref.id, None)
-                    if self.memory.is_owned(ref.id):
-                        self._maybe_free_locked(ref.id)
-                else:
-                    self._pins[ref.id] = count
+            self._unpin_locked(refs)
+
+    def _unpin_locked(self, refs: Sequence[ObjectRef]):
+        for ref in refs:
+            count = self._pins.get(ref.id, 0) - 1
+            if count <= 0:
+                self._pins.pop(ref.id, None)
+                if self.memory.is_owned(ref.id):
+                    self._maybe_free_locked(ref.id)
+            else:
+                self._pins[ref.id] = count
 
     # ------------------------------------------------------------ export
 
@@ -340,7 +360,10 @@ class ClusterRuntime(CoreRuntime):
         oid = object_id or self._next_put_id()
         payload = ser.to_payload()
         if ser.contained_refs:
-            self._pin(ser.contained_refs)  # nested refs live while object does
+            with self._ref_lock:  # nested refs live while the object does
+                self._pin_locked(ser.contained_refs)
+                self._contained_pins.setdefault(oid, []).extend(
+                    ser.contained_refs)
         if len(payload) <= global_config().max_inline_object_size:
             self.memory.put(oid, "inline", payload)
         else:
@@ -563,15 +586,13 @@ class ClusterRuntime(CoreRuntime):
             self.memory.mark_pending(oid)
             return_refs.append(ObjectRef(oid, owner_address=self.address))
 
-        ser = serialization.serialize((args, kwargs))
-        if ser.contained_refs:
-            self._pin(ser.contained_refs)
+        args_payload, pinned = self._pack_args(args, kwargs)
         cfg = global_config()
         spec = TaskSpec(
             task_id=task_id,
             function_id=fn_key,
             function_name=remote_function.function_name,
-            args_payload=ser.to_payload(),
+            args_payload=args_payload,
             num_returns=num_returns,
             owner_address=self.address,
             resources=options.resource_demand(),
@@ -586,7 +607,6 @@ class ClusterRuntime(CoreRuntime):
                 options.placement_group_bundle_index, 0),
             runtime_env=self._package_runtime_env(options.runtime_env),
         )
-        pinned = list(ser.contained_refs)
         if cfg.enable_insight:
             from ant_ray_tpu.util import insight  # noqa: PLC0415
 
@@ -595,6 +615,24 @@ class ClusterRuntime(CoreRuntime):
         asyncio.run_coroutine_threadsafe(
             self._run_normal_task(spec, pinned), self._io.loop)
         return return_refs[0] if num_returns == 1 else return_refs
+
+    def _pack_args(self, args, kwargs) -> tuple[bytes, list]:
+        """Serialize task args; large blobs are promoted to plasma so the
+        control-plane RPC frame stays small (ref behavior:
+        max_direct_call_object_size).  Returns (wire payload, refs pinned
+        for the task's lifetime — unpinned by the caller on completion)."""
+        ser = serialization.serialize((args, kwargs))
+        payload = ser.to_payload()
+        if len(payload) <= global_config().max_inline_object_size:
+            if ser.contained_refs:
+                self._pin(ser.contained_refs)
+            return payload, list(ser.contained_refs)
+        # put_serialized() pins the contained refs for the plasma object's
+        # lifetime; the task pins only the promoted object itself.
+        args_ref = self.put_serialized(ser)
+        self._pin([args_ref])
+        wrapper = serialization.serialize(PromotedArgs(args_ref))
+        return wrapper.to_payload(), [args_ref]
 
     def _package_runtime_env(self, runtime_env: dict | None):
         """Stage a runtime env into GCS KV (cached per content)."""
@@ -849,14 +887,32 @@ class ClusterRuntime(CoreRuntime):
         cls_key = self.export(actor_class.cls, "cls")
         actor_id = ActorID.of(self.job_id)
         ser = serialization.serialize((args, kwargs))
-        if ser.contained_refs:
+        args_payload = ser.to_payload()
+        # Large ctor args travel through plasma like task args do —
+        # except for detached actors, whose restarts must outlive this
+        # owner process, so their args stay embedded in the GCS spec.
+        promote = (options.lifetime != "detached"
+                   and len(args_payload)
+                   > global_config().max_inline_object_size)
+        if promote:
+            args_ref = self.put_serialized(ser)
+            self._pin([args_ref])
+            with self._ref_lock:
+                self._actor_ctor_pins[actor_id] = [args_ref]
+            args_payload = serialization.serialize(
+                PromotedArgs(args_ref)).to_payload()
+        elif ser.contained_refs:
+            # Constructor args must survive actor restarts; released when
+            # the actor is killed or observed permanently dead.
             self._pin(ser.contained_refs)
+            with self._ref_lock:
+                self._actor_ctor_pins[actor_id] = list(ser.contained_refs)
         cfg = global_config()
         spec = ActorSpec(
             actor_id=actor_id,
             class_id=cls_key,
             class_name=actor_class._class_name,
-            args_payload=ser.to_payload(),
+            args_payload=args_payload,
             owner_address=self.address,
             resources=options.resource_demand(),
             placement_resources=options.placement_demand(),
@@ -921,6 +977,15 @@ class ClusterRuntime(CoreRuntime):
         state = self._actor_states.get(handle.actor_id)
         if state is not None:
             state.address = ""
+        if no_restart:
+            self._release_actor_ctor_pins(handle.actor_id)
+
+    def _release_actor_ctor_pins(self, actor_id):
+        """Drop constructor-arg pins once the actor can never restart."""
+        with self._ref_lock:
+            pins = self._actor_ctor_pins.pop(actor_id, None)
+            if pins:
+                self._unpin_locked(pins)
 
     def cancel(self, ref, force=False, recursive=True):
         # Round 1: cancellation of queued (not yet leased) tasks only is
@@ -938,14 +1003,12 @@ class ClusterRuntime(CoreRuntime):
             self.memory.mark_pending(oid)
             return_refs.append(ObjectRef(oid, owner_address=self.address))
 
-        ser = serialization.serialize((args, kwargs))
-        if ser.contained_refs:
-            self._pin(ser.contained_refs)
+        args_payload, pinned = self._pack_args(args, kwargs)
         spec = TaskSpec(
             task_id=task_id,
             function_id="",
             function_name=f"{handle.class_name}.{method_name}",
-            args_payload=ser.to_payload(),
+            args_payload=args_payload,
             num_returns=num_returns,
             owner_address=self.address,
             resources={},
@@ -953,7 +1016,6 @@ class ClusterRuntime(CoreRuntime):
             actor_id=actor_id,
             method_name=method_name,
         )
-        pinned = list(ser.contained_refs)
 
         def _enqueue():
             state = self._actor_states.get(actor_id)
@@ -989,6 +1051,7 @@ class ClusterRuntime(CoreRuntime):
                         reason = (info or {}).get("death_reason",
                                                   "actor not found")
                         state.dead_reason = reason or "failed to start"
+                        self._release_actor_ctor_pins(state.actor_id)
                         self._store_error(spec, exceptions.ActorDiedError(
                             state.actor_id, state.dead_reason))
                         self._unpin(pinned)
@@ -1041,6 +1104,7 @@ class ClusterRuntime(CoreRuntime):
         if not may_restart:
             state.dead_reason = (info or {}).get(
                 "death_reason", "worker connection lost") or "worker died"
+            self._release_actor_ctor_pins(state.actor_id)
         self._store_error(spec, exceptions.ActorDiedError(
             state.actor_id,
             (info or {}).get("death_reason", "")

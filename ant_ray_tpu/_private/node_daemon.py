@@ -58,7 +58,14 @@ class NodeManager:
         self._clients = ClientPool()
         self._io = IoThread.get()
         self._session_dir = session_dir
-        self._labels = dict(labels or {})
+        # Auto-detected TPU slice labels (generation/pod/topology) merged
+        # under explicit labels — this node process runs on the host being
+        # described, so detection happens here, not in the launcher
+        # (ref: node label advertisement for SlicePlacementGroup,
+        # python/ray/util/tpu.py:52).
+        from ant_ray_tpu._private.accelerators import tpu as _tpu  # noqa: PLC0415
+
+        self._labels = {**_tpu.node_labels(), **(labels or {})}
 
         cfg = global_config()
         store_capacity = cfg.object_store_memory or default_store_capacity()

@@ -162,6 +162,11 @@ class RpcServer:
         self.address = f"{self._host}:{port}"
 
     async def _handle_conn(self, reader, writer):
+        # One write lock per connection: replies are written by concurrently
+        # dispatched handler tasks, and StreamWriter.drain() is not safe to
+        # call from two coroutines at once when flow control pauses the
+        # transport (FlowControlMixin._drain_helper asserts).
+        write_lock = asyncio.Lock()
         try:
             while True:
                 try:
@@ -169,12 +174,14 @@ class RpcServer:
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
                 _spawn(
-                    self._dispatch(writer, kind, msg_id, method, payload)
+                    self._dispatch(
+                        writer, write_lock, kind, msg_id, method, payload)
                 )
         finally:
             writer.close()
 
-    async def _dispatch(self, writer, kind, msg_id, method, payload):
+    async def _dispatch(self, writer, write_lock, kind, msg_id, method,
+                        payload):
         handler = self._routes.get(method)
         try:
             if handler is None:
@@ -192,8 +199,9 @@ class RpcServer:
             except Exception:
                 frame = _encode_frame((_ERR, msg_id, method, RpcError(repr(e))))
         try:
-            writer.write(frame)
-            await writer.drain()
+            async with write_lock:
+                writer.write(frame)
+                await writer.drain()
         except (ConnectionResetError, BrokenPipeError):
             pass
 
@@ -223,12 +231,18 @@ class RpcClient:
         self._writer: asyncio.StreamWriter | None = None
         self._pending: dict[int, asyncio.Future] = {}
         self._conn_lock: asyncio.Lock | None = None
+        # Serializes write+drain: concurrent drains on one StreamWriter are
+        # unsafe once the transport pauses (see server-side note).  Lock
+        # acquisition is FIFO, so sequential senders keep their send order.
+        self._write_lock: asyncio.Lock | None = None
         self._chaos = _ChaosInjector(global_config().testing_rpc_failure)
         self._closed = False
 
     async def _ensure_connected(self):
         if self._conn_lock is None:
             self._conn_lock = asyncio.Lock()
+        if self._write_lock is None:
+            self._write_lock = asyncio.Lock()
         async with self._conn_lock:
             if self._writer is not None and not self._writer.is_closing():
                 return
@@ -286,8 +300,14 @@ class RpcClient:
         # wait_for timeout — so abandoned calls never leak their entry.
         fut.add_done_callback(
             lambda _f, mid=msg_id: self._pending.pop(mid, None))
-        self._writer.write(_encode_frame((_REQ, msg_id, method, payload)))
-        await self._writer.drain()
+        frame = _encode_frame((_REQ, msg_id, method, payload))
+        async with self._write_lock:
+            writer = self._writer
+            if writer is None:
+                raise RpcConnectionError(
+                    f"connection to {self.address} lost")
+            writer.write(frame)
+            await writer.drain()
         return fut
 
     async def call_async(
@@ -305,8 +325,14 @@ class RpcClient:
 
     async def oneway_async(self, method: str, payload: Any = None) -> None:
         await self._ensure_connected()
-        self._writer.write(_encode_frame((_ONEWAY, -1, method, payload)))
-        await self._writer.drain()
+        frame = _encode_frame((_ONEWAY, -1, method, payload))
+        async with self._write_lock:
+            writer = self._writer
+            if writer is None:
+                raise RpcConnectionError(
+                    f"connection to {self.address} lost")
+            writer.write(frame)
+            await writer.drain()
 
     def call(self, method: str, payload: Any = None,
              timeout: float | None = None, retries: int = 0) -> Any:

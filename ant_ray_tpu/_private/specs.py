@@ -8,6 +8,18 @@ from dataclasses import dataclass, field
 from ant_ray_tpu._private.ids import ActorID, JobID, NodeID, TaskID
 
 
+class PromotedArgs:
+    """Marker for task args promoted to the object plane: above
+    max_inline_object_size the (args, kwargs) blob is put into plasma and
+    the spec carries only this ref (ref: max_direct_call_object_size —
+    large args never travel inside the control-plane RPC frame)."""
+
+    __slots__ = ("ref",)
+
+    def __init__(self, ref):
+        self.ref = ref
+
+
 @dataclass
 class TaskSpec:
     task_id: TaskID

@@ -329,6 +329,13 @@ class Worker:
                 refs, num_returns, timeout, fetch_local)
             if len(ready) >= num_returns or (
                     deadline is not None and time.monotonic() >= deadline):
+                # Contract (matches the reference): at most num_returns
+                # refs come back ready; surplus ready refs stay in the
+                # continuation list so `done, refs = wait(refs, 1)` loops
+                # never drop results.
+                if len(ready) > num_returns:
+                    not_ready = ready[num_returns:] + not_ready
+                    ready = ready[:num_returns]
                 return ready, not_ready
             time.sleep(0.005)
 

@@ -25,7 +25,13 @@ from ant_ray_tpu._private.config import global_config
 from ant_ray_tpu._private.core import ClusterRuntime
 from ant_ray_tpu._private.ids import JobID, NodeID, ObjectID, WorkerID
 from ant_ray_tpu._private.protocol import IoThread
-from ant_ray_tpu._private.specs import ACTOR_ALIVE, ACTOR_DEAD, ActorSpec, TaskSpec
+from ant_ray_tpu._private.specs import (
+    ACTOR_ALIVE,
+    ACTOR_DEAD,
+    ActorSpec,
+    PromotedArgs,
+    TaskSpec,
+)
 from ant_ray_tpu._private.worker import CLUSTER_MODE, global_worker
 from ant_ray_tpu.object_ref import ObjectRef
 
@@ -156,7 +162,13 @@ class TaskExecutor:
 
     def _load_args(self, spec: TaskSpec):
         ser = serialization.SerializedObject.from_payload(spec.args_payload)
-        args, kwargs = serialization.deserialize(ser)
+        obj = serialization.deserialize(ser)
+        if isinstance(obj, PromotedArgs):
+            # Large args were promoted to plasma by the submitter; the
+            # fetch registers this worker as a borrower of nested refs.
+            args, kwargs = self.runtime.get([obj.ref], timeout=None)[0]
+        else:
+            args, kwargs = obj
         args = [self._maybe_fetch(a) for a in args]
         kwargs = {k: self._maybe_fetch(v) for k, v in kwargs.items()}
         return args, kwargs
@@ -245,7 +257,11 @@ def main():  # pragma: no cover — exercised via subprocess in tests
                 cls = runtime.fetch_code(spec.class_id)
                 ser = serialization.SerializedObject.from_payload(
                     spec.args_payload)
-                args, kwargs = serialization.deserialize(ser)
+                obj = serialization.deserialize(ser)
+                if isinstance(obj, PromotedArgs):
+                    args, kwargs = runtime.get([obj.ref], timeout=None)[0]
+                else:
+                    args, kwargs = obj
                 args = [executor._maybe_fetch(a) for a in args]
                 kwargs = {k: executor._maybe_fetch(v)
                           for k, v in kwargs.items()}

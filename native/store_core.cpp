@@ -133,10 +133,17 @@ PyObject* arena_alloc(Arena* self, PyObject* arg) {
   }
   unsigned long long nbytes_in = PyLong_AsUnsignedLongLong(arg);
   if (PyErr_Occurred()) return nullptr;
+  uint64_t cap = self->heap_size();
+  // Reject before the align_up below can wrap: a request near
+  // UINT64_MAX would otherwise alias to a tiny `need`, silently
+  // handing out a block the caller will overrun.
+  if (nbytes_in > cap) {
+    PyErr_SetString(PyExc_MemoryError, "arena full");
+    return nullptr;
+  }
   // Payload + header/footer tags, aligned.
   uint64_t need = align_up(nbytes_in + 2 * sizeof(uint64_t), kAlign);
   uint64_t off = 0;
-  uint64_t cap = self->heap_size();
   while (off < cap) {
     uint64_t tag = self->read_tag(off);
     uint64_t size = Arena::tag_size(tag);
@@ -217,7 +224,9 @@ PyObject* arena_view(Arena* self, PyObject* args) {
     return nullptr;
   }
   uint64_t heap_start = align_up(sizeof(ArenaHeader), kAlign);
-  if (off + nbytes > self->file_size - heap_start) {
+  uint64_t heap_bytes = self->file_size - heap_start;
+  // Overflow-safe bound: check each term, then the sum via subtraction.
+  if (off > heap_bytes || nbytes > heap_bytes - off) {
     PyErr_SetString(PyExc_ValueError, "view out of range");
     return nullptr;
   }

@@ -13,6 +13,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # ART_JAX_PLATFORM makes ant_ray_tpu's jax_utils force it via jax.config
 # (inherited by worker subprocesses).
 os.environ["ART_JAX_PLATFORM"] = "cpu"
+# Spawned daemons/workers must never consult the GCE metadata server
+# (tests mock it explicitly where needed via ART_GCE_METADATA_URL).
+os.environ.setdefault("ART_DISABLE_GCE_METADATA", "1")
 
 from ant_ray_tpu._private.jax_utils import import_jax  # noqa: E402
 

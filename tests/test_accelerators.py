@@ -1,0 +1,159 @@
+"""TPU accelerator-manager tests: hardware table, slice math, and
+GCE metadata-server detection against a local mock
+(ref test model: python/ray/tests/accelerators/test_tpu.py)."""
+
+import http.server
+import threading
+
+import pytest
+
+from ant_ray_tpu._private.accelerators import tpu
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches(monkeypatch):
+    monkeypatch.delenv("ART_DISABLE_GCE_METADATA", raising=False)
+    monkeypatch.delenv("ART_GCE_METADATA_URL", raising=False)
+    monkeypatch.delenv("ART_TPU_GENERATION", raising=False)
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    monkeypatch.delenv("TPU_NAME", raising=False)
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    monkeypatch.delenv("TPU_TOPOLOGY", raising=False)
+    tpu.get_tpu_metadata.cache_clear()
+    tpu.detect_generation.cache_clear()
+    yield
+    tpu.get_tpu_metadata.cache_clear()
+    tpu.detect_generation.cache_clear()
+
+
+# ----------------------------------------------------------- hardware table
+
+def test_v5e_v6e_are_8_chip_hosts():
+    """Regression: v5e/v6e host 8 chips, not 4 (ref:
+    SINGLE_HOST_8_CHIPS_TPU_TYPES, _private/accelerators/tpu.py:59)."""
+    assert tpu.TPU_HARDWARE_TABLE["v5e"][0] == 8
+    assert tpu.TPU_HARDWARE_TABLE["v6e"][0] == 8
+    for gen in ("v2", "v3", "v4", "v5p"):
+        assert tpu.TPU_HARDWARE_TABLE[gen][0] == 4
+
+
+def test_normalize_generation():
+    assert tpu.normalize_generation("v5litepod-16") == "v5e"
+    assert tpu.normalize_generation("TPU-V5E") == "v5e"
+    assert tpu.normalize_generation("v6e-8") == "v6e"
+    assert tpu.normalize_generation("v4") == "v4"
+
+
+def test_chips_per_host_slice_rule():
+    # Multi-host slices pack 4 chips/VM on every generation.
+    assert tpu.chips_per_host("2x8", "v5e") == 4     # 16 chips, 4 hosts
+    assert tpu.chips_per_host("4x4", "v6e") == 4
+    assert tpu.chips_per_host("4x4x4", "v4") == 4
+    # v5e/v6e single-host slices keep all chips on the one VM.
+    assert tpu.chips_per_host("2x4", "v5e") == 8
+    assert tpu.chips_per_host("2x2", "v6e") == 4
+    assert tpu.chips_per_host("1x1", "v5e") == 1
+
+
+def test_hosts_in_slice():
+    assert tpu.hosts_in_slice("4x8", "v5e") == 8     # v5e-32
+    assert tpu.hosts_in_slice("8x8", "v5e") == 16    # v5e-64 (north star)
+    assert tpu.hosts_in_slice("2x4", "v5e") == 1
+    assert tpu.hosts_in_slice("2x2x2", "v4") == 2
+
+
+def test_infer_pod_type():
+    assert tpu.infer_pod_type("4x4", "TPU-V5E") == "v5e-16"
+    assert tpu.infer_pod_type("8x8", "v5litepod-64") == "v5e-64"
+    assert tpu.infer_pod_type("2x2x2", "v4") == "v4-8"
+
+
+# ------------------------------------------------------------ GCE metadata
+
+class _MetadataHandler(http.server.BaseHTTPRequestHandler):
+    attributes = {}
+
+    def do_GET(self):  # noqa: N802 — stdlib API
+        if self.headers.get("Metadata-Flavor") != "Google":
+            self.send_response(403)
+            self.end_headers()
+            return
+        key = self.path.rsplit("/", 1)[-1]
+        value = self.attributes.get(key)
+        if value is None:
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = value.encode()
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def metadata_server(monkeypatch):
+    server = http.server.HTTPServer(("127.0.0.1", 0), _MetadataHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    monkeypatch.setenv(
+        "ART_GCE_METADATA_URL",
+        f"http://127.0.0.1:{server.server_address[1]}/attributes/")
+    yield _MetadataHandler
+    server.shutdown()
+    _MetadataHandler.attributes = {}
+
+
+def test_metadata_generation_detection(metadata_server):
+    """A plain GCE TPU-VM (no GKE env vars) detects its generation from
+    the metadata server (ref: _get_tpu_metadata, tpu.py:105)."""
+    metadata_server.attributes = {"accelerator-type": "v5litepod-16"}
+    assert tpu.detect_generation() == "v5e"
+
+
+def test_metadata_pod_name_and_worker_id(metadata_server):
+    metadata_server.attributes = {
+        "instance-id": "t1v-n-abc123-w-0",
+        "agent-worker-number": "3",
+    }
+    assert tpu.current_pod_name() == "t1v-n-abc123-w-0"
+    assert tpu.current_worker_id() == 3
+
+
+def test_metadata_topology_from_tpu_env(metadata_server):
+    metadata_server.attributes = {
+        "tpu-env": "ACCELERATOR_TYPE: 'v5litepod-16'\nTOPOLOGY: '4x4'\n",
+    }
+    assert tpu.current_topology() == "4x4"
+
+
+def test_gke_env_wins_over_metadata(metadata_server, monkeypatch):
+    metadata_server.attributes = {"accelerator-type": "v5litepod-16"}
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v6e-8")
+    assert tpu.detect_generation() == "v6e"
+
+
+def test_metadata_gated_off_without_tpu_devices(monkeypatch):
+    """CPU hosts never query the metadata server (no DNS stall in daemon
+    startup): without the test URL override and without /dev TPU devices,
+    the lookup short-circuits to None."""
+    monkeypatch.setattr(tpu, "_sysfs_chip_count", lambda: 0)
+    assert tpu.get_tpu_metadata("accelerator-type") is None
+
+
+def test_node_labels_with_metadata(metadata_server, monkeypatch):
+    metadata_server.attributes = {
+        "accelerator-type": "v5litepod-16",
+        "instance-id": "my-slice",
+        "agent-worker-number": "1",
+        "tpu-env": "TOPOLOGY: '4x4'\n",
+    }
+    labels = tpu.node_labels()
+    assert labels["tpu-generation"] == "v5e"
+    assert labels["tpu-pod-name"] == "my-slice"
+    assert labels["tpu-worker-id"] == "1"
+    assert labels["tpu-topology"] == "4x4"
+    assert labels["tpu-pod-type"] == "v5e-16"

@@ -130,6 +130,95 @@ def test_wait(cluster):
     assert not_ready == [slow]
 
 
+def test_wait_num_returns_caps_ready(cluster):
+    """num_returns bounds the ready list even when more refs are done, and
+    the surplus stays in the continuation list (reference contract)."""
+    @art.remote
+    def quick(i):
+        return i
+
+    refs = [quick.remote(i) for i in range(4)]
+    art.get(list(refs))  # everything is ready now
+    ready, not_ready = art.wait(refs, num_returns=1, timeout=5.0)
+    assert len(ready) == 1
+    assert len(not_ready) == 3
+    assert set(r.id for r in ready + not_ready) == set(r.id for r in refs)
+
+    # The canonical drain loop sees every result exactly once.
+    seen, pending = [], refs
+    while pending:
+        done, pending = art.wait(pending, num_returns=1, timeout=5.0)
+        seen.extend(art.get(done))
+    assert sorted(seen) == [0, 1, 2, 3]
+
+
+def test_large_args_promoted_to_plasma(cluster):
+    """Args above the inline threshold travel through plasma, not the
+    control-plane RPC frame, and arrive intact (incl. nested refs)."""
+    big = np.arange(1_000_000, dtype=np.float64)  # 8 MB >> 100 KB threshold
+    inner = art.put({"tag": 42})
+
+    @art.remote
+    def consume(arr, nested):
+        return float(arr.sum()), art.get(nested[0])["tag"]
+
+    total, tag = art.get(consume.remote(big, [inner]))
+    assert total == float(big.sum())
+    assert tag == 42
+
+
+def test_large_actor_ctor_args_promoted(cluster):
+    """Actor constructor args above the inline threshold travel through
+    plasma (like task args), and the actor still restarts correctly."""
+    big = np.arange(1_000_000, dtype=np.float64)
+
+    @art.remote(max_restarts=1)
+    class Holder:
+        def __init__(self, arr):
+            self.total = float(arr.sum())
+
+        def get(self):
+            return self.total
+
+        def crash(self):
+            import os
+            os._exit(1)
+
+    h = Holder.remote(big)
+    expect = float(big.sum())
+    assert art.get(h.get.remote()) == expect
+    h.crash.remote()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            assert art.get(h.get.remote()) == expect  # restarted w/ same args
+            break
+        except ActorDiedError:
+            time.sleep(0.2)
+    else:
+        raise AssertionError("actor did not restart in time")
+
+
+def test_nested_ref_pins_released_with_outer(cluster):
+    """put() of a value containing refs pins the inner refs only for the
+    outer object's lifetime (regression: pins used to leak forever)."""
+    import gc
+
+    from ant_ray_tpu._private.worker import global_worker
+
+    rt = global_worker.runtime
+    inner = art.put(123)
+    outer = art.put([inner])
+    oid = outer.id
+    assert oid in rt._contained_pins
+    assert rt._pins.get(inner.id, 0) >= 1
+    del outer
+    gc.collect()
+    assert oid not in rt._contained_pins
+    assert rt._pins.get(inner.id, 0) == 0
+    assert art.get(inner) == 123  # inner still alive via the local ref
+
+
 def test_actor_state_and_ordering(cluster):
     @art.remote
     class Counter:
