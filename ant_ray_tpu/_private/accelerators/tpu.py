@@ -13,7 +13,6 @@ when explicitly requested.
 
 from __future__ import annotations
 
-import functools
 import glob
 import logging
 import os
@@ -182,19 +181,33 @@ def infer_pod_type(topology: str, generation: str) -> str:
             f"{topology_chip_count(topology)}")
 
 
-@functools.lru_cache(maxsize=1)
+_generation_memo: list = []  # [gen] once positively detected
+
+
 def detect_generation() -> str | None:
     """TPU generation of this host ("v5e", ...), or None.  Order: explicit
-    override → GKE env var → GCE metadata server."""
+    override → GKE env var → GCE metadata server.  Only POSITIVE results
+    memoize — a transiently-unreachable metadata server must not pin
+    None for the process lifetime (the metadata layer has its own
+    short backoff)."""
+    if _generation_memo:
+        return _generation_memo[0]
     env = os.environ.get("ART_TPU_GENERATION")
-    if env:
-        return normalize_generation(env)
-    accel_type = os.environ.get("TPU_ACCELERATOR_TYPE")  # GKE sets this
+    accel_type = env or os.environ.get("TPU_ACCELERATOR_TYPE")  # GKE
     if not accel_type:
         accel_type = get_tpu_metadata(_METADATA_KEY_ACCELERATOR_TYPE)
     if accel_type:  # e.g. "v5litepod-16"
-        return normalize_generation(accel_type)
+        gen = normalize_generation(accel_type)
+        _generation_memo.append(gen)
+        return gen
     return None
+
+
+def _detect_generation_cache_clear() -> None:
+    _generation_memo.clear()
+
+
+detect_generation.cache_clear = _detect_generation_cache_clear  # test hook
 
 
 def num_tpu_chips() -> int:

@@ -81,6 +81,11 @@ class Config:
     heartbeat_period_s: float = 0.5
     num_heartbeats_timeout: int = 10
 
+    # Node-side virtual-cluster fencing verdicts are cached this long
+    # before re-checking with the GCS (ant ref: virtual-cluster GC/TTL
+    # flags, ray_config_def.ant.h).
+    vc_fence_ttl_s: float = 5.0
+
     # ---- rpc ----
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 60.0

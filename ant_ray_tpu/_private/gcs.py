@@ -43,7 +43,21 @@ class ActorRecord:
 
 
 class GcsServer:
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 store_path: str | None = None):
+        from ant_ray_tpu._private.store_client import (  # noqa: PLC0415
+            InMemoryStoreClient,
+            SqliteStoreClient,
+        )
+
+        # Write-through persistence (ref: gcs store clients,
+        # src/ray/gcs/store_client/redis_store_client.h): with a store
+        # path, every table mutation lands in sqlite and a restarted
+        # head (same port + store) resumes the cluster — actors stay
+        # callable, PGs stay reserved, nodes resync via heartbeats.
+        self._store = (SqliteStoreClient(store_path) if store_path
+                       else InMemoryStoreClient())
+        self._durable = store_path is not None
         self._server = RpcServer(host, port)
         self._nodes: dict[NodeID, NodeInfo] = {}
         self._last_heartbeat: dict[NodeID, float] = {}
@@ -63,6 +77,7 @@ class GcsServer:
         from collections import deque  # noqa: PLC0415
 
         self._insight_events: deque = deque(maxlen=10000)
+        self._dirty_locations: set[ObjectID] = set()
         self._clients = ClientPool()
         self._io = IoThread.get()
         self._health_task = None
@@ -112,15 +127,126 @@ class GcsServer:
             "InsightGet": self._insight_get,
             "Shutdown": self._shutdown_rpc,
         })
+        if self._durable:
+            self._load_tables()
         self.address = self._server.start()
         self._health_task = asyncio.run_coroutine_threadsafe(
             self._health_check_loop(), self._io.loop)
+        if self._durable:
+            self._flush_task = asyncio.run_coroutine_threadsafe(
+                self._location_flush_loop(), self._io.loop)
         logger.info("GCS listening on %s", self.address)
         return self.address
+
+    # ---------------------------------------------------- persistence
+
+    def _persist(self, table: str, key: str, value) -> None:
+        if self._durable:
+            import pickle  # noqa: PLC0415
+
+            self._store.put(table, key, pickle.dumps(value))
+
+    def _persist_del(self, table: str, key: str) -> None:
+        if self._durable:
+            self._store.delete(table, key)
+
+    def _save_actor(self, record: ActorRecord) -> None:
+        self._persist("actors", record.spec.actor_id.hex(), {
+            "spec": record.spec, "state": record.state,
+            "address": record.address, "node_id": record.node_id,
+            "restarts_used": record.restarts_used,
+            "death_reason": record.death_reason,
+        })
+
+    def _save_pg(self, record: dict) -> None:
+        self._persist("pgs", record["pg_id"].hex(), record)
+
+    def _save_locations(self, oid) -> None:
+        # Object-location churn is the hottest GCS path — a synchronous
+        # sqlite commit per event would serialize the whole object plane
+        # behind the disk.  Mark dirty; a periodic flusher batches the
+        # writes (restart loses at most one flush period of location
+        # updates, which heartbeat resync / lineage absorbs).
+        if self._durable:
+            self._dirty_locations.add(oid)
+
+    async def _location_flush_loop(self):
+        while True:
+            await asyncio.sleep(0.5)
+            self._flush_locations()
+
+    def _flush_locations(self) -> None:
+        if not self._durable or not self._dirty_locations:
+            return
+        dirty, self._dirty_locations = self._dirty_locations, set()
+        for oid in dirty:
+            nodes = self._object_locations.get(oid)
+            if nodes:
+                self._persist("locations", oid.hex(), (oid, nodes))
+            else:
+                self._persist_del("locations", oid.hex())
+
+    def _save_vcs(self) -> None:
+        self._persist("misc", "virtual_clusters", self._virtual_clusters)
+        self._persist("misc", "job_vc", self._job_vc)
+
+    def _load_tables(self) -> None:
+        import pickle  # noqa: PLC0415
+
+        for key, blob in self._store.load_table("kv").items():
+            self._kv[key] = pickle.loads(blob)
+        for _key, blob in self._store.load_table("jobs").items():
+            job_id, info = pickle.loads(blob)
+            self._jobs[job_id] = info
+        for _key, blob in self._store.load_table("actors").items():
+            snap = pickle.loads(blob)
+            record = ActorRecord(
+                spec=snap["spec"], state=snap["state"],
+                address=snap["address"], node_id=snap["node_id"],
+                restarts_used=snap["restarts_used"],
+                death_reason=snap["death_reason"])
+            self._actors[record.spec.actor_id] = record
+            if record.spec.name and record.state != ACTOR_DEAD:
+                self._named_actors[
+                    (record.spec.namespace, record.spec.name)
+                ] = record.spec.actor_id
+            # Actors that were mid-scheduling when the head died get
+            # re-kicked once the loop runs (nodes resync via heartbeat).
+            if record.state in (ACTOR_PENDING, ACTOR_RESTARTING):
+                asyncio.run_coroutine_threadsafe(
+                    self._reschedule_after_resync(record), self._io.loop)
+        for _key, blob in self._store.load_table("pgs").items():
+            record = pickle.loads(blob)
+            self._placement_groups[record["pg_id"]] = record
+            if record["state"] == "PENDING":
+                asyncio.run_coroutine_threadsafe(
+                    self._schedule_placement_group(record), self._io.loop)
+        blob = self._store.get("misc", "virtual_clusters")
+        if blob:
+            self._virtual_clusters = pickle.loads(blob)
+        blob = self._store.get("misc", "job_vc")
+        if blob:
+            self._job_vc = pickle.loads(blob)
+        for key, blob in self._store.load_table("locations").items():
+            oid, nodes = pickle.loads(blob)
+            self._object_locations[oid] = nodes
+        logger.info(
+            "restored GCS state: %d actors, %d pgs, %d kv keys, %d jobs",
+            len(self._actors), len(self._placement_groups),
+            len(self._kv), len(self._jobs))
+
+    async def _reschedule_after_resync(self, record: ActorRecord):
+        # Give nodes one heartbeat round to re-register before placing.
+        await asyncio.sleep(global_config().heartbeat_period_s * 2)
+        await self._schedule_actor(record)
 
     def stop(self):
         if self._health_task is not None:
             self._health_task.cancel()
+        flush_task = getattr(self, "_flush_task", None)
+        if flush_task is not None:
+            flush_task.cancel()
+            self._flush_locations()  # final batch before shutdown
         self._server.stop()
         self._clients.close_all()
 
@@ -227,6 +353,7 @@ class GcsServer:
             "divisible": bool(payload.get("divisible", False)),
             "created_at": time.time(),
         }
+        self._save_vcs()
         return {"vc_id": vc_id,
                 "node_ids": [n.hex() for n in node_ids]}
 
@@ -235,6 +362,7 @@ class GcsServer:
         for job_id, vc in list(self._job_vc.items()):
             if vc == payload["vc_id"]:
                 del self._job_vc[job_id]
+        self._save_vcs()
         return removed is not None
 
     async def _update_virtual_cluster(self, payload):
@@ -252,6 +380,7 @@ class GcsServer:
                              f"{[n.hex()[:8] for n in bad]}"}
         record["node_ids"] |= add
         record["node_ids"] -= set(payload.get("remove_nodes") or [])
+        self._save_vcs()
         return {"node_ids": [n.hex() for n in record["node_ids"]]}
 
     async def _list_virtual_clusters(self, _payload):
@@ -267,10 +396,12 @@ class GcsServer:
         vc_id = payload.get("vc_id")
         if vc_id is None:
             self._job_vc.pop(payload["job_id"], None)
+            self._save_vcs()
             return True
         if vc_id not in self._virtual_clusters:
             return {"error": f"no virtual cluster {vc_id!r}"}
         self._job_vc[payload["job_id"]] = vc_id
+        self._save_vcs()
         return True
 
     async def _get_job_virtual_cluster(self, payload):
@@ -332,12 +463,14 @@ class GcsServer:
         if not overwrite and key in self._kv:
             return False
         self._kv[key] = value
+        self._persist("kv", key, value)
         return True
 
     async def _kv_get(self, payload):
         return self._kv.get(payload["key"])
 
     async def _kv_del(self, payload):
+        self._persist_del("kv", payload["key"])
         return self._kv.pop(payload["key"], None) is not None
 
     async def _kv_keys(self, payload):
@@ -351,6 +484,8 @@ class GcsServer:
             "driver_address": payload.get("driver_address", ""),
             "started_at": time.time(),
         }
+        self._persist("jobs", payload["job_id"].hex(),
+                      (payload["job_id"], self._jobs[payload["job_id"]]))
         return True
 
     # ------------------------------------------------------------- actors
@@ -368,6 +503,7 @@ class GcsServer:
         self._actors[spec.actor_id] = record
         if spec.name:
             self._named_actors[key] = spec.actor_id
+        self._save_actor(record)
         asyncio.ensure_future(self._schedule_actor(record))
         return {"ok": True}
 
@@ -379,6 +515,7 @@ class GcsServer:
             record.state = ACTOR_DEAD
             record.death_reason = f"scheduling error: {e}"
             record.state_event.set()
+            self._save_actor(record)
 
     async def _schedule_actor_inner(self, record: ActorRecord):
         spec = record.spec
@@ -407,6 +544,7 @@ class GcsServer:
         record.state = ACTOR_DEAD
         record.death_reason = "no node with required resources"
         record.state_event.set()
+        self._save_actor(record)
 
     def _pick_node(self, resources: dict[str, float],
                    by_available: bool = True,
@@ -458,6 +596,7 @@ class GcsServer:
             record.death_reason = payload.get("reason", "")
         record.state_event.set()
         record.state_event = asyncio.Event()
+        self._save_actor(record)
         return True
 
     async def _list_actors(self, _payload):
@@ -544,6 +683,7 @@ class GcsServer:
         record.state = ACTOR_DEAD
         record.death_reason = "killed via kill()"
         record.state_event.set()
+        self._save_actor(record)
         return True
 
     async def _worker_died(self, payload):
@@ -565,26 +705,32 @@ class GcsServer:
             logger.info("restarting actor %s (%d/%d): %s",
                         record.spec.actor_id.hex()[:8], record.restarts_used,
                         record.spec.max_restarts, reason)
+            self._save_actor(record)
             asyncio.ensure_future(self._schedule_actor(record))
         else:
             record.state = ACTOR_DEAD
             record.death_reason = reason
             record.state_event.set()
             record.state_event = asyncio.Event()
+            self._save_actor(record)
 
     # ------------------------------------------------------------- objects
 
     async def _object_location_add(self, payload):
-        self._object_locations.setdefault(
-            payload["object_id"], set()).add(payload["node_id"])
+        oid = payload["object_id"]
+        self._object_locations.setdefault(oid, set()).add(
+            payload["node_id"])
+        self._save_locations(oid)
         return True
 
     async def _object_location_remove(self, payload):
-        locs = self._object_locations.get(payload["object_id"])
+        oid = payload["object_id"]
+        locs = self._object_locations.get(oid)
         if locs is not None:
             locs.discard(payload["node_id"])
             if not locs:
-                del self._object_locations[payload["object_id"]]
+                del self._object_locations[oid]
+        self._save_locations(oid)
         return True
 
     async def _object_locations_get(self, payload):
@@ -595,6 +741,7 @@ class GcsServer:
     async def _free_object(self, payload):
         oid = payload["object_id"]
         node_ids = self._object_locations.pop(oid, set())
+        self._save_locations(oid)
         for nid in node_ids:
             node = self._nodes.get(nid)
             if node is None or not node.alive:
@@ -622,6 +769,7 @@ class GcsServer:
             "reason": "",
         }
         self._placement_groups[payload["pg_id"]] = record
+        self._save_pg(record)
         asyncio.ensure_future(self._schedule_placement_group(record))
         return True
 
@@ -720,6 +868,7 @@ class GcsServer:
                         record["bundle_nodes"][index] = node
                     if committed and record["state"] != "REMOVED":
                         record["state"] = "CREATED"
+                        self._save_pg(record)
                         return
                 for index, node in prepared:  # roll back (2-phase abort)
                     record["bundle_nodes"][index] = None
@@ -731,7 +880,8 @@ class GcsServer:
                     except Exception:  # noqa: BLE001
                         pass
                 if record["state"] == "REMOVED":
-                    return
+                    return  # removal handler already dropped the store row
+                self._save_pg(record)  # keep the store in sync w/ rollback
             else:
                 # Distinguish "busy now" from "never possible".
                 totals = {n.node_id: dict(n.total_resources)
@@ -768,6 +918,10 @@ class GcsServer:
         if record is None:
             return False
         record["state"] = "REMOVED"
+        # Persist the terminal state FIRST: a head crash mid-removal must
+        # not resurrect a CREATED/PENDING record whose bundles the nodes
+        # have already returned.
+        self._persist_del("pgs", record["pg_id"].hex())
         for index, node in enumerate(record["bundle_nodes"]):
             if node is None:
                 continue
@@ -839,12 +993,15 @@ def main():  # pragma: no cover — exercised via subprocess in tests
     parser.add_argument("--port", type=int, required=True)
     parser.add_argument("--monitor-pid", type=int, default=0,
                         help="exit when this process disappears")
+    parser.add_argument("--store", default="",
+                        help="sqlite path for durable tables (restart-"
+                             "resync; empty = in-memory only)")
     args = parser.parse_args()
 
     logging.basicConfig(
         level=global_config().log_level,
         format="[gcs %(levelname)s %(asctime)s] %(message)s")
-    server = GcsServer(port=args.port)
+    server = GcsServer(port=args.port, store_path=args.store or None)
     server.start()
     print(f"GCS_READY {server.address}", flush=True)
 

@@ -352,7 +352,8 @@ class NodeManager:
         if len(self._vc_cache) > 256:
             self._vc_cache = {k: v for k, v in self._vc_cache.items()
                               if v[1] > now}
-        self._vc_cache[job_id] = (allowed, now + 5.0)
+        self._vc_cache[job_id] = (
+            allowed, now + global_config().vc_fence_ttl_s)
         return allowed
 
     def _idle_worker(self, env_key: str = "") -> WorkerHandle | None:

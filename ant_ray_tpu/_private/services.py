@@ -32,11 +32,19 @@ def _wait_ready(proc: subprocess.Popen, marker: str) -> str:
     raise RuntimeError(f"timed out waiting for {marker}")
 
 
-def start_gcs(session_dir: str) -> tuple[subprocess.Popen, str]:
-    port = find_free_port()
+def start_gcs(session_dir: str,
+              port: int | None = None) -> tuple[subprocess.Popen, str]:
+    """Start (or restart — same port + store file) the GCS head.
+
+    Tables persist to ``<session_dir>/gcs_store.db`` so a restarted head
+    resumes the cluster (ref: Redis-backed GCS fault tolerance,
+    src/ray/gcs/store_client/redis_store_client.h)."""
+    port = port or find_free_port()
+    store = os.path.join(session_dir, "gcs_store.db")
     proc = subprocess.Popen(
         [sys.executable, "-m", "ant_ray_tpu._private.gcs",
-         "--port", str(port), "--monitor-pid", str(os.getpid())],
+         "--port", str(port), "--store", store,
+         "--monitor-pid", str(os.getpid())],
         stdout=subprocess.PIPE, stderr=_log_file(session_dir, "gcs.err"),
         start_new_session=True)
     address = _wait_ready(proc, "GCS_READY")

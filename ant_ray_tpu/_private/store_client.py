@@ -1,0 +1,109 @@
+"""Pluggable GCS table persistence.
+
+Role of the reference's store clients (ref:
+src/ray/gcs/store_client/redis_store_client.h, in_memory_store_client.h):
+every GCS table write-throughs to a store client so a restarted head
+reloads the cluster instead of electing a leader of nothing.  Redesigned
+for this stack: the durable backend is a single sqlite file in the
+session dir (no external Redis dependency; WAL mode keeps the write path
+on the event loop sub-millisecond), keyed (table, key) → pickled record.
+The HA leader selector points standby heads at the same file.
+"""
+
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+
+
+class StoreClient:
+    """Interface: byte-valued tables keyed by string."""
+
+    def put(self, table: str, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, table: str, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def delete(self, table: str, key: str) -> None:
+        raise NotImplementedError
+
+    def load_table(self, table: str) -> dict[str, bytes]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStoreClient(StoreClient):
+    """Process-local storage — the no-persistence default."""
+
+    def __init__(self):
+        self._tables: dict[str, dict[str, bytes]] = {}
+
+    def put(self, table, key, value):
+        self._tables.setdefault(table, {})[key] = value
+
+    def get(self, table, key):
+        return self._tables.get(table, {}).get(key)
+
+    def delete(self, table, key):
+        self._tables.get(table, {}).pop(key, None)
+
+    def load_table(self, table):
+        return dict(self._tables.get(table, {}))
+
+
+class SqliteStoreClient(StoreClient):
+    """Durable storage in one sqlite file (WAL journal).
+
+    sqlite connections are not thread-safe by default; the GCS only
+    touches the store from its IO loop, but a lock keeps misuse safe.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._lock = threading.Lock()
+        with self._lock:
+            self._conn.execute("PRAGMA journal_mode=WAL")
+            self._conn.execute("PRAGMA synchronous=NORMAL")
+            self._conn.execute(
+                "CREATE TABLE IF NOT EXISTS art_store ("
+                "  tbl TEXT NOT NULL, key TEXT NOT NULL, value BLOB,"
+                "  PRIMARY KEY (tbl, key))")
+            self._conn.commit()
+
+    def put(self, table, key, value):
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO art_store (tbl, key, value) "
+                "VALUES (?, ?, ?)", (table, key, value))
+            self._conn.commit()
+
+    def get(self, table, key):
+        with self._lock:
+            row = self._conn.execute(
+                "SELECT value FROM art_store WHERE tbl = ? AND key = ?",
+                (table, key)).fetchone()
+        return row[0] if row else None
+
+    def delete(self, table, key):
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM art_store WHERE tbl = ? AND key = ?",
+                (table, key))
+            self._conn.commit()
+
+    def load_table(self, table):
+        with self._lock:
+            rows = self._conn.execute(
+                "SELECT key, value FROM art_store WHERE tbl = ?",
+                (table,)).fetchall()
+        return {key: value for key, value in rows}
+
+    def close(self):
+        with self._lock:
+            self._conn.close()

@@ -117,8 +117,18 @@ class TaskExecutor:
                 if self.actor_instance is None:
                     raise exceptions.ActorDiedError(
                         spec.actor_id, "actor instance not initialized")
-                method = getattr(self.actor_instance, spec.method_name)
-                result = method(*args, **kwargs)
+                if spec.method_name == "__art_exec_loop__":
+                    # Compiled-DAG execution loop: occupies this actor
+                    # until the driver tears the channels down
+                    # (ref: compiled_dag_node.py actor exec loops).
+                    from ant_ray_tpu.dag.compiled import exec_loop  # noqa: PLC0415
+
+                    result = exec_loop(self.actor_instance, *args,
+                                       **kwargs)
+                else:
+                    method = getattr(self.actor_instance,
+                                     spec.method_name)
+                    result = method(*args, **kwargs)
             else:
                 fn = self.runtime.fetch_code(spec.function_id)
                 result = fn(*args, **kwargs)

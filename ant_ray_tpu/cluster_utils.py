@@ -4,6 +4,8 @@ real machines: N node daemons, each a full node, on one host)."""
 
 from __future__ import annotations
 
+import json
+import os
 import subprocess
 
 from ant_ray_tpu._private import services
@@ -18,8 +20,21 @@ class Cluster:
         self._node_addresses: list[str] = []
         self.gcs_address: str | None = None
         self._pool = ClientPool()
+        self._saved_env: list[tuple[str, str | None]] = []
+        head_node_args = dict(head_node_args or {})
+        # _system_config flags travel to every daemon this cluster spawns
+        # as ART_<NAME> env vars — same channel api.init uses
+        # (ref: _system_config embedded into raylet launch,
+        # services.py:1518).
+        for key, value in (head_node_args.pop("_system_config", None)
+                           or {}).items():
+            name = f"ART_{key.upper()}"
+            self._saved_env.append((name, os.environ.get(name)))
+            os.environ[name] = (json.dumps(value)
+                                if isinstance(value, (dict, list))
+                                else str(value))
         if initialize_head:
-            self.add_node(**(head_node_args or {}))
+            self.add_node(**head_node_args)
 
     @property
     def address(self) -> str:
@@ -41,6 +56,22 @@ class Cluster:
         self._procs.append(proc)
         self._node_addresses.append(address)
         return address
+
+    def kill_gcs(self) -> None:
+        """Kill the head's GCS process (simulates head failure)."""
+        assert self.gcs_address is not None
+        proc = self._procs[0]
+        proc.kill()
+        proc.wait(timeout=5)
+
+    def restart_gcs(self) -> None:
+        """Restart the GCS on the same port, resuming from its sqlite
+        store (the test_gcs_fault_tolerance scenario)."""
+        assert self.gcs_address is not None
+        port = int(self.gcs_address.rsplit(":", 1)[1])
+        proc, address = services.start_gcs(self._session_dir, port=port)
+        self._procs[0] = proc
+        assert address == self.gcs_address
 
     def remove_node(self, address: str, graceful: bool = False) -> None:
         """Kill a node daemon (simulates node failure when not graceful)."""
@@ -67,3 +98,9 @@ class Cluster:
         self._procs.clear()
         self._node_addresses.clear()
         self.gcs_address = None
+        for name, old in self._saved_env:
+            if old is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = old
+        self._saved_env.clear()

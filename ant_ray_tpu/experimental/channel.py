@@ -1,0 +1,148 @@
+"""Shared-memory mutable-object channels.
+
+The substrate of compiled step graphs (ref:
+src/ray/core_worker/experimental_mutable_object_manager.h:44 — mutable
+plasma objects with writer/reader semaphores; python surface
+python/ray/experimental/channel/shared_memory_channel.py).  Redesigned
+lock-free for the tmpfs-arena model: each channel is its own small mmap
+file with a version counter + readers-done counter; synchronization is
+acquire/release atomics with GIL-released spin-waits in C++
+(native/store_core.cpp Channel type).
+
+Protocol: single writer, ``num_readers`` readers.  Every published
+version must be read (acquire) and released by every reader before the
+next write can begin — the same backpressure contract as the
+reference's mutable objects, which is what makes a pipeline of
+channel-connected actors self-throttling.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+from ant_ray_tpu._private.native import load_native
+from ant_ray_tpu._private.serialization import (
+    SerializedObject,
+    deserialize,
+    serialize,
+)
+
+_TAG_VALUE = 0
+_TAG_ERROR = 1
+
+
+class ChannelClosedError(Exception):
+    """The channel was torn down (writer or driver called close())."""
+
+
+class ChannelTimeoutError(Exception):
+    pass
+
+
+class ShmChannel:
+    """One mutable shm buffer: ``write(obj)`` / ``begin_read()`` /
+    ``end_read()``.  Values are pickled with out-of-band buffer support
+    (zero additional copies for numpy/jax host arrays on write)."""
+
+    def __init__(self, path: str, capacity: int = 0, num_readers: int = 1,
+                 create: bool = False):
+        native = load_native()
+        if native is None:
+            raise RuntimeError(
+                "art_native is unavailable — shm channels need the C++ "
+                "extension (no compiler on this host?)")
+        self.path = path
+        self._ch = native.Channel(path, capacity=capacity,
+                                  num_readers=num_readers, create=create)
+        self._last_version = 0
+        self._reading = False
+
+    # ------------------------------------------------------------ writer
+
+    def write(self, value: Any, timeout: float | None = None) -> None:
+        self._write_tagged(_TAG_VALUE, serialize(value).to_payload(),
+                           timeout)
+
+    def write_error(self, err: Exception,
+                    timeout: float | None = None) -> None:
+        self._write_tagged(_TAG_ERROR, pickle.dumps(err), timeout)
+
+    def _write_tagged(self, tag: int, payload: bytes,
+                      timeout: float | None) -> None:
+        nbytes = len(payload) + 1
+        try:
+            view = self._ch.write_begin(
+                nbytes, -1.0 if timeout is None else timeout)
+        except ValueError as e:
+            raise ChannelClosedError(str(e)) from None
+        except TimeoutError as e:
+            raise ChannelTimeoutError(str(e)) from None
+        view[0] = tag
+        view[1:nbytes] = payload
+        self._ch.write_commit(nbytes)
+
+    # ------------------------------------------------------------ reader
+
+    def begin_read(self, timeout: float | None = None) -> Any:
+        """Block until a version newer than the last one read arrives;
+        returns the deserialized value (raises the payload's error if the
+        producer wrote one).  Call :meth:`end_read` when done with it —
+        the writer cannot publish the next version until every reader
+        has."""
+        tag, value = self.begin_read_tagged(timeout)
+        if tag == "error":
+            self.end_read()
+            raise value
+        return value
+
+    def begin_read_tagged(
+            self, timeout: float | None = None) -> tuple[str, Any]:
+        """Like :meth:`begin_read` but returns ("value", v) or
+        ("error", exc) without raising — the exec-loop path, where errors
+        are propagated values, not control flow."""
+        try:
+            out = self._ch.read_acquire(
+                self._last_version, -1.0 if timeout is None else timeout)
+        except ValueError as e:
+            raise ChannelClosedError(str(e)) from None
+        if out is None:
+            raise ChannelTimeoutError(
+                f"no new value within {timeout}s on {self.path}")
+        version, view = out
+        self._last_version = version
+        self._reading = True
+        tag = view[0]
+        body = bytes(view[1:])
+        if tag == _TAG_ERROR:
+            return ("error", pickle.loads(body))
+        return ("value", deserialize(SerializedObject.from_payload(body)))
+
+    def end_read(self) -> None:
+        if self._reading:
+            self._reading = False
+            self._ch.read_release()
+
+    # ------------------------------------------------------------ misc
+
+    @property
+    def version(self) -> int:
+        return self._ch.version
+
+    def close(self, unlink: bool = False) -> None:
+        try:
+            self._ch.close()
+        finally:
+            if unlink:
+                try:
+                    os.unlink(self.path)
+                except OSError:
+                    pass
+
+
+def channel_dir() -> str:
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+    d = os.path.join(base, "art_channels")
+    os.makedirs(d, exist_ok=True)
+    return d

@@ -176,7 +176,8 @@ def param_shardings(config: LlamaConfig, mesh) -> dict:
 
 def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
             attn_impl: str = "auto", positions=None,
-            return_kv: bool = False, logits_at=None):
+            return_kv: bool = False, logits_at=None,
+            remat: str = "full"):
     """tokens: (batch, seq) int32 → logits (batch, seq, vocab) fp32.
 
     When ``mesh`` is provided, activations get sharding constraints
@@ -188,6 +189,12 @@ def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
     ``logits_at`` (traced scalar position) computes logits for that one
     position only — (b, vocab) — skipping the full-sequence lm-head
     matmul.
+
+    ``remat`` trades HBM for recompute FLOPs in the backward pass:
+    "full" (checkpoint every block — the multi-chip/8B default), "dots"
+    (save matmul outputs, recompute the cheap elementwise tail), "none"
+    (save everything — best MFU when the model fits, e.g. the single-chip
+    bench).
     """
     c = config
     cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta,
@@ -209,7 +216,6 @@ def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
             return ring_attention(xq, xk, xv, mesh=mesh, causal=True)
         return attention(xq, xk, xv, causal=True, impl=attn_impl)
 
-    @jax.checkpoint
     def block(x, layer):
         batch, seq, _ = x.shape
         h = rmsnorm(x, layer["ln_attn"], c.norm_eps)
@@ -232,6 +238,15 @@ def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
         kv = (xk.astype(c.dtype), xv.astype(c.dtype)) if return_kv else None
         return x, kv
 
+    if remat == "full":
+        block = jax.checkpoint(block)
+    elif remat == "dots":
+        block = jax.checkpoint(
+            block,
+            policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif remat != "none":
+        raise ValueError(f"unknown remat policy {remat!r}")
+
     x = params["embed"][tokens].astype(c.dtype)
     x = constrain_act(x, ("batch", "seq", "embed"))
     x, kv = lax.scan(block, x, params["layers"])
@@ -249,11 +264,12 @@ def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
 
 
 def loss_fn(params: dict, batch: dict, config: LlamaConfig, *, mesh=None,
-            attn_impl: str = "auto"):
+            attn_impl: str = "auto", remat: str = "full"):
     """batch: {"tokens": (b, s+1) int32} — next-token cross entropy."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, config, mesh=mesh, attn_impl=attn_impl)
+    logits = forward(params, inputs, config, mesh=mesh, attn_impl=attn_impl,
+                     remat=remat)
     import optax  # noqa: PLC0415
 
     losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)

@@ -6,6 +6,14 @@ north-star baseline (BASELINE.md: Llama-3-8B ≥ 40% MFU on v5e — here
 normalized per-chip: achieved_flops / peak_bf16_flops, vs_baseline =
 mfu / 0.40).
 
+Resilience (the round-1 failure mode was a flaky TPU tunnel):
+* the measurement runs in a CHILD process, so a failed backend init is
+  never cached in the reporting process — each retry starts clean;
+* `UNAVAILABLE` / backend-init errors retry with exponential backoff
+  under an overall deadline;
+* HBM OOM falls back through remat policies (none → dots → full) and
+  then smaller batch, so a number is always produced if the chip works.
+
 Prints exactly one JSON line:
     {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 """
@@ -13,13 +21,23 @@ Prints exactly one JSON line:
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+# (delay before attempt N in seconds); total ~10.5 min of waiting.
+_RETRY_DELAYS = (0, 20, 40, 80, 160, 320)
+_TRANSIENT_MARKERS = (
+    "UNAVAILABLE", "Unable to initialize backend", "DEADLINE_EXCEEDED",
+    "backend setup/compile error", "Socket closed", "Connection reset",
+)
+_OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM")
 
-def run_bench():
+
+def measure(remat: str, batch_scale: float):
     from ant_ray_tpu._private.accelerators import tpu as tpu_accel
     from ant_ray_tpu._private.jax_utils import import_jax
     from ant_ray_tpu.models import llama
@@ -28,17 +46,24 @@ def run_bench():
     import jax.numpy as jnp
     import optax
 
+    try:  # persistent compile cache makes retries/fallbacks cheap
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.expanduser("~/.cache/art_jax_cache"))
+    except Exception:  # noqa: BLE001 — older jax; cache is best-effort
+        pass
+
     backend = jax.default_backend()
     on_tpu = backend in ("tpu", "axon")
 
     if on_tpu:
         config = llama.CONFIGS["llama-400m"]
-        batch, seq = 8, 2048
-        peak_flops = tpu_accel.peak_bf16_tflops("v5e") * 1e12
+        batch, seq = max(1, int(8 * batch_scale)), 2048
+        gen = tpu_accel.detect_generation() or "v5e"
+        peak_flops = tpu_accel.peak_bf16_tflops(gen) * 1e12
         metric = "llama400m_train_mfu_v5e_1chip"
     else:  # CI / no-accelerator fallback: tiny config, nominal peak
         config = llama.CONFIGS["tiny"]
-        batch, seq = 2, 256
+        batch, seq = max(1, int(2 * batch_scale)), 256
         peak_flops = 1e12
         metric = "llama_tiny_train_flops_cpu"
 
@@ -51,7 +76,7 @@ def run_bench():
 
     def train_step(params, opt_state, tokens):
         loss, grads = jax.value_and_grad(llama.loss_fn)(
-            params, {"tokens": tokens}, config)
+            params, {"tokens": tokens}, config, remat=remat)
         updates, opt_state = opt.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
@@ -86,14 +111,85 @@ def run_bench():
         "step_time_ms": round(1000 * elapsed / n_steps, 2),
         "loss": round(float(loss), 4),
         "backend": backend,
+        "remat": remat,
+        "batch_scale": batch_scale,
     }
 
 
+def run_child() -> None:
+    """Run one measurement; falls back through remat policies / batch on
+    OOM inside this process (backend is known-alive once the first
+    compile succeeds)."""
+    plans = [("none", 1.0), ("dots", 1.0), ("full", 1.0), ("full", 0.5)]
+    last_err = None
+    for remat, scale in plans:
+        try:
+            result = measure(remat, scale)
+            print(json.dumps(result))
+            return
+        except Exception as e:  # noqa: BLE001
+            msg = repr(e)
+            last_err = msg
+            if any(m in msg for m in _OOM_MARKERS):
+                continue  # next (cheaper) plan
+            break  # non-OOM: report it — parent decides about retry
+    print(json.dumps({"metric": "bench_error", "value": 0.0, "unit": "MFU",
+                      "vs_baseline": 0.0, "error": (last_err or "")[:300]}))
+
+
+def main() -> None:
+    for attempt, delay in enumerate(_RETRY_DELAYS):
+        if delay:
+            time.sleep(delay)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--child"],
+                capture_output=True, text=True, timeout=1800,
+                cwd=os.path.dirname(os.path.abspath(__file__)) or ".")
+        except subprocess.TimeoutExpired:
+            # A hung backend init (the classic flaky-tunnel mode) is the
+            # most transient failure of all — it must retry, not abort.
+            if attempt == len(_RETRY_DELAYS) - 1:
+                break
+            print(f"# attempt {attempt + 1} hung; retrying",
+                  file=sys.stderr)
+            continue
+        line = ""
+        for candidate in reversed(proc.stdout.strip().splitlines()):
+            if candidate.startswith("{"):
+                line = candidate
+                break
+        if not line:
+            result = {"metric": "bench_error", "value": 0.0, "unit": "MFU",
+                      "vs_baseline": 0.0,
+                      "error": (proc.stderr or "no output")[-300:]}
+        else:
+            result = json.loads(line)
+        err = result.get("error", "")
+        transient = result["metric"] == "bench_error" and any(
+            m in err for m in _TRANSIENT_MARKERS)
+        if not transient or attempt == len(_RETRY_DELAYS) - 1:
+            print(json.dumps(result))
+            return
+        print(f"# attempt {attempt + 1} hit transient backend error; "
+              f"retrying: {err[:120]}", file=sys.stderr)
+    print(json.dumps({"metric": "bench_error", "value": 0.0, "unit": "MFU",
+                      "vs_baseline": 0.0, "error": "retries exhausted"}))
+
+
 if __name__ == "__main__":
+    if "--child" in sys.argv:
+        try:
+            run_child()
+        except Exception as e:  # noqa: BLE001 — child must emit a line
+            print(json.dumps({"metric": "bench_error", "value": 0.0,
+                              "unit": "MFU", "vs_baseline": 0.0,
+                              "error": repr(e)[:300]}))
+        sys.exit(0)
     try:
-        result = run_bench()
+        main()
     except Exception as e:  # noqa: BLE001 — bench must always emit a line
-        result = {"metric": "bench_error", "value": 0.0, "unit": "MFU",
-                  "vs_baseline": 0.0, "error": repr(e)[:200]}
-    print(json.dumps(result))
+        print(json.dumps({"metric": "bench_error", "value": 0.0,
+                          "unit": "MFU", "vs_baseline": 0.0,
+                          "error": repr(e)[:300]}))
     sys.exit(0)

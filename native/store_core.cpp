@@ -18,6 +18,22 @@
 //   a.view(offset, nbytes) -> memoryview (zero-copy, writable)
 //   a.used, a.capacity, a.num_blocks
 //   a.close()
+//
+//   Channel(path, capacity, num_readers, create) — mutable-object channel
+//   (role of the reference's multi-reader/single-writer mutable plasma
+//   objects, ref: src/ray/core_worker/experimental_mutable_object_manager.h:44,
+//   redesigned lock-free: a version counter + readers-done counter in the
+//   mmap header replace the writer/reader semaphore pair; waits are
+//   GIL-released spin-with-backoff, bounded by a caller deadline).
+//   c.write_begin(nbytes, timeout) -> writable memoryview (waits for all
+//       readers of the previous version; MemoryError if nbytes > capacity,
+//       TimeoutError on deadline)
+//   c.write_commit(nbytes)         — publish: version += 1
+//   c.read_acquire(last_version, timeout) -> (version, memoryview) | None
+//   c.read_release()               — reader done with current version
+//   c.close()                      — set closed flag (readers/writers see
+//       ChannelClosed via ValueError) and unmap
+//   c.version, c.num_readers, c.capacity
 
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
@@ -269,6 +285,286 @@ PyObject* arena_get_heap_start(Arena* self, void*) {
       align_up(sizeof(ArenaHeader), kAlign));
 }
 
+// ================================================================ channel
+
+// Header layout (all u64, 64-byte aligned block):
+//   magic, capacity, num_readers, closed, version, msg_len, readers_done
+struct ChannelHeader {
+  uint64_t magic;
+  uint64_t capacity;
+  uint64_t num_readers;
+  uint64_t closed;
+  uint64_t version;       // published generation; 0 = nothing written yet
+  uint64_t msg_len;       // payload bytes of the current version
+  uint64_t readers_done;  // readers that released the current version
+};
+
+constexpr uint64_t kChannelMagic = 0x415254434831ull;  // "ARTCH1"
+
+struct Channel {
+  PyObject_HEAD
+  int fd;
+  uint8_t* base;
+  uint64_t file_size;
+  uint64_t pending_write;  // bytes granted by write_begin, 0 otherwise
+
+  ChannelHeader* header() { return reinterpret_cast<ChannelHeader*>(base); }
+  uint8_t* payload() { return base + align_up(sizeof(ChannelHeader), kAlign); }
+};
+
+inline uint64_t ch_load(uint64_t* p) {
+  return __atomic_load_n(p, __ATOMIC_ACQUIRE);
+}
+inline void ch_store(uint64_t* p, uint64_t v) {
+  __atomic_store_n(p, v, __ATOMIC_RELEASE);
+}
+inline void ch_add(uint64_t* p, uint64_t v) {
+  __atomic_fetch_add(p, v, __ATOMIC_ACQ_REL);
+}
+
+// Spin with escalating sleep until `pred` returns true, the channel
+// closes, or the deadline passes.  Returns 0 ok, 1 closed, 2 timeout.
+// Runs WITHOUT the GIL; pred must touch only the mmap.
+template <typename Pred>
+int ch_wait(Channel* self, double timeout_s, Pred pred) {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  double deadline = ts.tv_sec + ts.tv_nsec * 1e-9 + timeout_s;
+  int spins = 0;
+  while (true) {
+    if (pred()) return 0;
+    if (ch_load(&self->header()->closed)) return 1;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    if (timeout_s >= 0 && ts.tv_sec + ts.tv_nsec * 1e-9 > deadline)
+      return 2;
+    if (spins < 1024) {  // ~fast path: just yield the core
+      ++spins;
+      sched_yield();
+    } else {  // slow path: sleep 50us (latency floor for idle channels)
+      struct timespec req = {0, 50 * 1000};
+      nanosleep(&req, nullptr);
+    }
+  }
+}
+
+int channel_tp_init(PyObject* self_obj, PyObject* args, PyObject* kwargs) {
+  Channel* self = reinterpret_cast<Channel*>(self_obj);
+  self->fd = -1;
+  self->base = nullptr;
+  self->pending_write = 0;
+  const char* path;
+  unsigned long long capacity = 0;
+  unsigned long long num_readers = 1;
+  int create = 0;
+  static const char* kwlist[] = {"path", "capacity", "num_readers",
+                                 "create", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(
+          args, kwargs, "s|KKp", const_cast<char**>(kwlist), &path,
+          &capacity, &num_readers, &create)) {
+    return -1;
+  }
+  uint64_t header_sz = align_up(sizeof(ChannelHeader), kAlign);
+  int flags = create ? (O_RDWR | O_CREAT | O_EXCL) : O_RDWR;
+  self->fd = open(path, flags, 0600);
+  if (self->fd < 0) {
+    PyErr_SetFromErrnoWithFilename(PyExc_OSError, path);
+    return -1;
+  }
+  if (create) {
+    self->file_size = header_sz + align_up(capacity, kAlign);
+    if (ftruncate(self->fd, static_cast<off_t>(self->file_size)) != 0) {
+      PyErr_SetFromErrno(PyExc_OSError);
+      return -1;
+    }
+  } else {
+    struct stat st;
+    if (fstat(self->fd, &st) != 0) {
+      PyErr_SetFromErrno(PyExc_OSError);
+      return -1;
+    }
+    self->file_size = static_cast<uint64_t>(st.st_size);
+  }
+  self->base = static_cast<uint8_t*>(
+      mmap(nullptr, self->file_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+           self->fd, 0));
+  if (self->base == MAP_FAILED) {
+    self->base = nullptr;
+    PyErr_SetFromErrno(PyExc_OSError);
+    return -1;
+  }
+  if (create) {
+    ChannelHeader* h = self->header();
+    h->magic = kChannelMagic;
+    h->capacity = align_up(capacity, kAlign);
+    h->num_readers = num_readers;
+    h->closed = 0;
+    h->version = 0;
+    h->msg_len = 0;
+    // First write needs no reader handshake.
+    h->readers_done = num_readers;
+  } else if (self->header()->magic != kChannelMagic) {
+    PyErr_SetString(PyExc_ValueError, "not an art channel file");
+    return -1;
+  }
+  return 0;
+}
+
+PyObject* channel_write_begin(Channel* self, PyObject* args) {
+  unsigned long long nbytes;
+  double timeout_s = -1.0;
+  if (!PyArg_ParseTuple(args, "K|d", &nbytes, &timeout_s)) return nullptr;
+  if (self->base == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "channel is closed");
+    return nullptr;
+  }
+  ChannelHeader* h = self->header();
+  if (nbytes > h->capacity) {
+    PyErr_Format(PyExc_MemoryError,
+                 "message of %llu bytes exceeds channel capacity %llu",
+                 nbytes, static_cast<unsigned long long>(h->capacity));
+    return nullptr;
+  }
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = ch_wait(self, timeout_s, [&] {
+    return ch_load(&h->readers_done) >= h->num_readers;
+  });
+  Py_END_ALLOW_THREADS
+  if (rc == 1) {
+    PyErr_SetString(PyExc_ValueError, "channel is closed");
+    return nullptr;
+  }
+  if (rc == 2) {
+    PyErr_SetString(PyExc_TimeoutError,
+                    "timed out waiting for readers of previous version");
+    return nullptr;
+  }
+  self->pending_write = nbytes;
+  return PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(self->payload()),
+      static_cast<Py_ssize_t>(nbytes), PyBUF_WRITE);
+}
+
+PyObject* channel_write_commit(Channel* self, PyObject* arg) {
+  unsigned long long nbytes = PyLong_AsUnsignedLongLong(arg);
+  if (PyErr_Occurred()) return nullptr;
+  if (self->base == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "channel is closed");
+    return nullptr;
+  }
+  ChannelHeader* h = self->header();
+  if (nbytes > self->pending_write) {
+    PyErr_SetString(PyExc_ValueError, "commit larger than write_begin");
+    return nullptr;
+  }
+  self->pending_write = 0;
+  h->msg_len = nbytes;
+  ch_store(&h->readers_done, 0);
+  ch_add(&h->version, 1);  // publish
+  Py_RETURN_NONE;
+}
+
+PyObject* channel_read_acquire(Channel* self, PyObject* args) {
+  unsigned long long last_version;
+  double timeout_s = -1.0;
+  if (!PyArg_ParseTuple(args, "K|d", &last_version, &timeout_s))
+    return nullptr;
+  if (self->base == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "channel is closed");
+    return nullptr;
+  }
+  ChannelHeader* h = self->header();
+  int rc;
+  Py_BEGIN_ALLOW_THREADS
+  rc = ch_wait(self, timeout_s, [&] {
+    return ch_load(&h->version) > last_version;
+  });
+  Py_END_ALLOW_THREADS
+  if (rc == 1) {
+    PyErr_SetString(PyExc_ValueError, "channel is closed");
+    return nullptr;
+  }
+  if (rc == 2) Py_RETURN_NONE;
+  uint64_t version = ch_load(&h->version);
+  PyObject* view = PyMemoryView_FromMemory(
+      reinterpret_cast<char*>(self->payload()),
+      static_cast<Py_ssize_t>(h->msg_len), PyBUF_READ);
+  if (view == nullptr) return nullptr;
+  PyObject* out = Py_BuildValue("KN", version, view);
+  return out;
+}
+
+PyObject* channel_read_release(Channel* self, PyObject*) {
+  if (self->base == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "channel is closed");
+    return nullptr;
+  }
+  ch_add(&self->header()->readers_done, 1);
+  Py_RETURN_NONE;
+}
+
+PyObject* channel_close(Channel* self, PyObject*) {
+  if (self->base != nullptr) {
+    ch_store(&self->header()->closed, 1);
+    munmap(self->base, self->file_size);
+    self->base = nullptr;
+  }
+  if (self->fd >= 0) {
+    close(self->fd);
+    self->fd = -1;
+  }
+  Py_RETURN_NONE;
+}
+
+PyObject* channel_get_version(Channel* self, void*) {
+  if (self->base == nullptr) return PyLong_FromLong(-1);
+  return PyLong_FromUnsignedLongLong(ch_load(&self->header()->version));
+}
+
+PyObject* channel_get_capacity(Channel* self, void*) {
+  if (self->base == nullptr) return PyLong_FromLong(0);
+  return PyLong_FromUnsignedLongLong(self->header()->capacity);
+}
+
+PyObject* channel_get_num_readers(Channel* self, void*) {
+  if (self->base == nullptr) return PyLong_FromLong(0);
+  return PyLong_FromUnsignedLongLong(self->header()->num_readers);
+}
+
+void channel_dealloc(PyObject* self_obj) {
+  Channel* self = reinterpret_cast<Channel*>(self_obj);
+  if (self->base != nullptr) munmap(self->base, self->file_size);
+  if (self->fd >= 0) close(self->fd);
+  Py_TYPE(self_obj)->tp_free(self_obj);
+}
+
+PyMethodDef channel_methods[] = {
+    {"write_begin", reinterpret_cast<PyCFunction>(channel_write_begin),
+     METH_VARARGS, "write_begin(nbytes, timeout=-1) -> writable view"},
+    {"write_commit", reinterpret_cast<PyCFunction>(channel_write_commit),
+     METH_O, "write_commit(nbytes) — publish the new version"},
+    {"read_acquire", reinterpret_cast<PyCFunction>(channel_read_acquire),
+     METH_VARARGS,
+     "read_acquire(last_version, timeout=-1) -> (version, view) | None"},
+    {"read_release", reinterpret_cast<PyCFunction>(channel_read_release),
+     METH_NOARGS, "read_release() — done with the current version"},
+    {"close", reinterpret_cast<PyCFunction>(channel_close), METH_NOARGS,
+     "set closed flag and unmap"},
+    {nullptr, nullptr, 0, nullptr}};
+
+PyGetSetDef channel_getset[] = {
+    {"version", reinterpret_cast<getter>(channel_get_version), nullptr,
+     nullptr, nullptr},
+    {"capacity", reinterpret_cast<getter>(channel_get_capacity), nullptr,
+     nullptr, nullptr},
+    {"num_readers", reinterpret_cast<getter>(channel_get_num_readers),
+     nullptr, nullptr, nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr}};
+
+PyTypeObject ChannelType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
 int arena_tp_init(PyObject* self_obj, PyObject* args, PyObject* kwargs) {
   Arena* self = reinterpret_cast<Arena*>(self_obj);
   self->fd = -1;
@@ -340,10 +636,22 @@ PyMODINIT_FUNC PyInit_art_native(void) {
   ArenaType.tp_methods = arena_methods;
   ArenaType.tp_getset = arena_getset;
   if (PyType_Ready(&ArenaType) < 0) return nullptr;
+  ChannelType.tp_name = "art_native.Channel";
+  ChannelType.tp_basicsize = sizeof(Channel);
+  ChannelType.tp_flags = Py_TPFLAGS_DEFAULT;
+  ChannelType.tp_new = PyType_GenericNew;
+  ChannelType.tp_init = channel_tp_init;
+  ChannelType.tp_dealloc = channel_dealloc;
+  ChannelType.tp_methods = channel_methods;
+  ChannelType.tp_getset = channel_getset;
+  if (PyType_Ready(&ChannelType) < 0) return nullptr;
   PyObject* m = PyModule_Create(&art_native_module);
   if (m == nullptr) return nullptr;
   Py_INCREF(&ArenaType);
   PyModule_AddObject(m, "Arena",
                      reinterpret_cast<PyObject*>(&ArenaType));
+  Py_INCREF(&ChannelType);
+  PyModule_AddObject(m, "Channel",
+                     reinterpret_cast<PyObject*>(&ChannelType));
   return m;
 }

@@ -60,6 +60,7 @@ def test_actor_dag(cluster):
         dag = a.add.bind(inp)
     assert art.get(dag.execute(5)) == 5
     assert art.get(dag.execute(7)) == 12  # same actor, stateful
+    art.kill(a)  # shared module cluster: release the actor's CPU
 
 
 def test_compiled_dag_reuse(cluster):
@@ -95,3 +96,116 @@ def test_missing_input_errors(cluster):
         dag = f.bind(inp)
     with pytest.raises(ValueError, match="input"):
         dag.execute()
+
+
+# ---------------------------------------------------- channel-compiled DAGs
+
+def _require_channels():
+    from ant_ray_tpu._private.native import load_native
+
+    if load_native() is None:
+        pytest.skip("native channel extension unavailable")
+
+
+def test_channel_compiled_actor_pipeline(cluster):
+    """Two-stage actor pipeline over preallocated shm channels: correct,
+    stateful, reusable (ref: compiled_dag_node.py exec loops)."""
+    _require_channels()
+
+    @art.remote
+    class Scale:
+        def __init__(self, k):
+            self.k = k
+            self.calls = 0
+
+        def apply(self, x):
+            self.calls += 1
+            return x * self.k
+
+        def get_calls(self):
+            return self.calls
+
+    a = Scale.remote(2)
+    b = Scale.remote(10)
+    with InputNode() as inp:
+        dag = b.apply.bind(a.apply.bind(inp))
+    compiled = dag.experimental_compile()
+    from ant_ray_tpu.dag.compiled import ChannelCompiledDAG
+
+    assert isinstance(compiled, ChannelCompiledDAG)
+    refs = [compiled.execute(i) for i in range(5)]
+    assert [r.get(timeout=30) for r in refs] == [i * 20 for i in range(5)]
+    compiled.teardown()
+    # Actors are usable again after teardown (loops exited cleanly).
+    assert art.get(a.get_calls.remote()) == 5
+    art.kill(a)
+    art.kill(b)
+
+
+def test_channel_compiled_error_propagation(cluster):
+    _require_channels()
+
+    @art.remote
+    class Flaky:
+        def work(self, x):
+            if x < 0:
+                raise ValueError("negative input")
+            return x + 1
+
+    @art.remote
+    class Tail:
+        def passthrough(self, x):
+            return x
+
+    f, t = Flaky.remote(), Tail.remote()
+    with InputNode() as inp:
+        dag = t.passthrough.bind(f.work.bind(inp))
+    compiled = dag.experimental_compile()
+    assert compiled.execute(1).get(timeout=30) == 2
+    with pytest.raises(ValueError, match="negative"):
+        compiled.execute(-1).get(timeout=30)
+    # The pipeline survives the error and keeps serving.
+    assert compiled.execute(5).get(timeout=30) == 6
+    compiled.teardown()
+    art.kill(f)
+    art.kill(t)
+
+
+def test_channel_compiled_beats_interpreted(cluster):
+    """The whole point of the substrate: steady-state step latency with
+    zero per-step task submissions beats the bind/execute path."""
+    _require_channels()
+    import time as _time
+
+    @art.remote
+    class Stage:
+        def work(self, x):
+            return x + 1
+
+    s1, s2 = Stage.remote(), Stage.remote()
+    with InputNode() as inp:
+        dag = s2.work.bind(s1.work.bind(inp))
+
+    n = 50
+    # Interpreted: full submission + object-plane cost per step.
+    t0 = _time.perf_counter()
+    for i in range(n):
+        assert art.get(dag.execute(i), timeout=60) == i + 2
+    interpreted = _time.perf_counter() - t0
+
+    compiled = dag.experimental_compile()
+    compiled.execute(0).get(timeout=60)  # warm the loops
+    t0 = _time.perf_counter()
+    for i in range(n):
+        assert compiled.execute(i).get(timeout=60) == i + 2
+    channeled = _time.perf_counter() - t0
+    compiled.teardown()
+
+    # Generous margin: the substrate is ~100x faster in practice, but CI
+    # hosts under load can wobble — require a clear win, not a photo
+    # finish, so the test stays meaningful without being flaky.
+    assert channeled < interpreted * 0.5, (channeled, interpreted)
+    print(f"interpreted {1e3 * interpreted / n:.2f} ms/step, "
+          f"channel-compiled {1e3 * channeled / n:.2f} ms/step")
+    art.kill(s1)
+    art.kill(s2)

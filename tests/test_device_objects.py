@@ -2,12 +2,19 @@
 path (capability mirror of the reference's gpu_objects tests)."""
 
 import numpy as np
+import pytest
 
 import ant_ray_tpu as art
 
 
-def test_device_object_roundtrip_actors(shutdown_only):
+@pytest.fixture(scope="module")
+def device_cluster():
     art.init(num_cpus=2)
+    yield None
+    art.shutdown()
+
+
+def test_device_object_roundtrip_actors(device_cluster):
 
     @art.remote
     class Producer:
@@ -40,8 +47,7 @@ def test_device_object_roundtrip_actors(shutdown_only):
         np.arange(1000, dtype=np.float32).sum() * 2.0)
 
 
-def test_device_object_driver_get_and_free(shutdown_only):
-    art.init(num_cpus=2)
+def test_device_object_driver_get_and_free(device_cluster):
     from ant_ray_tpu.experimental import device_objects
 
     @art.remote
@@ -68,8 +74,7 @@ def test_device_object_driver_get_and_free(shutdown_only):
         device_objects.get(ref, timeout=30)
 
 
-def test_driver_side_put(shutdown_only):
-    art.init(num_cpus=2)
+def test_driver_side_put(device_cluster):
     import jax.numpy as jnp
 
     from ant_ray_tpu.experimental import device_objects

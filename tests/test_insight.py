@@ -1,0 +1,37 @@
+"""Flow-insight call-graph tracing (ant ref: python/ray/util/insight.py).
+Own module: needs a cluster started with enable_insight, separate from the
+shared ant-extras cluster."""
+
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+
+
+def test_flow_insight_call_graph(shutdown_only):
+    art.init(num_cpus=2, _system_config={"enable_insight": True})
+    from ant_ray_tpu.util import insight
+
+    @art.remote
+    def traced(x):
+        return x + 1
+
+    @art.remote
+    def failing():
+        raise ValueError("nope")
+
+    art.get([traced.remote(i) for i in range(3)], timeout=120)
+    with pytest.raises(Exception):
+        art.get(failing.remote(), timeout=120)
+    time.sleep(0.5)  # oneway events drain
+
+    events = insight.get_flow_events()
+    kinds = {e["type"] for e in events}
+    assert {"call_submit", "call_begin", "call_end"} <= kinds
+    graph = insight.build_call_graph(events)
+    fn_stats = {name.split(".")[-1]: s
+                for name, s in graph["functions"].items()}
+    assert fn_stats["traced"]["calls"] == 3
+    assert fn_stats["failing"]["errors"] == 1
+    assert any(e["count"] >= 3 for e in graph["edges"])

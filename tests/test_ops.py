@@ -18,11 +18,14 @@ def _get_json(url):
         return json.loads(resp.read().decode())
 
 
-@pytest.fixture
-def dashboard_cluster(shutdown_only):
+@pytest.fixture(scope="module")
+def dashboard_cluster():
+    """One cluster for the whole ops module — dashboard, jobs, and
+    runtime-env tests all run against it."""
     ctx = art.init(num_cpus=2)
     assert ctx.dashboard_url, "dashboard did not start"
     yield ctx.dashboard_url
+    art.shutdown()
 
 
 def test_dashboard_state_endpoints(dashboard_cluster):
@@ -97,8 +100,7 @@ def test_job_stop_and_missing(dashboard_cluster):
         client.get_job_info("nope")
 
 
-def test_runtime_env_env_vars(shutdown_only):
-    art.init(num_cpus=2)
+def test_runtime_env_env_vars(dashboard_cluster):
 
     @art.remote(runtime_env={"env_vars": {"ART_TEST_FLAG": "banana"}})
     def read_flag():
@@ -113,13 +115,11 @@ def test_runtime_env_env_vars(shutdown_only):
     assert art.get(read_plain.remote(), timeout=60) is None
 
 
-def test_runtime_env_working_dir(shutdown_only, tmp_path):
+def test_runtime_env_working_dir(dashboard_cluster, tmp_path):
     pkg = tmp_path / "mypkg"
     pkg.mkdir()
     (pkg / "helper_mod.py").write_text("VALUE = 'from-working-dir'\n")
     (pkg / "data.txt").write_text("payload")
-
-    art.init(num_cpus=2)
 
     @art.remote(runtime_env={"working_dir": str(pkg)})
     def use_working_dir():
@@ -134,8 +134,7 @@ def test_runtime_env_working_dir(shutdown_only, tmp_path):
     assert data == "payload"
 
 
-def test_runtime_env_on_actor(shutdown_only):
-    art.init(num_cpus=2)
+def test_runtime_env_on_actor(dashboard_cluster):
 
     @art.remote(runtime_env={"env_vars": {"ACTOR_ENV": "yes"}})
     class EnvActor:

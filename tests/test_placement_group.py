@@ -14,7 +14,7 @@ from ant_ray_tpu.util.placement_group import (
 )
 
 
-@pytest.fixture
+@pytest.fixture(scope="module")
 def three_nodes():
     cluster = Cluster(head_node_args={"num_cpus": 2})
     cluster.add_node(num_cpus=2)
@@ -23,6 +23,39 @@ def three_nodes():
     yield cluster
     art.shutdown()
     cluster.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _pg_cleanup(request):
+    """Remove every placement group a test created so the shared cluster's
+    resources are whole again for the next test."""
+    yield
+    if "three_nodes" not in request.fixturenames:
+        return
+    from ant_ray_tpu.api import global_worker
+
+    rt = getattr(global_worker, "runtime", None)
+    if rt is None:
+        return
+    from ant_ray_tpu._private.ids import PlacementGroupID
+
+    for pg_hex, entry in placement_group_table().items():
+        if entry.get("state") not in ("REMOVED",):
+            try:
+                rt._gcs.call(
+                    "RemovePlacementGroup",
+                    {"pg_id": PlacementGroupID.from_hex(pg_hex)}, retries=3)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+    # Bundle returns reach the node daemons asynchronously; give the
+    # table a moment to reflect the removals.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if all(e.get("state") == "REMOVED"
+               for e in placement_group_table().values()):
+            time.sleep(0.2)  # daemon-side ReturnBundle drains
+            return
+        time.sleep(0.1)
 
 
 def test_strict_spread_places_on_distinct_nodes(three_nodes):
@@ -91,6 +124,7 @@ def test_actor_in_placement_group(three_nodes):
     node = art.get(a.where.remote())
     assert pg.bundle_node(0) is not None
     assert node
+    art.kill(a)  # release the bundle's CPU for the shared cluster
 
 
 def test_pg_table(three_nodes):

@@ -1,0 +1,19 @@
+"""Object spilling under store pressure in cluster mode — own module:
+needs its own art.init(object_store_memory=...) (ref: LocalObjectManager
+spill/restore, local_object_manager.h:44)."""
+
+import numpy as np
+
+import ant_ray_tpu as art
+
+
+def test_spill_cluster_roundtrip(shutdown_only):
+    art.init(num_cpus=2, object_store_memory=32 * 1024 * 1024)
+    arrays = []
+    refs = []
+    for i in range(6):                    # ~48 MB total > 32 MB store
+        arr = np.full(1_000_000, i, np.float64)
+        arrays.append(arr)
+        refs.append(art.put(arr))
+    for arr, ref in zip(arrays, refs):    # early ones restored from disk
+        assert np.array_equal(art.get(ref, timeout=120), arr)
