@@ -67,6 +67,18 @@ class _AllCopiesLost(Exception):
 
 
 @dataclass
+class _StreamState:
+    """Owner-side bookkeeping of one streaming task's returns
+    (ref: ObjectRefStream, src/ray/core_worker/task_manager.h:67)."""
+
+    received: int = 0                  # contiguous items stored so far
+    total: int | None = None           # set by the end-of-stream marker
+    error: Exception | None = None     # mid-stream task failure
+    cond: threading.Condition = field(
+        default_factory=threading.Condition)
+
+
+@dataclass
 class _ActorSubmitState:
     """Per-actor ordered submission queue
     (ref: ActorTaskSubmitter, task_submission/actor_task_submitter.h:68)."""
@@ -108,7 +120,12 @@ class ClusterRuntime(CoreRuntime):
             "ReconstructObject": self._handle_reconstruct_object,
             "DeviceTensorFetch": self._handle_device_tensor_fetch,
             "DeviceTensorFree": self._handle_device_tensor_free,
+            "StreamItem": self._handle_stream_item,
         })
+        self._streams: dict[TaskID, _StreamState] = {}
+        # abandoned stream ids (insertion-ordered; bounded) — late items
+        # for these are dropped, not stored
+        self._released_streams: dict[TaskID, bool] = {}
         # HBM-resident objects held by this worker, keyed by holder
         # token, plus the metadata-oid → token map that ties payload
         # lifetime to the metadata object's refcount
@@ -579,12 +596,17 @@ class ClusterRuntime(CoreRuntime):
     def submit_task(self, remote_function, args, kwargs, options: TaskOptions):
         fn_key = self.export(remote_function.function, "fn")
         task_id = TaskID.for_normal_task(self.job_id)
-        num_returns = options.num_returns
+        streaming = options.num_returns == "streaming"
+        num_returns = -1 if streaming else options.num_returns
         return_refs = []
-        for i in range(num_returns):
-            oid = ObjectID.for_task_return(task_id, i)
-            self.memory.mark_pending(oid)
-            return_refs.append(ObjectRef(oid, owner_address=self.address))
+        if streaming:
+            self._register_stream(task_id)
+        else:
+            for i in range(num_returns):
+                oid = ObjectID.for_task_return(task_id, i)
+                self.memory.mark_pending(oid)
+                return_refs.append(
+                    ObjectRef(oid, owner_address=self.address))
 
         args_payload, pinned = self._pack_args(args, kwargs)
         cfg = global_config()
@@ -596,9 +618,13 @@ class ClusterRuntime(CoreRuntime):
             num_returns=num_returns,
             owner_address=self.address,
             resources=options.resource_demand(),
-            max_retries=(options.max_retries
-                         if options.max_retries is not None
-                         else cfg.task_max_retries_default),
+            # Streaming tasks never retry: replaying would re-emit items
+            # the consumer already observed (ref: generator tasks are
+            # non-retriable by default).
+            max_retries=(0 if streaming else
+                         (options.max_retries
+                          if options.max_retries is not None
+                          else cfg.task_max_retries_default)),
             retry_exceptions=options.retry_exceptions,
             placement_group_id=(options.placement_group.id
                                 if options.placement_group is not None
@@ -606,6 +632,7 @@ class ClusterRuntime(CoreRuntime):
             placement_group_bundle_index=max(
                 options.placement_group_bundle_index, 0),
             runtime_env=self._package_runtime_env(options.runtime_env),
+            label_selector=options.label_selector,
         )
         if cfg.enable_insight:
             from ant_ray_tpu.util import insight  # noqa: PLC0415
@@ -614,6 +641,10 @@ class ClusterRuntime(CoreRuntime):
                                        task_id.hex(), self.role)
         asyncio.run_coroutine_threadsafe(
             self._run_normal_task(spec, pinned), self._io.loop)
+        if streaming:
+            from ant_ray_tpu.object_ref import ObjectRefGenerator  # noqa: PLC0415
+
+            return ObjectRefGenerator(task_id, self)
         return return_refs[0] if num_returns == 1 else return_refs
 
     def _pack_args(self, args, kwargs) -> tuple[bytes, list]:
@@ -712,7 +743,8 @@ class ClusterRuntime(CoreRuntime):
         return the worker reply (ref: NormalTaskSubmitter::SubmitTask)."""
         lease_payload = {"resources": spec.resources,
                          "runtime_env": spec.runtime_env,
-                         "job_id": self.job_id}
+                         "job_id": self.job_id,
+                         "label_selector": spec.label_selector}
         if spec.placement_group_id is not None:
             node = await self._resolve_bundle_node(
                 spec.placement_group_id, spec.placement_group_bundle_index)
@@ -747,7 +779,111 @@ class ClusterRuntime(CoreRuntime):
                 raise exceptions.ArtError(f"bad lease reply {reply}")
         raise exceptions.ArtError("too many scheduling spillbacks")
 
+    # --------------------------------------------------- streaming returns
+
+    async def _handle_stream_item(self, payload):
+        """A streaming task produced its next item (worker → owner,
+        ordered oneway on one connection)."""
+        task_id = payload["task_id"]
+        oid = ObjectID.for_task_return(task_id, payload["index"])
+        if task_id in self._released_streams:
+            # The consumer abandoned this stream; drop the item instead
+            # of storing it forever (plasma copies are freed explicitly).
+            if payload["kind"] == "plasma":
+                self._send_oneway(self.gcs_address, "FreeObject",
+                                  {"object_id": oid})
+            return True
+        self.memory.put(oid, payload["kind"], payload["data"])
+        state = self._streams.get(task_id)
+        if state is not None:
+            with state.cond:
+                state.received = max(state.received, payload["index"] + 1)
+                state.cond.notify_all()
+        return True
+
+    def _register_stream(self, task_id: TaskID) -> None:
+        self._streams[task_id] = _StreamState()
+
+    def _finish_stream(self, task_id: TaskID, total: int,
+                       error: Exception | None) -> None:
+        state = self._streams.get(task_id)
+        if state is None:
+            return
+        with state.cond:
+            state.total = total
+            state.error = error
+            state.cond.notify_all()
+
+    def stream_next(self, task_id: TaskID, index: int,
+                    timeout: float | None):
+        """Block until return #index exists (→ its ObjectRef), the stream
+        ends (→ None), or a mid-stream failure surfaces (→ raises).
+        A missing stream (already fully consumed / released) reads as
+        exhausted, so re-iterating a finished generator raises
+        StopIteration like any other iterator."""
+        state = self._streams.get(task_id)
+        if state is None:
+            return None
+        deadline = (None if timeout is None
+                    else time.monotonic() + timeout)
+        with state.cond:
+            while True:
+                # Items already received stream out even after a failure —
+                # the error surfaces at the point production stopped.
+                if index < state.received:
+                    return ObjectRef(
+                        ObjectID.for_task_return(task_id, index),
+                        owner_address=self.address)
+                if state.total is not None and index >= state.total:
+                    # End marker seen AND index past it.  Items travel on
+                    # a different connection than the marker, so wait for
+                    # stragglers (received < total) instead of dropping
+                    # them.
+                    if state.error is not None:
+                        self._streams.pop(task_id, None)
+                        raise state.error
+                    if state.received >= state.total:
+                        self._streams.pop(task_id, None)
+                        return None
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    raise exceptions.GetTimeoutError(
+                        f"stream item {index} of "
+                        f"{task_id.hex()[:12]} not ready in time")
+                state.cond.wait(remaining if remaining is not None
+                                else 1.0)
+
+    def release_stream(self, task_id: TaskID, consumed: int) -> None:
+        """Drop an abandoned stream's state and free the items the
+        consumer never took (called from ObjectRefGenerator.__del__ —
+        without it, a half-read stream leaks its tail forever).  The
+        task id is remembered so items still in flight from the
+        still-running producer are dropped on arrival."""
+        state = self._streams.pop(task_id, None)
+        if state is None:
+            return
+        self._released_streams[task_id] = True
+        while len(self._released_streams) > 1024:  # bounded memory
+            self._released_streams.pop(
+                next(iter(self._released_streams)))
+        with state.cond:
+            received = state.received
+        with self._ref_lock:
+            for i in range(consumed, received):
+                oid = ObjectID.for_task_return(task_id, i)
+                if self.memory.is_owned(oid):
+                    self._maybe_free_locked(oid)
+
     def _store_returns(self, spec: TaskSpec, returns: list):
+        if spec.num_returns == -1:  # streaming: end-of-stream marker
+            kind, data = returns[0]
+            assert kind == "stream_end", kind
+            count, err_payload = data
+            error = (self._deserialize_payload(err_payload)
+                     if err_payload is not None else None)
+            self._finish_stream(spec.task_id, count, error)
+            return
         for i, (kind, data) in enumerate(returns):
             oid = ObjectID.for_task_return(spec.task_id, i)
             self.memory.put(oid, kind, data)
@@ -874,6 +1010,12 @@ class ClusterRuntime(CoreRuntime):
             f"lineage re-execution kept failing: {last}")
 
     def _store_error(self, spec: TaskSpec, err: Exception):
+        if spec.num_returns == -1:  # streaming: fail the stream
+            state = self._streams.get(spec.task_id)
+            self._finish_stream(
+                spec.task_id,
+                state.received if state is not None else 0, err)
+            return
         payload = serialization.serialize_error(err).to_payload()
         for i in range(spec.num_returns):
             oid = ObjectID.for_task_return(spec.task_id, i)
@@ -930,6 +1072,7 @@ class ClusterRuntime(CoreRuntime):
             placement_group_bundle_index=max(
                 options.placement_group_bundle_index, 0),
             runtime_env=self._package_runtime_env(options.runtime_env),
+            label_selector=options.label_selector,
         )
         reply = self._gcs.call("CreateActor", spec, retries=3)
         if "error" in reply:
@@ -996,12 +1139,17 @@ class ClusterRuntime(CoreRuntime):
                           options: TaskOptions):
         actor_id = handle.actor_id
         task_id = TaskID.for_actor_task(actor_id)
-        num_returns = options.num_returns
+        streaming = options.num_returns == "streaming"
+        num_returns = -1 if streaming else options.num_returns
         return_refs = []
-        for i in range(num_returns):
-            oid = ObjectID.for_task_return(task_id, i)
-            self.memory.mark_pending(oid)
-            return_refs.append(ObjectRef(oid, owner_address=self.address))
+        if streaming:
+            self._register_stream(task_id)
+        else:
+            for i in range(num_returns):
+                oid = ObjectID.for_task_return(task_id, i)
+                self.memory.mark_pending(oid)
+                return_refs.append(
+                    ObjectRef(oid, owner_address=self.address))
 
         args_payload, pinned = self._pack_args(args, kwargs)
         spec = TaskSpec(
@@ -1012,7 +1160,8 @@ class ClusterRuntime(CoreRuntime):
             num_returns=num_returns,
             owner_address=self.address,
             resources={},
-            max_retries=getattr(handle, "_max_task_retries", 0),
+            max_retries=(0 if streaming else
+                         getattr(handle, "_max_task_retries", 0)),
             actor_id=actor_id,
             method_name=method_name,
         )
@@ -1030,6 +1179,10 @@ class ClusterRuntime(CoreRuntime):
                 asyncio.ensure_future(self._actor_sender(state))
 
         self._io.loop.call_soon_threadsafe(_enqueue)
+        if streaming:
+            from ant_ray_tpu.object_ref import ObjectRefGenerator  # noqa: PLC0415
+
+            return ObjectRefGenerator(task_id, self)
         return return_refs[0] if num_returns == 1 else return_refs
 
     async def _actor_sender(self, state: _ActorSubmitState):

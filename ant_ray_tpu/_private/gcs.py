@@ -230,6 +230,15 @@ class GcsServer:
         for key, blob in self._store.load_table("locations").items():
             oid, nodes = pickle.loads(blob)
             self._object_locations[oid] = nodes
+        # Liveness reconciliation: an actor restored as ALIVE may sit on
+        # a node that never comes back (its daemon died during the head's
+        # downtime, so no WorkerDied report will ever arrive).  After a
+        # registration grace period, fail those actors through the normal
+        # restart machinery.
+        if any(r.state in (ACTOR_ALIVE, ACTOR_RESTARTING)
+               for r in self._actors.values()):
+            asyncio.run_coroutine_threadsafe(
+                self._reconcile_actors_after_restart(), self._io.loop)
         logger.info(
             "restored GCS state: %d actors, %d pgs, %d kv keys, %d jobs",
             len(self._actors), len(self._placement_groups),
@@ -239,6 +248,19 @@ class GcsServer:
         # Give nodes one heartbeat round to re-register before placing.
         await asyncio.sleep(global_config().heartbeat_period_s * 2)
         await self._schedule_actor(record)
+
+    async def _reconcile_actors_after_restart(self):
+        cfg = global_config()
+        await asyncio.sleep(
+            cfg.heartbeat_period_s * cfg.num_heartbeats_timeout)
+        for record in list(self._actors.values()):
+            if record.state not in (ACTOR_ALIVE, ACTOR_RESTARTING):
+                continue
+            node = (self._nodes.get(record.node_id)
+                    if record.node_id is not None else None)
+            if node is None or not node.alive:
+                await self._handle_actor_failure(
+                    record, "node lost while the head was down")
 
     def stop(self):
         if self._health_task is not None:
@@ -528,7 +550,8 @@ class GcsServer:
             else:
                 node = self._pick_node(
                     placement,
-                    allowed=self._allowed_nodes_for_job(spec.job_id))
+                    allowed=self._allowed_nodes_for_job(spec.job_id),
+                    label_selector=spec.label_selector)
             if node is not None:
                 record.node_id = node.node_id
                 client = self._clients.get(node.address)
@@ -546,22 +569,35 @@ class GcsServer:
         record.state_event.set()
         self._save_actor(record)
 
+    @staticmethod
+    def _labels_match(info: NodeInfo, selector: dict | None) -> bool:
+        """Exact-match label selector (ref: LabelSelector,
+        src/ray/common/scheduling/label_selector.h — equality terms)."""
+        if not selector:
+            return True
+        return all(info.labels.get(k) == v for k, v in selector.items())
+
     def _pick_node(self, resources: dict[str, float],
                    by_available: bool = True,
-                   allowed: set | None = None) -> NodeInfo | None:
+                   allowed: set | None = None,
+                   label_selector: dict | None = None) -> NodeInfo | None:
         """Least-loaded feasible node (hybrid policy seed).
 
         by_available=True matches against the (heartbeat-fed, possibly
         stale) availability view; by_available=False against total
         capacity — used to distinguish "busy right now" from "can never
         run" (ref: ClusterResourceScheduler feasibility vs availability).
-        ``allowed`` restricts candidates (virtual-cluster membership).
+        ``allowed`` restricts candidates (virtual-cluster membership);
+        ``label_selector`` restricts to nodes advertising those labels
+        (TPU generation / pod / worker-id).
         """
         best, best_score = None, -1.0
         for info in self._nodes.values():
             if not info.alive:
                 continue
             if allowed is not None and info.node_id not in allowed:
+                continue
+            if not self._labels_match(info, label_selector):
                 continue
             view = (info.available_resources if by_available
                     else info.total_resources)
@@ -767,21 +803,54 @@ class GcsServer:
             "state": "PENDING",
             "bundle_nodes": [None] * len(payload["bundles"]),
             "reason": "",
+            "bundle_selectors": payload.get("bundle_label_selectors"),
+            "same_label": payload.get("same_label"),
         }
         self._placement_groups[payload["pg_id"]] = record
         self._save_pg(record)
         asyncio.ensure_future(self._schedule_placement_group(record))
         return True
 
-    def _plan_bundles(self, bundles, strategy,
-                      job_id=None) -> list[NodeInfo] | None:
+    def _plan_bundles(self, bundles, strategy, job_id=None,
+                      bundle_selectors=None,
+                      same_label=None) -> list[NodeInfo] | None:
         """Choose a node per bundle against the availability view; None if
         no valid assignment right now.  Candidates respect the job's
-        virtual cluster."""
+        virtual cluster.
+
+        ``bundle_selectors``: optional per-bundle label selectors (exact
+        match).  ``same_label``: a label key whose VALUE must be shared by
+        every chosen node — the slice-affinity constraint ("all bundles on
+        one tpu-pod-name") behind SlicePlacementGroup (ref:
+        python/ray/util/tpu.py:52, bundle_label_selector)."""
         allowed = self._allowed_nodes_for_job(job_id)
         alive = [n for n in self._nodes.values() if n.alive
                  and (allowed is None or n.node_id in allowed)]
+        if same_label is not None:
+            # Try each value-group of the shared label independently;
+            # first group that fits wins.  Deterministic order so
+            # repeated attempts converge.
+            values = sorted({n.labels.get(same_label) for n in alive
+                             if n.labels.get(same_label) is not None})
+            for value in values:
+                group = [n for n in alive
+                         if n.labels.get(same_label) == value]
+                plan = self._plan_bundles_in(
+                    group, bundles, strategy, bundle_selectors)
+                if plan is not None:
+                    return plan
+            return None
+        return self._plan_bundles_in(alive, bundles, strategy,
+                                     bundle_selectors)
+
+    def _plan_bundles_in(self, alive, bundles, strategy,
+                         bundle_selectors=None) -> list[NodeInfo] | None:
         remaining = {n.node_id: dict(n.available_resources) for n in alive}
+
+        def selector_ok(node, index):
+            if not bundle_selectors:
+                return True
+            return self._labels_match(node, bundle_selectors[index])
 
         def fits(node_id, bundle):
             return all(remaining[node_id].get(k, 0.0) >= v
@@ -795,6 +864,9 @@ class GcsServer:
         if strategy in ("STRICT_PACK", "PACK"):
             # try to fit everything on one node
             for node in alive:
+                if not all(selector_ok(node, i)
+                           for i in range(len(bundles))):
+                    continue
                 snapshot = dict(remaining[node.node_id])
                 ok = True
                 for bundle in bundles:
@@ -810,13 +882,15 @@ class GcsServer:
                 return None
         # greedy per-bundle; SPREAD/STRICT_SPREAD prefer unused nodes
         used: set = set()
-        for bundle in bundles:
+        for index, bundle in enumerate(bundles):
             candidates = sorted(
                 alive, key=lambda n: (n.node_id in used,
                                       -sum(remaining[n.node_id].values())))
             chosen = None
             for node in candidates:
                 if strategy == "STRICT_SPREAD" and node.node_id in used:
+                    continue
+                if not selector_ok(node, index):
                     continue
                 if fits(node.node_id, bundle):
                     chosen = node
@@ -833,8 +907,10 @@ class GcsServer:
         for _attempt in range(120):
             if record["state"] == "REMOVED":
                 return
-            plan = self._plan_bundles(bundles, record["strategy"],
-                                      record.get("job_id"))
+            plan = self._plan_bundles(
+                bundles, record["strategy"], record.get("job_id"),
+                bundle_selectors=record.get("bundle_selectors"),
+                same_label=record.get("same_label"))
             if plan is not None:
                 prepared = []
                 ok = True
@@ -947,15 +1023,18 @@ class GcsServer:
     async def _select_node(self, payload):
         resources = payload.get("resources", {})
         exclude = payload.get("exclude")
+        selector = payload.get("label_selector")
         allowed = self._allowed_nodes_for_job(payload.get("job_id"))
 
         def _excluding(by_available: bool) -> NodeInfo | None:
-            node = self._pick_node(resources, by_available, allowed)
+            node = self._pick_node(resources, by_available, allowed,
+                                   selector)
             if node is not None and node.node_id == exclude:
                 others = [
                     n for n in self._nodes.values()
                     if n.alive and n.node_id != exclude and (
-                        allowed is None or n.node_id in allowed) and all(
+                        allowed is None or n.node_id in allowed)
+                    and self._labels_match(n, selector) and all(
                         (n.available_resources if by_available
                          else n.total_resources).get(k, 0) >= v
                         for k, v in resources.items())

@@ -67,6 +67,15 @@ class NodeManager:
 
         self._labels = {**_tpu.node_labels(), **(labels or {})}
 
+        # The slice-head host (worker 0) advertises TPU-<pod_type>-head
+        # so a slice can be exclusively claimed by reserving that single
+        # unit resource (ref: python/ray/util/tpu.py:227).
+        if self._labels.get("tpu-worker-id") == "0" and \
+                self._labels.get("tpu-pod-type"):
+            resources = dict(resources)
+            resources.setdefault(
+                f"TPU-{self._labels['tpu-pod-type']}-head", 1.0)
+
         cfg = global_config()
         store_capacity = cfg.object_store_memory or default_store_capacity()
         store_dir = os.path.join(
@@ -280,17 +289,30 @@ class NodeManager:
                         self._release(handle.lease_resources)
                 if handle.state == ACTOR and handle.actor_spec is not None:
                     self._release_actor_resources(handle.actor_spec)
-                    try:
-                        await gcs.call_async("WorkerDied", {
-                            "node_id": self.node_id,
-                            "worker_id": worker_id,
-                            "actor_id": handle.actor_spec.actor_id,
-                            "reason": f"worker exited with code "
-                                      f"{handle.proc.returncode}",
-                        }, timeout=10)
-                    except Exception:  # noqa: BLE001
-                        pass
+                    # Death reports must survive a GCS restart window —
+                    # fire-and-forget here loses the actor forever
+                    # (restored as ALIVE on resync with no one to
+                    # correct it), so retry in the background.
+                    asyncio.ensure_future(self._report_worker_died(
+                        gcs, worker_id, handle))
                 self._lease_event.set()
+
+    async def _report_worker_died(self, gcs, worker_id, handle):
+        payload = {
+            "node_id": self.node_id,
+            "worker_id": worker_id,
+            "actor_id": handle.actor_spec.actor_id,
+            "reason": f"worker exited with code "
+                      f"{handle.proc.returncode}",
+        }
+        for attempt in range(30):  # ~60s: outlasts a head restart
+            try:
+                await gcs.call_async("WorkerDied", payload, timeout=10)
+                return
+            except Exception:  # noqa: BLE001 — head may be restarting
+                await asyncio.sleep(min(0.2 * (attempt + 1), 2.0))
+        logger.warning("giving up reporting death of worker %s",
+                       worker_id)
 
     def _terminate_worker(self, handle: WorkerHandle):
         if handle.proc.poll() is None:
@@ -396,6 +418,20 @@ class NodeManager:
 
         pg_key = payload.get("pg")
         job_id = payload.get("job_id")
+        selector = payload.get("label_selector")
+        # A label-constrained lease on a non-matching node redirects
+        # immediately (the GCS picks a matching node); PG leases are
+        # exempt — the bundle was placed under the selector already.
+        if pg_key is None and selector and not all(
+                self._labels.get(k) == v for k, v in selector.items()):
+            node = await gcs.call_async(
+                "SelectNode", {"resources": demand, "job_id": job_id,
+                               "exclude": self.node_id,
+                               "label_selector": selector}, timeout=10)
+            if node is not None and node.node_id != self.node_id:
+                return {"spill": node.address}
+            return {"infeasible": True,
+                    "reason": f"no node matches label selector {selector}"}
         # Virtual-cluster fencing: if this node isn't in the job's
         # allowed set, redirect before doing any work here (ant-fork
         # ref: node_manager.ant.cc cancels mismatched leases).  PG
@@ -405,7 +441,8 @@ class NodeManager:
                 not await self._job_allowed_here(job_id):
             node = await gcs.call_async(
                 "SelectNode", {"resources": demand, "job_id": job_id,
-                               "exclude": self.node_id}, timeout=10)
+                               "exclude": self.node_id,
+                               "label_selector": selector}, timeout=10)
             if node is not None and node.node_id != self.node_id:
                 return {"spill": node.address}
             return {"infeasible": True,
@@ -458,7 +495,8 @@ class NodeManager:
         if not self._feasible(demand):
             node = await gcs.call_async(
                 "SelectNode", {"resources": demand, "job_id": job_id,
-                               "exclude": self.node_id},
+                               "exclude": self.node_id,
+                               "label_selector": selector},
                 timeout=10)
             if node is not None:
                 return {"spill": node.address}
@@ -486,7 +524,8 @@ class NodeManager:
                 node = await gcs.call_async(
                     "SelectNode",
                     {"resources": demand, "job_id": job_id,
-                     "exclude": self.node_id},
+                     "exclude": self.node_id,
+                     "label_selector": selector},
                     timeout=10)
                 if node is not None and node.node_id != self.node_id:
                     return {"spill": node.address}

@@ -40,6 +40,9 @@ class TaskSpec:
     placement_group_bundle_index: int = -1
     # Wire-form runtime env (see _private/runtime_env.py)
     runtime_env: dict | None = None
+    # Exact-match node-label constraint (ref: label_selector,
+    # src/ray/common/scheduling/label_selector.h)
+    label_selector: dict | None = None
 
 
 @dataclass
@@ -62,6 +65,7 @@ class ActorSpec:
     placement_group_id: "object | None" = None
     placement_group_bundle_index: int = -1
     runtime_env: dict | None = None
+    label_selector: dict | None = None
 
 
 @dataclass

@@ -21,6 +21,9 @@ class TaskOptions:
     scheduling_strategy: Any = None        # "DEFAULT" | "SPREAD" | PG strategy
     placement_group: Any = None
     placement_group_bundle_index: int = -1
+    # Node-label constraint, e.g. {"tpu-pod-name": "slice-A"}
+    # (ref: @ray.remote(label_selector=...))
+    label_selector: dict | None = None
     _metadata: dict = dataclasses.field(default_factory=dict)
 
     def resource_demand(self, default_num_cpus: float = 1.0) -> dict[str, float]:

@@ -136,6 +136,12 @@ class LocalModeRuntime(CoreRuntime):
     def submit_task(self, remote_function, args, kwargs, options: TaskOptions):
         task_id = TaskID.for_normal_task(self._job_id)
         num_returns = options.num_returns
+        if num_returns == "streaming":
+            # Local mode executes eagerly; the generator surface is kept
+            # so user code is portable.
+            rargs, rkwargs = self._resolve_args(args, kwargs)
+            result = remote_function.function(*rargs, **rkwargs)
+            return iter(self._store(task_id, list(result)))
         try:
             rargs, rkwargs = self._resolve_args(args, kwargs)
             result = remote_function.function(*rargs, **rkwargs)

@@ -149,6 +149,8 @@ class TaskExecutor:
             insight.record_call_end(spec.function_name,
                                     spec.task_id.hex(),
                                     time.monotonic() - started)
+        if spec.num_returns == -1:  # streaming generator task
+            return self._stream_returns(spec, result)
         values = [result] if spec.num_returns == 1 else list(result)
         if len(values) != spec.num_returns:
             err = exceptions.TaskError(
@@ -187,6 +189,34 @@ class TaskExecutor:
         if isinstance(value, ObjectRef):
             return self.runtime.get([value], timeout=None)[0]
         return value
+
+    def _stream_returns(self, spec: TaskSpec, result) -> dict:
+        """Drive a streaming task: each yielded item is shipped to the
+        owner the moment it exists (ordered oneways on one connection),
+        so the consumer reads item 0 while the task still runs (ref:
+        streaming generator path, task_manager.h:67).  The final reply
+        carries the end-of-stream marker (count + optional error)."""
+        count = 0
+        error_payload = None
+        owner = self.runtime._clients.get(spec.owner_address)
+        try:
+            for item in result:
+                kind, data = self._package(spec, count, item)
+                fut = asyncio.run_coroutine_threadsafe(
+                    owner.oneway_async("StreamItem", {
+                        "task_id": spec.task_id,
+                        "index": count,
+                        "kind": kind,
+                        "data": data,
+                    }), self._io.loop)
+                fut.result(timeout=60)
+                count += 1
+        except Exception as e:  # noqa: BLE001 — mid-stream failure
+            err_cls = (exceptions.ActorError if spec.actor_id is not None
+                       else exceptions.TaskError)
+            err = err_cls.from_exception(spec.function_name, e)
+            error_payload = serialization.serialize_error(err).to_payload()
+        return {"returns": [("stream_end", (count, error_payload))]}
 
     def _package(self, spec: TaskSpec, index: int, value):
         oid = ObjectID.for_task_return(spec.task_id, index)

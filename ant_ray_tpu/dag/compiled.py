@@ -228,8 +228,15 @@ class ChannelCompiledDAG:
         for n in actor_nodes:
             if any(isinstance(c, InputNode) for c in n._children()):
                 input_consumer_actors.add(n._handle.actor_id)
-        self._input_chan = (make_channel("in", len(input_consumer_actors))
-                            if input_consumer_actors else None)
+        if not input_consumer_actors:
+            # Without an input channel the exec loops would free-run on
+            # output backpressure alone, decoupled from execute() calls —
+            # diverging from one-execution-per-execute semantics.  Such
+            # graphs stay on the interpreted executor.
+            raise ValueError(
+                "channel compilation requires an InputNode feeding the "
+                "graph")
+        self._input_chan = make_channel("in", len(input_consumer_actors))
 
         # Output channels: one per node consumed by a DIFFERENT actor,
         # plus the final output (read by the driver).
@@ -329,6 +336,10 @@ class ChannelCompiledDAG:
     # ------------------------------------------------------------ api
 
     def execute(self, *input_args):
+        if getattr(self, "_closed", False):
+            raise RuntimeError(
+                "this compiled DAG was torn down; call "
+                "experimental_compile() again for a fresh pipeline")
         if not self._started:
             self._start()
         self._submitted += 1
@@ -425,3 +436,5 @@ class ChannelCompiledDAG:
         except OSError:
             pass
         self._started = False
+        # Channels and loop actors are gone; the object is terminal.
+        self._closed = True

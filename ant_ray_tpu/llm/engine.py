@@ -184,6 +184,37 @@ class LLMEngine:
                 outputs[out.request_id] = out
         return [outputs[rid] for rid in order]
 
+    def stream(self, prompt, sampling: SamplingParams | None = None):
+        """Incremental generation for one request: yields a dict per new
+        token ({"token_id", "text", "finished": False}) and a final
+        summary chunk ({"finished": True, "finish_reason", "token_ids",
+        "full_text"}) — the serving-side source for SSE token streaming
+        (ref capability: vllm engine streaming outputs)."""
+        rid = self.add_request(prompt, sampling)
+        seq = self._waiting[-1]
+        assert seq.request_id == rid
+        emitted = 0
+        final: RequestOutput | None = None
+        while final is None and self.has_unfinished():
+            for out in self.step():
+                if out.request_id == rid:
+                    final = out
+            source = final.token_ids if final else seq.generated
+            while emitted < len(source):
+                tok = int(source[emitted])
+                emitted += 1
+                yield {"token_id": tok,
+                       "text": self.tokenizer.decode([tok]),
+                       "finished": False,
+                       "finish_reason": None}
+        yield {"token_id": None,
+               "text": "",
+               "finished": True,
+               "finish_reason": (final.finish_reason if final
+                                 else "length"),
+               "token_ids": list(final.token_ids) if final else [],
+               "full_text": final.text if final else ""}
+
     # ----------------------------------------------------------- private
 
     def _after_token(self, seq: _Seq, tok: int):

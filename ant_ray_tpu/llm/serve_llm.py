@@ -20,11 +20,17 @@ class LLMServer:
     def __init__(self, model="tiny", *, slots: int = 8,
                  max_seq: int | None = None, tokenizer_name: str | None =
                  None, seed: int = 0):
+        import threading  # noqa: PLC0415
+
         from ant_ray_tpu.llm.tokenizer import get_tokenizer  # noqa: PLC0415
 
         self.engine = LLMEngine(
             model, slots=slots, max_seq=max_seq,
             tokenizer=get_tokenizer(tokenizer_name), seed=seed)
+        # The engine mutates shared slot/cache state; replicas may run
+        # requests on overlapping threads (max_concurrency > 1), so all
+        # engine access serializes here.
+        self._engine_lock = threading.Lock()
 
     def __call__(self, request: dict) -> dict:
         """OpenAI-completions-shaped request: {"prompt": str|list,
@@ -42,7 +48,8 @@ class LLMServer:
             stop_token_ids=tuple(request.get("stop_token_ids", ())),
             seed=request.get("seed"),
         )
-        outs = self.engine.generate(batch, sampling)
+        with self._engine_lock:
+            outs = self.engine.generate(batch, sampling)
         return {
             "object": "text_completion",
             "choices": [
@@ -52,6 +59,48 @@ class LLMServer:
                 for i, o in enumerate(outs)
             ],
         }
+
+    def stream(self, request: dict):
+        """Token-streaming completion: a generator of OpenAI-chunk-shaped
+        dicts, consumed through the object plane as a streaming actor
+        call (num_returns="streaming") and exposed over SSE by the HTTP
+        proxy (ref: serve streaming responses, serve/_private/replica.py
+        streaming path)."""
+        prompts = request.get("prompt", "")
+        prompt = prompts[0] if isinstance(prompts, list) and prompts \
+            and not isinstance(prompts[0], int) else prompts
+        sampling = SamplingParams(
+            max_tokens=int(request.get("max_tokens", 64)),
+            temperature=float(request.get("temperature", 0.0)),
+            top_k=int(request.get("top_k", 0)),
+            top_p=float(request.get("top_p", 1.0)),
+            stop_token_ids=tuple(request.get("stop_token_ids", ())),
+            seed=request.get("seed"),
+        )
+        # The lock spans the generator's whole life (tokens must stream
+        # while generation runs, and no other request may touch the
+        # engine mid-stream); the finally releases it even if the
+        # consumer abandons the generator (GeneratorExit).
+        self._engine_lock.acquire()
+        try:
+            yield from self._chunks(self.engine.stream(prompt, sampling))
+        finally:
+            self._engine_lock.release()
+
+    def _chunks(self, deltas):
+        for delta in deltas:
+            if delta["finished"]:
+                yield {"object": "text_completion.chunk",
+                       "choices": [{"index": 0, "text": "",
+                                    "finish_reason":
+                                        delta["finish_reason"]}],
+                       "done": True}
+            else:
+                yield {"object": "text_completion.chunk",
+                       "choices": [{"index": 0, "text": delta["text"],
+                                    "token_id": delta["token_id"],
+                                    "finish_reason": None}],
+                       "done": False}
 
     def health(self):
         return "ok"

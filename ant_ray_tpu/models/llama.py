@@ -41,6 +41,12 @@ class LlamaConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = False
+    # Mixture-of-experts MLP (0 = dense).  Experts shard over the mesh's
+    # ``ep`` axis; routing is dense top-k dispatch (static shapes — the
+    # XLA-friendly formulation; expert weights never leave their shard,
+    # the combine einsum's contraction inserts the psum over ep).
+    num_experts: int = 0
+    experts_per_token: int = 2
 
     @property
     def head_dim(self) -> int:
@@ -48,11 +54,16 @@ class LlamaConfig:
 
     def num_params(self) -> int:
         p = self.vocab_size * self.dim                       # embed
+        if self.num_experts:
+            mlp = (self.dim * self.num_experts               # router
+                   + 3 * self.num_experts * self.dim * self.mlp_dim)
+        else:
+            mlp = 3 * self.dim * self.mlp_dim                # gate, up, down
         per_layer = (
             self.dim * self.n_heads * self.head_dim          # wq
             + 2 * self.dim * self.n_kv_heads * self.head_dim  # wk, wv
             + self.n_heads * self.head_dim * self.dim        # wo
-            + 3 * self.dim * self.mlp_dim                    # gate, up, down
+            + mlp
             + 2 * self.dim                                   # norms
         )
         p += self.n_layers * per_layer + self.dim            # final norm
@@ -74,6 +85,11 @@ CONFIGS: dict[str, LlamaConfig] = {
     "tiny": LlamaConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
         mlp_dim=128, max_seq=512, dtype=jnp.float32),
+    # MoE variant: 4 experts, top-2 routing — the ep-axis test model
+    "moe-tiny": LlamaConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        mlp_dim=128, max_seq=512, dtype=jnp.float32,
+        num_experts=4, experts_per_token=2),
 }
 
 
@@ -82,6 +98,19 @@ CONFIGS: dict[str, LlamaConfig] = {
 def param_shapes(config: LlamaConfig) -> dict:
     c = config
     hd = c.head_dim
+    if c.num_experts:
+        mlp_shapes = {
+            "router": (c.n_layers, c.dim, c.num_experts),
+            "w_gate": (c.n_layers, c.num_experts, c.dim, c.mlp_dim),
+            "w_up": (c.n_layers, c.num_experts, c.dim, c.mlp_dim),
+            "w_down": (c.n_layers, c.num_experts, c.mlp_dim, c.dim),
+        }
+    else:
+        mlp_shapes = {
+            "w_gate": (c.n_layers, c.dim, c.mlp_dim),
+            "w_up": (c.n_layers, c.dim, c.mlp_dim),
+            "w_down": (c.n_layers, c.mlp_dim, c.dim),
+        }
     return {
         "embed": (c.vocab_size, c.dim),
         "layers": {
@@ -91,9 +120,7 @@ def param_shapes(config: LlamaConfig) -> dict:
             "wv": (c.n_layers, c.dim, c.n_kv_heads * hd),
             "wo": (c.n_layers, c.n_heads * hd, c.dim),
             "ln_mlp": (c.n_layers, c.dim),
-            "w_gate": (c.n_layers, c.dim, c.mlp_dim),
-            "w_up": (c.n_layers, c.dim, c.mlp_dim),
-            "w_down": (c.n_layers, c.mlp_dim, c.dim),
+            **mlp_shapes,
         },
         "norm_f": (c.dim,),
         **({} if config.tie_embeddings else
@@ -103,6 +130,19 @@ def param_shapes(config: LlamaConfig) -> dict:
 
 def param_logical_dims(config: LlamaConfig) -> dict:
     """Logical dim names per param (see parallel/sharding.py rules)."""
+    if config.num_experts:
+        mlp_dims = {
+            "router": (None, None, "experts"),
+            "w_gate": (None, "experts", "embed_param", "mlp"),
+            "w_up": (None, "experts", "embed_param", "mlp"),
+            "w_down": (None, "experts", "mlp", "embed_param"),
+        }
+    else:
+        mlp_dims = {
+            "w_gate": (None, "embed_param", "mlp"),
+            "w_up": (None, "embed_param", "mlp"),
+            "w_down": (None, "mlp", "embed_param"),
+        }
     tree = {
         "embed": ("vocab", "embed_param"),
         "layers": {
@@ -112,9 +152,7 @@ def param_logical_dims(config: LlamaConfig) -> dict:
             "wv": (None, "embed_param", "heads_flat"),
             "wo": (None, "heads_flat", "embed_param"),
             "ln_mlp": (None, "norm"),
-            "w_gate": (None, "embed_param", "mlp"),
-            "w_up": (None, "embed_param", "mlp"),
-            "w_down": (None, "mlp", "embed_param"),
+            **mlp_dims,
         },
         "norm_f": ("norm",),
     }
@@ -174,6 +212,59 @@ def param_shardings(config: LlamaConfig, mesh) -> dict:
 
 # ---------------------------------------------------------------- forward
 
+def apply_block(layer: dict, x, c: LlamaConfig, cos, sin, positions,
+                attend, constrain_act, *, return_kv: bool = False):
+    """One transformer block — shared by the scan path (forward), the
+    GPipe stage path (loss_fn_pp), and decode variants."""
+    batch, seq, _ = x.shape
+    h = rmsnorm(x, layer["ln_attn"], c.norm_eps)
+    xq = (h @ layer["wq"]).reshape(batch, seq, c.n_heads, c.head_dim)
+    xk = (h @ layer["wk"]).reshape(batch, seq, c.n_kv_heads, c.head_dim)
+    xv = (h @ layer["wv"]).reshape(batch, seq, c.n_kv_heads, c.head_dim)
+    xq = apply_rope(xq, cos, sin, positions)
+    xk = apply_rope(xk, cos, sin, positions)
+    xq = constrain_act(xq, ("batch", "seq", "heads", "head_dim"))
+    xk = constrain_act(xk, ("batch", "seq", "kv_heads", "head_dim"))
+    attn = attend(xq, xk, xv)
+    attn = attn.reshape(batch, seq, c.n_heads * c.head_dim)
+    x = x + (attn @ layer["wo"]).astype(x.dtype)
+    x = constrain_act(x, ("batch", "seq", "embed"))
+
+    h = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
+    if c.num_experts:
+        x = x + _moe_mlp(layer, h, c, constrain_act).astype(x.dtype)
+    else:
+        gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+        x = x + (gated @ layer["w_down"]).astype(x.dtype)
+    x = constrain_act(x, ("batch", "seq", "embed"))
+    kv = (xk.astype(c.dtype), xv.astype(c.dtype)) if return_kv else None
+    return x, kv
+
+
+def _moe_mlp(layer: dict, h, c: LlamaConfig, constrain_act):
+    """Top-k mixture-of-experts MLP with dense dispatch.
+
+    Every expert runs on every token with static shapes (XLA-friendly; no
+    ragged gather), weighted by the router's top-k gates.  The experts
+    dimension shards over the mesh's ``ep`` axis — expert weights stay on
+    their shard and the final combine einsum (contraction over e) is
+    where XLA inserts the psum across ep.
+    """
+    router_logits = h @ layer["router"]                    # (b, s, E)
+    top_vals, top_idx = lax.top_k(router_logits, c.experts_per_token)
+    gates = jax.nn.softmax(top_vals, axis=-1)              # (b, s, k)
+    # Scatter the top-k gates back to a dense (b, s, E) weight map.
+    weights = jnp.sum(
+        jax.nn.one_hot(top_idx, c.num_experts, dtype=h.dtype)
+        * gates[..., None].astype(h.dtype), axis=-2)
+    ge = jnp.einsum("bsd,edm->ebsm", h, layer["w_gate"])   # (E, b, s, m)
+    ue = jnp.einsum("bsd,edm->ebsm", h, layer["w_up"])
+    oe = jnp.einsum("ebsm,emd->ebsd", jax.nn.silu(ge) * ue,
+                    layer["w_down"])
+    oe = constrain_act(oe, ("experts", "batch", "seq", "embed"))
+    return jnp.einsum("ebsd,bse->bsd", oe, weights)
+
+
 def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
             attn_impl: str = "auto", positions=None,
             return_kv: bool = False, logits_at=None,
@@ -217,26 +308,8 @@ def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
         return attention(xq, xk, xv, causal=True, impl=attn_impl)
 
     def block(x, layer):
-        batch, seq, _ = x.shape
-        h = rmsnorm(x, layer["ln_attn"], c.norm_eps)
-        xq = (h @ layer["wq"]).reshape(batch, seq, c.n_heads, c.head_dim)
-        xk = (h @ layer["wk"]).reshape(batch, seq, c.n_kv_heads, c.head_dim)
-        xv = (h @ layer["wv"]).reshape(batch, seq, c.n_kv_heads, c.head_dim)
-        xq = apply_rope(xq, cos, sin, positions)
-        xk = apply_rope(xk, cos, sin, positions)
-        xq = constrain_act(xq, ("batch", "seq", "heads", "head_dim"))
-        xk = constrain_act(xk, ("batch", "seq", "kv_heads", "head_dim"))
-        attn = attend(xq, xk, xv)
-        attn = attn.reshape(batch, seq, c.n_heads * c.head_dim)
-        x = x + (attn @ layer["wo"]).astype(x.dtype)
-        x = constrain_act(x, ("batch", "seq", "embed"))
-
-        h = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
-        gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
-        x = x + (gated @ layer["w_down"]).astype(x.dtype)
-        x = constrain_act(x, ("batch", "seq", "embed"))
-        kv = (xk.astype(c.dtype), xv.astype(c.dtype)) if return_kv else None
-        return x, kv
+        return apply_block(layer, x, c, cos, sin, positions, attend,
+                           constrain_act, return_kv=return_kv)
 
     if remat == "full":
         block = jax.checkpoint(block)
@@ -278,6 +351,60 @@ def loss_fn(params: dict, batch: dict, config: LlamaConfig, *, mesh=None,
         mask = mask[:, 1:]
         return jnp.sum(losses * mask) / jnp.maximum(jnp.sum(mask), 1)
     return jnp.mean(losses)
+
+
+def loss_fn_pp(params: dict, batch: dict, config: LlamaConfig, *, mesh,
+               num_microbatches: int = 4, attn_impl: str = "auto"):
+    """Pipeline-parallel next-token loss: the transformer blocks run as a
+    GPipe schedule over the mesh's ``pp`` axis (parallel/pipeline.py —
+    single compiled program, activations hop stages via ppermute),
+    composing with dp/fsdp/tp on the remaining axes.  Requires
+    n_layers % pp == 0 and batch % num_microbatches == 0."""
+    from ant_ray_tpu.parallel.pipeline import gpipe  # noqa: PLC0415
+
+    c = config
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    pp = mesh.shape["pp"]
+    if c.n_layers % pp != 0:
+        raise ValueError(f"n_layers {c.n_layers} % pp {pp} != 0")
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta,
+                                jnp.float32)
+
+    def attend(xq, xk, xv):
+        return attention(xq, xk, xv, causal=True, impl=attn_impl)
+
+    def no_constrain(x, _dims):
+        return x
+
+    def stage_fn(stage_layers, mx):
+        def body(h, layer):
+            h, _ = apply_block(layer, h, c, cos, sin, None, attend,
+                               no_constrain)
+            return h, None
+
+        out, _ = lax.scan(body, mx, stage_layers)
+        return out
+
+    x = params["embed"][inputs].astype(c.dtype)          # (b, s, d)
+    b = x.shape[0]
+    if b % num_microbatches != 0:
+        raise ValueError(
+            f"batch {b} % microbatches {num_microbatches} != 0")
+    micro = x.reshape(num_microbatches, b // num_microbatches,
+                     *x.shape[1:])
+    stacked = jax.tree.map(
+        lambda p: p.reshape(pp, c.n_layers // pp, *p.shape[1:]),
+        params["layers"])
+    y = gpipe(stage_fn, stacked, micro, mesh=mesh)
+    x = y.reshape(b, *y.shape[2:])
+    x = rmsnorm(x, params["norm_f"], c.norm_eps)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+    import optax  # noqa: PLC0415
+
+    return jnp.mean(
+        optax.softmax_cross_entropy_with_integer_labels(logits, targets))
 
 
 def flops_per_token(config: LlamaConfig, seq_len: int) -> float:

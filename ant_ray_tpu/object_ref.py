@@ -92,3 +92,61 @@ def set_refcount_hook(hook) -> None:
     """Installed by the core runtime to observe ref creation/destruction."""
     global _refcount_hook
     _refcount_hook = hook if hook is not None else _noop_hook
+
+
+class ObjectRefGenerator:
+    """Stream of ObjectRefs from a ``num_returns="streaming"`` task
+    (ref: ObjectRefStream, src/ray/core_worker/task_manager.h:67 and the
+    ObjectRefGenerator surface in python/ray/_raylet.pyx).
+
+    Yields each return's ObjectRef AS IT IS PRODUCED by the still-running
+    task — the consumer can ``get()`` the first item long before the
+    producer finishes.  Iteration blocks on the next item; ``StopIteration``
+    once the producer signalled the end of the stream; a mid-stream task
+    failure raises at the failure point after all prior items."""
+
+    def __init__(self, task_id, runtime):
+        self._task_id = task_id
+        self._runtime = runtime
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        ref = self._runtime.stream_next(self._task_id, self._index, None)
+        if ref is None:
+            raise StopIteration
+        self._index += 1
+        return ref
+
+    def next_with_timeout(self, timeout: float | None):
+        """Like next() but bounded; raises GetTimeoutError on deadline."""
+        ref = self._runtime.stream_next(self._task_id, self._index,
+                                        timeout)
+        if ref is None:
+            raise StopIteration
+        self._index += 1
+        return ref
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio  # noqa: PLC0415
+
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(None, self.__next__)
+        except StopIteration:
+            raise StopAsyncIteration from None
+
+    @property
+    def task_id(self):
+        return self._task_id
+
+    def __del__(self):
+        try:
+            self._runtime.release_stream(self._task_id, self._index)
+        except Exception:  # noqa: BLE001 — interpreter shutdown etc.
+            pass

@@ -2,18 +2,24 @@
 reconciliation, deployment handles, HTTP ingress)."""
 
 from ant_ray_tpu.serve.api import (
+    CONTROLLER_NAME,
     Application,
+    AutoscalingConfig,
     Deployment,
     DeploymentHandle,
+    batch,
     deployment,
     run,
     shutdown,
 )
 
 __all__ = [
+    "CONTROLLER_NAME",
     "Application",
+    "AutoscalingConfig",
     "Deployment",
     "DeploymentHandle",
+    "batch",
     "deployment",
     "run",
     "shutdown",

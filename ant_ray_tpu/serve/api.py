@@ -3,15 +3,21 @@
 Scaled-down mirror of the reference architecture (SURVEY §2.4 Serve /
 §3.6): ``serve.run`` starts a named **controller actor** that reconciles
 desired deployment state into **replica actors**; **handles** route calls
-to replicas (round-robin with pending-count preference — the seed of
-power-of-two-choices, ref: serve/_private/router.py:472); an optional
-aiohttp **proxy actor** exposes deployments over HTTP
-(ref: serve/_private/proxy.py).
+to replicas with power-of-two-choices over reported queue depths
+(ref: serve/_private/router.py:472); an optional aiohttp **proxy actor**
+exposes deployments over HTTP (ref: serve/_private/proxy.py).  Replicas
+report ongoing-request counts, which also drive **queue-based
+autoscaling** (ref: serve/_private/autoscaling_state.py), and
+``@serve.batch`` coalesces concurrent calls into one model invocation
+(ref: serve/batching.py).
 """
 
 from __future__ import annotations
 
 import itertools
+import random
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -26,6 +32,21 @@ def _art():
 
 # ---------------------------------------------------------------- public
 
+@dataclass(frozen=True)
+class AutoscalingConfig:
+    """Queue-depth-driven replica scaling
+    (ref: serve/_private/autoscaling_state.py + AutoscalingConfig)."""
+
+    min_replicas: int = 1
+    max_replicas: int = 4
+    target_ongoing_requests: float = 2.0
+    # Seconds between controller scaling decisions.
+    interval_s: float = 0.5
+    # Consecutive low-load intervals required before scaling down
+    # (downscale damping, ref: downscale_delay_s).
+    downscale_patience: int = 4
+
+
 @dataclass
 class Deployment:
     cls_or_fn: Any
@@ -35,13 +56,18 @@ class Deployment:
     ray_actor_options: dict = field(default_factory=dict)
     init_args: tuple = ()
     init_kwargs: dict = field(default_factory=dict)
+    autoscaling_config: AutoscalingConfig | None = None
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)
 
     def options(self, *, num_replicas: int | None = None,
                 route_prefix: str | None = None,
-                name: str | None = None) -> "Deployment":
+                name: str | None = None,
+                autoscaling_config: AutoscalingConfig | dict | None = None,
+                ) -> "Deployment":
+        if isinstance(autoscaling_config, dict):
+            autoscaling_config = AutoscalingConfig(**autoscaling_config)
         return Deployment(
             cls_or_fn=self.cls_or_fn,
             name=name or self.name,
@@ -51,6 +77,8 @@ class Deployment:
             ray_actor_options=dict(self.ray_actor_options),
             init_args=self.init_args,
             init_kwargs=dict(self.init_kwargs),
+            autoscaling_config=(autoscaling_config
+                                or self.autoscaling_config),
         )
 
 
@@ -63,8 +91,11 @@ class Application:
 
 def deployment(_cls=None, *, name: str | None = None, num_replicas: int = 1,
                route_prefix: str | None = None,
-               ray_actor_options: dict | None = None):
+               ray_actor_options: dict | None = None,
+               autoscaling_config: AutoscalingConfig | dict | None = None):
     """``@serve.deployment`` decorator (ref: serve/api.py)."""
+    if isinstance(autoscaling_config, dict):
+        autoscaling_config = AutoscalingConfig(**autoscaling_config)
 
     def wrap(cls_or_fn):
         return Deployment(
@@ -73,6 +104,7 @@ def deployment(_cls=None, *, name: str | None = None, num_replicas: int = 1,
             num_replicas=num_replicas,
             route_prefix=route_prefix,
             ray_actor_options=dict(ray_actor_options or {}),
+            autoscaling_config=autoscaling_config,
         )
 
     if _cls is not None:
@@ -80,29 +112,176 @@ def deployment(_cls=None, *, name: str | None = None, num_replicas: int = 1,
     return wrap
 
 
+def batch(_fn=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """``@serve.batch``: coalesce concurrent single-item calls into one
+    list call (ref: serve/batching.py).  The wrapped method must accept a
+    LIST of items and return a LIST of results, one per item; callers
+    still call it with a single item.  Requires the deployment to run
+    with ``ray_actor_options={"max_concurrency": N}`` so calls can
+    overlap inside the replica."""
+
+    def wrap(fn):
+        # Batch state lives on the INSTANCE (created lazily on first
+        # call): a closure-level Lock would make the deployment class
+        # unpicklable for shipping to replica workers.
+        state_attr = f"_art_batch_state_{fn.__name__}"
+
+        def get_state(self_obj):
+            state = getattr(self_obj, state_attr, None)
+            if state is None:
+                state = self_obj.__dict__.setdefault(
+                    state_attr, {"lock": threading.Lock(), "items": []})
+            return state
+
+        def flush(self_obj, my_batch):
+            items = [it for it, _ in my_batch]
+            try:
+                results = fn(self_obj, items)
+                if len(results) != len(items):
+                    raise ValueError(
+                        f"@serve.batch function returned {len(results)} "
+                        f"results for {len(items)} items")
+            except Exception as e:  # noqa: BLE001 — fan the error out
+                results = [e] * len(items)
+            for (_, slot), result in zip(my_batch, results):
+                slot["result"] = result
+                slot["event"].set()
+
+        def wrapper(self_obj, item):
+            state = get_state(self_obj)
+            lock = state["lock"]
+            slot = {"event": threading.Event(), "result": None}
+            with lock:
+                state["items"].append((item, slot))
+                is_flusher = len(state["items"]) == 1
+            if is_flusher:
+                deadline = time.monotonic() + batch_wait_timeout_s
+                while time.monotonic() < deadline:
+                    with lock:
+                        if len(state["items"]) >= max_batch_size:
+                            break
+                    time.sleep(batch_wait_timeout_s / 10)
+                # Drain in ≤max_batch_size chunks until empty: the model
+                # never sees an oversized batch, and late arrivals that
+                # saw a non-empty queue (so didn't become flushers) are
+                # never stranded.
+                while True:
+                    with lock:
+                        my_batch = state["items"][:max_batch_size]
+                        state["items"] = state["items"][max_batch_size:]
+                    if not my_batch:
+                        break
+                    flush(self_obj, my_batch)
+            # Non-flushers wait for their batch-mate to flush; the
+            # flusher's own event was set inside flush().
+            slot["event"].wait()
+            if isinstance(slot["result"], Exception):
+                raise slot["result"]
+            return slot["result"]
+
+        wrapper.__name__ = fn.__name__
+        wrapper.__wrapped__ = fn
+        wrapper.__art_serve_batch__ = (max_batch_size,
+                                       batch_wait_timeout_s)
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
 class DeploymentHandle:
-    """Client handle routing calls across a deployment's replicas."""
+    """Client handle routing calls across a deployment's replicas with
+    power-of-two-choices over reported queue depths
+    (ref: PowerOfTwoChoicesRequestRouter, serve/_private/router.py:472).
+
+    With a controller reference the handle refreshes its replica set and
+    queue snapshot on a short TTL, so it follows autoscaling."""
+
+    _REFRESH_TTL_S = 1.0
 
     def __init__(self, deployment_name: str, replicas: list,
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__", stream: bool = False,
+                 controller=None):
         self._name = deployment_name
         self._replicas = list(replicas)
         self._method = method_name
+        self._stream = stream
+        self._controller = controller
         self._rr = itertools.count()
+        self._ongoing: list = [0] * len(self._replicas)
+        self._local_extra: dict[int, int] = {}
+        self._last_refresh = time.monotonic()
+        self._lock = threading.Lock()
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self._name, self._replicas, method_name)
+    def options(self, method_name: str | None = None,
+                stream: bool | None = None) -> "DeploymentHandle":
+        """``stream=True``: remote() returns an ObjectRefGenerator whose
+        refs arrive as the replica's generator produces them
+        (ref: handle.options(stream=True))."""
+        return DeploymentHandle(
+            self._name, self._replicas,
+            method_name if method_name is not None else self._method,
+            self._stream if stream is None else stream,
+            self._controller)
+
+    def _maybe_refresh(self):
+        if self._controller is None:
+            return
+        now = time.monotonic()
+        if now - self._last_refresh < self._REFRESH_TTL_S:
+            return
+        try:
+            info = _art().get(
+                self._controller.get_handle_info.remote(self._name))
+        except Exception:  # noqa: BLE001 — keep the cached set
+            return
+        if info:
+            with self._lock:
+                self._replicas = list(info["replicas"])
+                self._ongoing = list(info.get("ongoing",
+                                              [0] * len(self._replicas)))
+                self._local_extra = {}
+                self._last_refresh = now
+
+    def _pick(self) -> int:
+        """Two random candidates, route to the shorter queue (cached
+        depth + dispatches this handle made since the last refresh)."""
+        with self._lock:
+            n = len(self._replicas)
+            if n == 0:
+                raise RuntimeError(
+                    f"deployment {self._name} has no replicas")
+            if n == 1:
+                index = 0
+            else:
+                i, j = random.sample(range(n), 2)
+
+                def load(k):
+                    depth = (self._ongoing[k]
+                             if k < len(self._ongoing) else 0)
+                    return depth + self._local_extra.get(k, 0)
+
+                index = i if load(i) <= load(j) else j
+            self._local_extra[index] = \
+                self._local_extra.get(index, 0) + 1
+            return index
 
     def remote(self, *args, **kwargs):
-        if not self._replicas:
-            raise RuntimeError(f"deployment {self._name} has no replicas")
-        index = next(self._rr) % len(self._replicas)
-        replica = self._replicas[index]
+        self._maybe_refresh()
+        index = self._pick()
+        with self._lock:
+            replica = self._replicas[index]
+        if self._stream:
+            return replica.handle_request_streaming.remote(
+                self._method, args, kwargs)
         return replica.handle_request.remote(self._method, args, kwargs)
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self._name, self._replicas, self._method))
+                (self._name, self._replicas, self._method, self._stream,
+                 self._controller))
 
 
 # ---------------------------------------------------------------- actors
@@ -116,28 +295,88 @@ class Replica:
             self._instance = cls_or_fn(*args, **kwargs)
         else:
             self._instance = cls_or_fn  # plain function deployment
+        self._ongoing = 0
+        self._ongoing_lock = threading.Lock()
 
-    def handle_request(self, method_name: str, args, kwargs):
+    def _invoke(self, method_name: str, args, kwargs):
         if method_name == "__call__":
             return self._instance(*args, **kwargs)
         return getattr(self._instance, method_name)(*args, **kwargs)
+
+    def handle_request(self, method_name: str, args, kwargs):
+        with self._ongoing_lock:
+            self._ongoing += 1
+        try:
+            return self._invoke(method_name, args, kwargs)
+        finally:
+            with self._ongoing_lock:
+                self._ongoing -= 1
+
+    def handle_request_streaming(self, method_name: str, args, kwargs):
+        """Streaming dispatch: the target method must return a generator;
+        its items flow back as a streaming actor call.  The ongoing
+        count covers the WHOLE stream — a replica mid-generation must
+        look busy to routing and must not be an autoscaler down-scale
+        victim."""
+        with self._ongoing_lock:
+            self._ongoing += 1
+        try:
+            yield from self._invoke(method_name, args, kwargs)
+        finally:
+            with self._ongoing_lock:
+                self._ongoing -= 1
+
+    def ongoing(self) -> int:
+        """Queue-depth metric feeding autoscaling and po2 routing
+        (ref: replica queue-length metrics, autoscaling_state.py)."""
+        return self._ongoing
 
     def health(self):
         return "ok"
 
 
+# Streaming marker on the dispatch method (equivalent of decorating with
+# @art.method(num_returns="streaming") without importing art at module
+# import time).
+Replica.handle_request_streaming.__art_num_returns__ = "streaming"
+
+
 class ServeController:
-    """Reconciles deployments → replica actors
-    (ref: serve/_private/controller.py:105)."""
+    """Reconciles deployments → replica actors; a background thread polls
+    replica queue depths and drives queue-based autoscaling
+    (ref: serve/_private/controller.py:105 + autoscaling_state.py)."""
 
     def __init__(self):
         self._deployments: dict[str, dict] = {}
         self._proxy = None
+        self._lock = threading.Lock()
+        self._stopping = False
+        self._scaler = threading.Thread(
+            target=self._scale_loop, daemon=True, name="serve-scaler")
+        self._scaler.start()
+
+    def _make_replicas(self, deployment: Deployment, args, kwargs, n: int):
+        art = _art()
+        # Default is SERIALIZED user code (max_concurrency=1, matching
+        # plain actors).  Autoscaling needs overlapping requests for a
+        # meaningful queue-depth signal, so it defaults to 8 — like the
+        # reference's max_ongoing_requests > 1, replica code must then
+        # be thread-safe.  @serve.batch also requires an explicit
+        # max_concurrency.
+        default_conc = 8 if deployment.autoscaling_config is not None else 1
+        replica_cls = art.remote(Replica).options(
+            **{"num_cpus": deployment.ray_actor_options.get("num_cpus", 0),
+               "max_concurrency": deployment.ray_actor_options.get(
+                   "max_concurrency", default_conc)})
+        replicas = [
+            replica_cls.remote(deployment.cls_or_fn, args, kwargs)
+            for _ in range(n)
+        ]
+        art.get([r.health.remote() for r in replicas])  # readiness gate
+        return replicas
 
     def deploy(self, deployment: Deployment, args, kwargs) -> dict:
         art = _art()
-        replica_cls = art.remote(Replica).options(
-            **{"num_cpus": deployment.ray_actor_options.get("num_cpus", 0)})
         existing = self._deployments.get(deployment.name)
         if existing is not None:
             for r in existing["replicas"]:
@@ -145,23 +384,146 @@ class ServeController:
                     art.kill(r)
                 except Exception:  # noqa: BLE001
                     pass
-        replicas = [
-            replica_cls.remote(deployment.cls_or_fn, args, kwargs)
-            for _ in range(deployment.num_replicas)
-        ]
-        art.get([r.health.remote() for r in replicas])  # readiness gate
-        self._deployments[deployment.name] = {
-            "deployment": deployment,
-            "replicas": replicas,
-            "route_prefix": deployment.route_prefix,
-        }
+        n = deployment.num_replicas
+        if deployment.autoscaling_config is not None:
+            n = deployment.autoscaling_config.min_replicas
+        replicas = self._make_replicas(deployment, args, kwargs, n)
+        with self._lock:
+            self._deployments[deployment.name] = {
+                "deployment": deployment,
+                "args": args,
+                "kwargs": kwargs,
+                "replicas": replicas,
+                "route_prefix": deployment.route_prefix,
+                "ongoing": [0] * len(replicas),
+                "low_streak": 0,
+            }
         return {"name": deployment.name}
 
     def get_handle_info(self, name: str):
-        entry = self._deployments.get(name)
-        if entry is None:
-            return None
-        return {"replicas": entry["replicas"]}
+        with self._lock:
+            entry = self._deployments.get(name)
+            if entry is None:
+                return None
+            return {"replicas": list(entry["replicas"]),
+                    "ongoing": list(entry["ongoing"])}
+
+    # ------------------------------------------------------ autoscaling
+
+    def _scale_loop(self):
+        import math  # noqa: PLC0415
+
+        art = _art()
+        while not self._stopping:
+            time.sleep(0.25)
+            with self._lock:
+                names = list(self._deployments)
+            for name in names:
+                with self._lock:
+                    entry = self._deployments.get(name)
+                    if entry is None:
+                        continue
+                    replicas = list(entry["replicas"])
+                    cfg = entry["deployment"].autoscaling_config
+                try:
+                    counts = art.get(
+                        [r.ongoing.remote() for r in replicas], timeout=5)
+                except Exception:  # noqa: BLE001 — replicas mid-change
+                    continue
+                with self._lock:
+                    entry = self._deployments.get(name)
+                    if entry is None or entry["replicas"] != replicas:
+                        continue
+                    entry["ongoing"] = counts
+                if cfg is None:
+                    continue
+                with self._lock:
+                    entry = self._deployments.get(name)
+                    if entry is None:
+                        continue
+                    # Queue depths refresh every poll; scaling DECISIONS
+                    # honour the config's cadence.
+                    last = entry.get("last_decision", 0.0)
+                    if time.monotonic() - last < cfg.interval_s:
+                        continue
+                    entry["last_decision"] = time.monotonic()
+                desired = math.ceil(
+                    sum(counts) / max(cfg.target_ongoing_requests, 1e-9))
+                desired = max(cfg.min_replicas,
+                              min(cfg.max_replicas, desired))
+                if desired > len(replicas):
+                    self._scale_up(name, desired - len(replicas))
+                elif desired < len(replicas):
+                    with self._lock:
+                        entry = self._deployments.get(name)
+                        if entry is None:
+                            continue
+                        entry["low_streak"] += 1
+                        trigger = entry["low_streak"] >= \
+                            cfg.downscale_patience
+                    if trigger:
+                        self._scale_down(name, len(replicas) - desired)
+                else:
+                    with self._lock:
+                        entry = self._deployments.get(name)
+                        if entry is not None:
+                            entry["low_streak"] = 0
+
+    def _scale_up(self, name: str, count: int):
+        with self._lock:
+            entry = self._deployments.get(name)
+            if entry is None:
+                return
+            deployment, args, kwargs = (entry["deployment"],
+                                        entry["args"], entry["kwargs"])
+        try:
+            new = self._make_replicas(deployment, args, kwargs, count)
+        except Exception:  # noqa: BLE001 — cluster may lack resources
+            return
+        with self._lock:
+            entry = self._deployments.get(name)
+            if entry is None:
+                return
+            entry["replicas"] = entry["replicas"] + new
+            entry["ongoing"] = entry["ongoing"] + [0] * len(new)
+            entry["low_streak"] = 0
+
+    def _scale_down(self, name: str, count: int):
+        doomed = []
+        with self._lock:
+            entry = self._deployments.get(name)
+            if entry is None:
+                return
+            # Prefer idle replicas, scanning from the tail.
+            for index in reversed(range(len(entry["replicas"]))):
+                if len(doomed) == count:
+                    break
+                if entry["ongoing"][index] == 0:
+                    doomed.append(entry["replicas"].pop(index))
+                    entry["ongoing"].pop(index)
+            entry["low_streak"] = 0
+        for replica in doomed:
+            # Drain before killing: client handles cache the replica set
+            # for up to the refresh TTL, so an immediate kill would turn
+            # in-flight/imminent requests into ActorDiedErrors.
+            threading.Thread(target=self._drain_then_kill,
+                             args=(replica,), daemon=True).start()
+
+    def _drain_then_kill(self, replica):
+        art = _art()
+        time.sleep(DeploymentHandle._REFRESH_TTL_S * 2 + 0.5)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            try:
+                if art.get(replica.ongoing.remote(), timeout=5) == 0:
+                    break
+            except Exception:  # noqa: BLE001 — already gone
+                break
+            time.sleep(0.5)
+        try:
+            art.kill(replica)
+        except Exception:  # noqa: BLE001
+            pass
 
     def list_deployments(self):
         return {
@@ -224,24 +586,73 @@ class HttpProxy:
         art = _art()
         loop = asyncio.new_event_loop()
 
-        def dispatch(path: str, body):
-            """Blocking route+call (runs on an executor thread so the
-            aiohttp loop stays free)."""
+        def resolve_handle(path: str) -> "DeploymentHandle | None":
             routes = art.get(self._controller.routes.remote())
             for prefix, name in routes.items():
                 if path.startswith(prefix):
                     info = art.get(
                         self._controller.get_handle_info.remote(name))
-                    handle = DeploymentHandle(name, info["replicas"])
-                    return {"result": art.get(handle.remote(body))}, 200
-            return {"error": f"no route for {path}"}, 404
+                    return DeploymentHandle(name, info["replicas"],
+                                            controller=self._controller)
+            return None
+
+        def dispatch(path: str, body):
+            """Blocking route+call (runs on an executor thread so the
+            aiohttp loop stays free)."""
+            handle = resolve_handle(path)
+            if handle is None:
+                return {"error": f"no route for {path}"}, 404
+            return {"result": art.get(handle.remote(body))}, 200
+
+        def stream_start(path: str, body):
+            """Start a streaming call; returns the ObjectRefGenerator
+            (convention: ``{"stream": true}`` requests dispatch to the
+            deployment's ``stream`` method as a generator)."""
+            handle = resolve_handle(path)
+            if handle is None:
+                return None
+            return handle.options(method_name="stream",
+                                  stream=True).remote(body)
+
+        def next_chunk(gen):
+            try:
+                ref = next(gen)
+            except StopIteration:
+                return None
+            return art.get(ref)
 
         async def handler(request: "web.Request"):
+            import json as _json  # noqa: PLC0415
+
             try:
                 body = await request.json() if request.can_read_body else {}
             except Exception:  # noqa: BLE001
                 body = {}
             loop_ = asyncio.get_running_loop()
+            if isinstance(body, dict) and body.get("stream"):
+                # Server-sent events: one `data:` frame per produced
+                # chunk, flowing while the model still generates
+                # (ref: serve streaming HTTP responses).
+                gen = await loop_.run_in_executor(
+                    None, stream_start, request.path, body)
+                if gen is None:
+                    return web.json_response(
+                        {"error": f"no route for {request.path}"},
+                        status=404)
+                resp = web.StreamResponse(
+                    headers={"Content-Type": "text/event-stream",
+                             "Cache-Control": "no-cache"})
+                await resp.prepare(request)
+                while True:
+                    chunk = await loop_.run_in_executor(
+                        None, next_chunk, gen)
+                    if chunk is None:
+                        break
+                    await resp.write(
+                        b"data: " + _json.dumps(chunk).encode() + b"\n\n")
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+                return resp
             payload, status = await loop_.run_in_executor(
                 None, dispatch, request.path, body)
             return web.json_response(payload, status=status)
@@ -294,7 +705,10 @@ def run(app: Application, *, port: int | None = None) -> DeploymentHandle:
         run.last_http_port = actual  # discoverable for tests/clients
     info = art.get(
         controller.get_handle_info.remote(app.deployment.name))
-    return DeploymentHandle(app.deployment.name, info["replicas"])
+    # The controller reference lets the handle refresh its replica set
+    # (autoscaling) and queue snapshot (po2 routing) on a TTL.
+    return DeploymentHandle(app.deployment.name, info["replicas"],
+                            controller=controller)
 
 
 run.last_http_port = None

@@ -20,6 +20,7 @@ class ScalingConfig:
     num_workers: int = 1
     use_tpu: bool = False
     topology: str = ""                  # e.g. "4x8" (whole-slice reservation)
+    accelerator_type: str = "TPU-V5E"   # generation for slice math
     chips_per_worker: int = 0           # TPU chips each worker binds (0=all)
     resources_per_worker: dict = dataclasses.field(default_factory=dict)
     placement_strategy: str = "PACK"

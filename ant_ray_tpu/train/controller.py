@@ -150,28 +150,42 @@ class TrainController:
         from ant_ray_tpu.api import remote  # noqa: PLC0415
 
         scaling = self._scaling
-        worker_cls = remote(TrainWorker).options(
-            **{"resources": scaling.worker_resources(),
-               "num_cpus": 0})
-        workers = [
-            worker_cls.remote(rank, scaling.num_workers,
-                              self._storage_path,
-                              self._run_config.name or "run",
-                              scaling.use_tpu)
-            for rank in range(scaling.num_workers)
-        ]
-        # Rendezvous: rank 0's host coordinates (multi-host slices).
-        coordinator = None
-        if scaling.use_tpu and scaling.num_workers > 1:
-            coordinator = art.get(workers[0].propose_coordinator.remote())
-        art.get([w.setup_distributed.remote(coordinator) for w in workers])
-        latest = self._ckpt_manager.latest
-        run_refs = [
-            w.run.remote(self._loop_fn, self._loop_config, self_handle,
-                         latest)
-            for w in workers
-        ]
+        pg, slice_pg = self._reserve_gang(scaling)
+        self._worker_pg = pg          # set BEFORE anything can fail, so
+        self._worker_slice = slice_pg  # the finally always releases it
+        workers = []
         try:
+            base_opts = {"resources": scaling.worker_resources(),
+                         "num_cpus": 0}
+            worker_cls = remote(TrainWorker)
+            workers = [
+                worker_cls.options(
+                    **base_opts,
+                    placement_group=pg,
+                    # Rank r on bundle r: with a slice PG this pins rank
+                    # r to the slice host with tpu-worker-id == r (ICI
+                    # layout).
+                    placement_group_bundle_index=(
+                        rank if pg is not None else -1),
+                ).remote(rank, scaling.num_workers,
+                         self._storage_path,
+                         self._run_config.name or "run",
+                         scaling.use_tpu)
+                for rank in range(scaling.num_workers)
+            ]
+            # Rendezvous: rank 0's host coordinates (multi-host slices).
+            coordinator = None
+            if scaling.use_tpu and scaling.num_workers > 1:
+                coordinator = art.get(
+                    workers[0].propose_coordinator.remote())
+            art.get([w.setup_distributed.remote(coordinator)
+                     for w in workers])
+            latest = self._ckpt_manager.latest
+            run_refs = [
+                w.run.remote(self._loop_fn, self._loop_config,
+                             self_handle, latest)
+                for w in workers
+            ]
             art.get(run_refs)
         finally:
             for w in workers:
@@ -179,6 +193,68 @@ class TrainController:
                     art.kill(w)
                 except Exception:  # noqa: BLE001
                     pass
+            self._release_gang()
+
+    def _reserve_gang(self, scaling):
+        """Gang-reserve the worker group's resources before spawning any
+        rank (ref: WorkerGroup placement-group creation,
+        worker_group.py:269).  TPU + topology ⇒ reserve a whole slice
+        (slice_placement_group); otherwise a plain PG with the scaling
+        config's strategy.  Single local worker ⇒ no PG (keeps the
+        laptop path free of reservation latency)."""
+        if scaling.use_tpu and scaling.topology:
+            from ant_ray_tpu.util.tpu import slice_placement_group  # noqa: PLC0415
+
+            # Bundles must cover everything a rank actor demands — the
+            # chips AND its CPU share — or the bundle lease rejects it.
+            extra = {k: v for k, v in scaling.worker_resources().items()
+                     if k != "TPU"}
+            slice_pg = slice_placement_group(
+                scaling.topology, scaling.accelerator_type,
+                name=f"train-{self._run_config.name or 'run'}",
+                bundle_extra=extra)
+            if scaling.num_workers != slice_pg.num_hosts:
+                slice_pg.remove()
+                raise ValueError(
+                    f"num_workers={scaling.num_workers} does not match "
+                    f"the {slice_pg.num_hosts} hosts of slice "
+                    f"{scaling.topology}")
+            if not slice_pg.ready(timeout=120):
+                slice_pg.remove()
+                raise RuntimeError(
+                    f"could not reserve TPU slice {scaling.topology}")
+            return slice_pg.placement_group, slice_pg
+        if scaling.num_workers <= 1:
+            return None, None
+        from ant_ray_tpu.util.placement_group import placement_group  # noqa: PLC0415
+
+        pg = placement_group(
+            [scaling.worker_resources()
+             for _ in range(scaling.num_workers)],
+            strategy=scaling.placement_strategy,
+            name=f"train-{self._run_config.name or 'run'}")
+        if not pg.ready(timeout=120):
+            from ant_ray_tpu.util.placement_group import (  # noqa: PLC0415
+                remove_placement_group,
+            )
+
+            remove_placement_group(pg)  # don't leak a PENDING reservation
+            raise RuntimeError("could not reserve training worker group")
+        return pg, None
+
+    def _release_gang(self):
+        pg = getattr(self, "_worker_pg", None)
+        self._worker_pg = None
+        self._worker_slice = None
+        if pg is not None:
+            from ant_ray_tpu.util.placement_group import (  # noqa: PLC0415
+                remove_placement_group,
+            )
+
+            try:
+                remove_placement_group(pg)
+            except Exception:  # noqa: BLE001 — release is best-effort
+                pass
 
     def _result(self, error):
         from ant_ray_tpu.train.config import Result  # noqa: PLC0415

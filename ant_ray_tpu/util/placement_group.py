@@ -59,7 +59,14 @@ class PlacementGroup:
 
 
 def placement_group(bundles: list[dict], strategy: str = "PACK",
-                    name: str = "") -> PlacementGroup:
+                    name: str = "",
+                    bundle_label_selectors: list[dict] | None = None,
+                    _same_label: str | None = None) -> PlacementGroup:
+    """``bundle_label_selectors``: optional per-bundle node-label
+    constraints (ref: bundle_label_selector in reserve_tpu_slice,
+    python/ray/_private/accelerators/tpu.py:213).  ``_same_label``: a
+    label key whose value must be shared by every bundle's node — the
+    slice-affinity primitive behind slice_placement_group()."""
     from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
 
     if strategy not in VALID_STRATEGIES:
@@ -67,6 +74,9 @@ def placement_group(bundles: list[dict], strategy: str = "PACK",
             f"strategy must be one of {VALID_STRATEGIES}, got {strategy!r}")
     if not bundles or any(not b for b in bundles):
         raise ValueError("bundles must be non-empty resource dicts")
+    if bundle_label_selectors is not None and \
+            len(bundle_label_selectors) != len(bundles):
+        raise ValueError("bundle_label_selectors must match bundles 1:1")
     global_worker._check_connected()
     runtime = global_worker.runtime
     pg_id = PlacementGroupID.of(runtime.job_id)
@@ -76,6 +86,8 @@ def placement_group(bundles: list[dict], strategy: str = "PACK",
         "strategy": strategy,
         "name": name,
         "job_id": runtime.job_id,  # VC-aware bundle placement
+        "bundle_label_selectors": bundle_label_selectors,
+        "same_label": _same_label,
     }, retries=3)
     return PlacementGroup(pg_id, tuple(tuple(sorted(b.items()))
                                        for b in bundles), strategy)

@@ -186,3 +186,13 @@ def test_mixed_jax_numpy_serialization():
     out = serialization.deserialize(serialization.serialize(value))
     np.testing.assert_array_equal(np.asarray(out[0]), np.arange(4))
     np.testing.assert_array_equal(out[1], np.arange(1000))
+
+
+def test_stream_local_mode(local_mode):
+    """num_returns="streaming" works in local mode (eager, same surface)."""
+    @art.remote(num_returns="streaming")
+    def produce():
+        yield 1
+        yield 2
+
+    assert [art.get(r) for r in produce.remote()] == [1, 2]

@@ -80,6 +80,47 @@ def test_gcs_restart_resync(ft_cluster):
     assert art.get(probe.remote(), timeout=60) == "ok"
 
 
+def test_actor_death_during_head_downtime(ft_cluster):
+    """An actor worker that dies while the head is down must not be
+    restored as ALIVE forever: the daemon retries its WorkerDied report
+    until the restarted head accepts it (restart machinery then runs)."""
+    @art.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.n = 0
+
+        def incr(self):
+            self.n += 1
+            return self.n
+
+        def pid(self):
+            import os
+            return os.getpid()
+
+    p = Phoenix.remote()
+    assert art.get(p.incr.remote()) == 1
+    pid = art.get(p.pid.remote())
+
+    ft_cluster.kill_gcs()
+    import os as _os
+    import signal as _signal
+    _os.kill(pid, _signal.SIGKILL)  # actor dies while head is down
+    time.sleep(1.0)
+    ft_cluster.restart_gcs()
+
+    # The daemon's retried death report reaches the new head; the actor
+    # restarts (max_restarts=1) and is callable again with fresh state.
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        try:
+            assert art.get(p.incr.remote(), timeout=20) == 1
+            break
+        except Exception:  # noqa: BLE001 — restart in progress
+            time.sleep(0.5)
+    else:
+        raise AssertionError("actor never restarted after head downtime")
+
+
 def test_new_actors_schedulable_after_restart(ft_cluster):
     ft_cluster.kill_gcs()
     ft_cluster.restart_gcs()

@@ -114,3 +114,71 @@ def test_greedy_generate(tiny_params):
     out = llama.greedy_generate(tiny_params, CFG, jnp.arange(8),
                                 max_new_tokens=4)
     assert out.shape == (1, 12)
+
+
+MOE_CFG = llama.CONFIGS["moe-tiny"]
+
+
+@pytest.fixture(scope="module")
+def moe_params():
+    return llama.init_params(MOE_CFG, jax.random.PRNGKey(1))
+
+
+def test_moe_forward_and_grad(moe_params):
+    tokens = _tokens()
+    logits = llama.forward(moe_params, tokens, MOE_CFG)
+    assert logits.shape == (2, 64, MOE_CFG.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    batch = {"tokens": _tokens(2, 65)}
+    loss, grads = jax.value_and_grad(llama.loss_fn)(
+        moe_params, batch, MOE_CFG)
+    assert np.isfinite(float(loss))
+    flat = jax.tree.leaves(grads)
+    assert all(np.isfinite(np.asarray(g)).all() for g in flat)
+    # The router actually routes: gradients reach the router weights.
+    assert float(jnp.abs(grads["layers"]["router"]).sum()) > 0
+
+
+def test_moe_expert_sharded_matches_unsharded(moe_params):
+    """Expert-parallel (ep) sharded forward equals the base — the ep
+    axis is real, not decorative."""
+    mesh = build_mesh(MeshConfig(ep=2, tp=2, dp=-1))
+    sharded_params = jax.device_put(
+        moe_params, llama.param_shardings(MOE_CFG, mesh))
+    tokens = _tokens()
+    base = llama.forward(moe_params, tokens, MOE_CFG)
+    sharded = jax.jit(
+        lambda p, t: llama.forward(p, t, MOE_CFG, mesh=mesh))(
+            sharded_params, tokens)
+    np.testing.assert_allclose(np.asarray(base), np.asarray(sharded),
+                               atol=2e-4, rtol=2e-4)
+
+
+def test_pp_loss_matches_dense_loss(tiny_params):
+    """The GPipe pipeline loss (pp axis) equals the plain scan loss —
+    microbatching and stage hops change nothing numerically."""
+    mesh = build_mesh(MeshConfig(pp=2, tp=2, dp=-1))
+    sharded_params = jax.device_put(
+        tiny_params, llama.param_shardings(CFG, mesh))
+    batch = {"tokens": _tokens(4, 65)}
+    base = float(llama.loss_fn(tiny_params, batch, CFG))
+    pp = float(jax.jit(
+        lambda p, b: llama.loss_fn_pp(p, b, CFG, mesh=mesh,
+                                      num_microbatches=2))(
+            sharded_params, batch))
+    assert abs(base - pp) < 2e-4, (base, pp)
+
+
+def test_pp_grads_flow(tiny_params):
+    """Backward through the pipeline reaches every stage's params."""
+    mesh = build_mesh(MeshConfig(pp=2, tp=2, dp=-1))
+    sharded_params = jax.device_put(
+        tiny_params, llama.param_shardings(CFG, mesh))
+    batch = {"tokens": _tokens(4, 65)}
+    grads = jax.jit(jax.grad(
+        lambda p: llama.loss_fn_pp(p, batch, CFG, mesh=mesh,
+                                   num_microbatches=2)))(sharded_params)
+    for name in ("wq", "w_gate", "w_down"):
+        g = np.asarray(grads["layers"][name])
+        # Both layers (= both pipeline stages) receive gradient signal.
+        assert np.abs(g[0]).sum() > 0 and np.abs(g[1]).sum() > 0, name

@@ -123,3 +123,42 @@ def test_batch_inference(shutdown_only):
     out = processor(ds).take_all()
     assert len(out) == 6
     assert all("generated_text" in row for row in out)
+
+
+def test_llm_sse_token_streaming(shutdown_only):
+    """End-to-end token streaming: the SSE response yields its first
+    token chunk before generation finishes (ref: serve streaming path +
+    vllm streaming outputs)."""
+    import json
+    import urllib.request
+
+    art.init(num_cpus=2)
+    from ant_ray_tpu import serve
+    from ant_ray_tpu.llm.serve_llm import build_llm_deployment
+
+    app = build_llm_deployment("tiny", slots=2, max_seq=64)
+    serve.run(app, port=0)
+    port = serve.run.last_http_port
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps({"prompt": "hello", "max_tokens": 6,
+                         "stream": True}).encode(),
+        headers={"Content-Type": "application/json"})
+    chunks = []
+    with urllib.request.urlopen(req, timeout=180) as resp:
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        for raw in resp:
+            line = raw.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            payload = line[len("data: "):]
+            if payload == "[DONE]":
+                break
+            chunks.append(json.loads(payload))
+    # Token chunks then a final finish chunk.
+    assert chunks, "no SSE chunks received"
+    assert chunks[-1]["done"] is True
+    token_chunks = [c for c in chunks if not c["done"]]
+    assert 1 <= len(token_chunks) <= 6
+    assert all("text" in c["choices"][0] for c in token_chunks)
+    serve.shutdown()

@@ -100,3 +100,112 @@ def test_http_ingress(cluster):
     except urllib.error.HTTPError as e:
         raised = e.code == 404
     assert raised
+
+
+def test_streaming_handle(cluster):
+    """handle.options(stream=True) returns an ObjectRefGenerator fed by
+    the replica's generator method (ref: serve streaming handles)."""
+    from ant_ray_tpu import serve
+
+    @serve.deployment(name="streamer")
+    class Streamer:
+        def stream(self, request):
+            for i in range(int(request["n"])):
+                yield {"i": i}
+
+    handle = serve.run(Streamer.bind())
+    gen = handle.options(method_name="stream", stream=True).remote(
+        {"n": 4})
+    items = [art.get(ref, timeout=60) for ref in gen]
+    assert items == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
+    serve.shutdown()
+
+
+def test_batching_coalesces_requests(cluster):
+    """@serve.batch turns N concurrent single calls into few list calls
+    (ref: serve/batching.py)."""
+    from ant_ray_tpu import serve
+
+    @serve.deployment(name="batched",
+                      ray_actor_options={"max_concurrency": 16})
+    class Batched:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        def __call__(self, items):
+            self.batch_sizes.append(len(items))
+            return [x * 2 for x in items]
+
+        def sizes(self):
+            return self.batch_sizes
+
+    handle = serve.run(Batched.bind())
+    refs = [handle.remote(i) for i in range(8)]
+    assert sorted(art.get(refs, timeout=60)) == [i * 2 for i in range(8)]
+    sizes = art.get(handle.options(method_name="sizes").remote(),
+                    timeout=60)
+    # 8 concurrent requests must NOT take 8 model invocations.
+    assert sum(sizes) == 8
+    assert max(sizes) >= 2, sizes
+    serve.shutdown()
+
+
+def test_autoscaling_follows_load(cluster):
+    """Replica count rises under queued load and returns to min when
+    idle (ref: serve/_private/autoscaling_state.py)."""
+    import threading as _threading
+    import time as _time
+
+    from ant_ray_tpu import serve
+
+    @serve.deployment(name="scaly",
+                      autoscaling_config={"min_replicas": 1,
+                                          "max_replicas": 3,
+                                          "target_ongoing_requests": 1.0,
+                                          "downscale_patience": 2})
+    class Scaly:
+        def __call__(self, x):
+            _time.sleep(1.0)
+            return x
+
+    handle = serve.run(Scaly.bind())
+    assert serve_replica_count("scaly") == 1
+
+    # Offer sustained concurrent load for a few seconds.
+    stop = _time.monotonic() + 6
+    def pump():
+        while _time.monotonic() < stop:
+            try:
+                art.get(handle.remote(1), timeout=30)
+            except Exception:
+                return
+    threads = [_threading.Thread(target=pump) for _ in range(6)]
+    for t in threads:
+        t.start()
+    grown = 0
+    while _time.monotonic() < stop:
+        grown = max(grown, serve_replica_count("scaly"))
+        if grown >= 2:
+            break
+        _time.sleep(0.25)
+    for t in threads:
+        t.join()
+    assert grown >= 2, f"never scaled up (peak {grown})"
+
+    # Idle: back down to min.
+    deadline = _time.monotonic() + 20
+    while _time.monotonic() < deadline:
+        if serve_replica_count("scaly") == 1:
+            break
+        _time.sleep(0.5)
+    assert serve_replica_count("scaly") == 1
+    serve.shutdown()
+
+
+def serve_replica_count(name):
+    from ant_ray_tpu import serve as _serve
+
+    controller = art.get_actor(_serve.CONTROLLER_NAME, namespace="_serve")
+    info = art.get(controller.list_deployments.remote())
+    return info[name]["num_replicas"]
