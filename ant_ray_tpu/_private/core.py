@@ -46,6 +46,7 @@ from ant_ray_tpu._private.protocol import (
 from ant_ray_tpu._private.specs import (
     ACTOR_ALIVE,
     ACTOR_DEAD,
+    ACTOR_RESTARTING,
     ActorSpec,
     PromotedArgs,
     TaskSpec,
@@ -172,6 +173,12 @@ class ClusterRuntime(CoreRuntime):
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
         self._shutdown = False
+        # Long-poll subscription to GCS pubsub channels: actor deaths
+        # arrive as pushes, so idle processes make ~0 RPCs/s and failure
+        # news beats the next failed call
+        # (ref: src/ray/pubsub/publisher.h subscriber side).
+        self._pubsub_task = asyncio.run_coroutine_threadsafe(
+            self._pubsub_loop(), self._io.loop)
 
     # ------------------------------------------------------------ bootstrap
 
@@ -216,6 +223,9 @@ class ClusterRuntime(CoreRuntime):
         if self._shutdown:
             return
         self._shutdown = True
+        task = getattr(self, "_pubsub_task", None)
+        if task is not None:
+            task.cancel()
         set_refcount_hook(None)
         from ant_ray_tpu._private import services  # noqa: PLC0415
 
@@ -227,6 +237,49 @@ class ClusterRuntime(CoreRuntime):
             services.stop_processes(self._owned_processes)
         self.server.stop()
         self._clients.close_all()
+
+    # ------------------------------------------------------------ pubsub
+
+    async def _pubsub_loop(self):
+        cursor = -1  # start from "now" — no interest in history
+        while not self._shutdown:
+            try:
+                reply = await self._gcs.call_async(
+                    "SubPoll", {"channels": ("actor_state",),
+                                "cursor": cursor, "timeout": 25.0},
+                    timeout=35)
+            except asyncio.CancelledError:
+                return
+            except Exception:  # noqa: BLE001 — head restarting
+                # A restarted head's sequence restarts at 0; resuming
+                # with the old (large) cursor would silence the channel
+                # forever.  Resubscribe from "now".
+                cursor = -1
+                await asyncio.sleep(1.0)
+                continue
+            cursor = reply["cursor"]
+            for _seq, channel, data in reply["events"]:
+                try:
+                    self._on_pubsub_event(channel, data)
+                except Exception:  # noqa: BLE001
+                    logger.exception("pubsub event handling failed")
+
+    def _on_pubsub_event(self, channel: str, data: dict) -> None:
+        if channel == "actor_state":
+            state = self._actor_states.get(data["actor_id"])
+            if state is None:
+                return
+            if data["state"] == ACTOR_DEAD:
+                # Push-based death: queued and future calls fail fast
+                # instead of each discovering it via its own RPC.
+                state.dead_reason = (data.get("death_reason")
+                                     or "actor died")
+                state.address = ""
+                self._release_actor_ctor_pins(data["actor_id"])
+            elif data["state"] == ACTOR_RESTARTING:
+                state.address = ""
+            elif data["state"] == ACTOR_ALIVE and data.get("address"):
+                state.address = data["address"]
 
     # ------------------------------------------------------------ refcount
 

@@ -78,6 +78,13 @@ class GcsServer:
 
         self._insight_events: deque = deque(maxlen=10000)
         self._dirty_locations: set[ObjectID] = set()
+        # ---- pubsub (ref: src/ray/pubsub/publisher.h — long-poll
+        # channels; here one global sequence + per-event channel tag so a
+        # subscriber resumes from a single cursor)
+        self._pub_events: deque = deque(maxlen=4096)
+        self._pub_seq = 0
+        self._pub_cond: asyncio.Condition | None = None  # lazy (io loop)
+        self._pub_notify_pending = False
         self._clients = ClientPool()
         self._io = IoThread.get()
         self._health_task = None
@@ -125,6 +132,7 @@ class GcsServer:
             "GetJobVirtualCluster": self._get_job_virtual_cluster,
             "InsightRecord": self._insight_record,
             "InsightGet": self._insight_get,
+            "SubPoll": self._sub_poll,
             "Shutdown": self._shutdown_rpc,
         })
         if self._durable:
@@ -277,11 +285,63 @@ class GcsServer:
         loop.call_later(0.05, self.stop)
         return True
 
+    # ------------------------------------------------------------- pubsub
+
+    def _publish(self, channel: str, data: dict) -> None:
+        """Append an event and wake long-pollers (ref: Publisher,
+        src/ray/pubsub/publisher.h — the mechanism that lets a thousand
+        workers watch actor/node state without hammering the head).
+        Wakeups coalesce: a burst of publishes (mass node failure)
+        schedules ONE notify, not one per event."""
+        self._pub_seq += 1
+        self._pub_events.append((self._pub_seq, channel, data))
+        if self._pub_cond is not None and not self._pub_notify_pending:
+            self._pub_notify_pending = True
+
+            async def _notify():
+                self._pub_notify_pending = False
+                async with self._pub_cond:
+                    self._pub_cond.notify_all()
+
+            asyncio.ensure_future(_notify())
+
+    async def _sub_poll(self, payload):
+        """Long-poll subscription: blocks until events newer than the
+        caller's cursor exist on its channels (or ~25s passes), then
+        returns them with the new cursor."""
+        if self._pub_cond is None:
+            self._pub_cond = asyncio.Condition()
+        channels = set(payload.get("channels") or ())
+        cursor = int(payload.get("cursor", 0))
+        if cursor < 0:  # "start from now" — skip buffered history
+            cursor = self._pub_events[-1][0] if self._pub_events else 0
+        timeout = min(float(payload.get("timeout", 25.0)), 25.0)
+        deadline = time.monotonic() + timeout
+        while True:
+            events = [(seq, ch, data)
+                      for seq, ch, data in self._pub_events
+                      if seq > cursor and (not channels or ch in channels)]
+            latest = (self._pub_events[-1][0]
+                      if self._pub_events else cursor)
+            if events:
+                return {"cursor": max(cursor, latest), "events": events}
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return {"cursor": max(cursor, latest), "events": []}
+            async with self._pub_cond:
+                try:
+                    await asyncio.wait_for(self._pub_cond.wait(),
+                                           remaining)
+                except asyncio.TimeoutError:
+                    pass
+
     # ------------------------------------------------------------- nodes
 
     async def _register_node(self, info: NodeInfo):
         self._nodes[info.node_id] = info
         self._last_heartbeat[info.node_id] = time.monotonic()
+        self._publish("node", {"node_id": info.node_id, "alive": True,
+                               "address": info.address})
         logger.info("node %s registered at %s", info.node_id.hex()[:8],
                     info.address)
         return True
@@ -316,6 +376,8 @@ class GcsServer:
         if info is None or not info.alive:
             return
         info.alive = False
+        self._publish("node", {"node_id": node_id, "alive": False,
+                               "address": info.address})
         for oid, nodes in list(self._object_locations.items()):
             nodes.discard(node_id)
         for record in list(self._actors.values()):
@@ -633,6 +695,10 @@ class GcsServer:
         record.state_event.set()
         record.state_event = asyncio.Event()
         self._save_actor(record)
+        self._publish("actor_state", {
+            "actor_id": record.spec.actor_id, "state": record.state,
+            "address": record.address,
+            "death_reason": record.death_reason})
         return True
 
     async def _list_actors(self, _payload):
@@ -704,8 +770,11 @@ class GcsServer:
         record = self._actors.get(payload["actor_id"])
         if record is None:
             return False
-        record.spec.max_restarts = 0 if payload.get("no_restart", True) else \
-            record.spec.max_restarts
+        no_restart = payload.get("no_restart", True)
+        restartable = (not no_restart
+                       and record.restarts_used < record.spec.max_restarts)
+        if no_restart:
+            record.spec.max_restarts = 0
         if record.node_id is not None:
             node = self._nodes.get(record.node_id)
             if node is not None and node.alive:
@@ -716,10 +785,22 @@ class GcsServer:
                         {"actor_id": record.spec.actor_id}, timeout=10)
                 except Exception:  # noqa: BLE001 — worker may be gone already
                     pass
+        if restartable:
+            # kill(no_restart=False): the worker death is a restartable
+            # failure — the daemon's WorkerDied report (or this direct
+            # call) drives the normal restart machinery, and subscribers
+            # see RESTARTING, never a terminal DEAD.
+            await self._handle_actor_failure(record,
+                                             "killed via kill(no_restart"
+                                             "=False)")
+            return True
         record.state = ACTOR_DEAD
         record.death_reason = "killed via kill()"
         record.state_event.set()
         self._save_actor(record)
+        self._publish("actor_state", {
+            "actor_id": record.spec.actor_id, "state": ACTOR_DEAD,
+            "address": "", "death_reason": record.death_reason})
         return True
 
     async def _worker_died(self, payload):
@@ -742,6 +823,10 @@ class GcsServer:
                         record.spec.actor_id.hex()[:8], record.restarts_used,
                         record.spec.max_restarts, reason)
             self._save_actor(record)
+            self._publish("actor_state", {
+                "actor_id": record.spec.actor_id,
+                "state": ACTOR_RESTARTING, "address": "",
+                "death_reason": ""})
             asyncio.ensure_future(self._schedule_actor(record))
         else:
             record.state = ACTOR_DEAD
@@ -749,6 +834,9 @@ class GcsServer:
             record.state_event.set()
             record.state_event = asyncio.Event()
             self._save_actor(record)
+            self._publish("actor_state", {
+                "actor_id": record.spec.actor_id, "state": ACTOR_DEAD,
+                "address": "", "death_reason": reason})
 
     # ------------------------------------------------------------- objects
 

@@ -375,3 +375,62 @@ def test_task_submitting_tasks(cluster):
         return sum(art.get([leaf.remote(i) for i in range(n)]))
 
     assert art.get(branch.remote(4)) == 60
+
+
+def test_pubsub_actor_death_pushes_to_submitters(cluster):
+    """Actor death reaches a caller WITHOUT polling: the pubsub channel
+    marks the submit state dead, so the next call fails fast instead of
+    waiting out WaitActorAlive (ref: src/ray/pubsub/publisher.h)."""
+    from ant_ray_tpu.api import global_worker
+
+    @art.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert art.get(v.ping.remote()) == "pong"
+    rt = global_worker.runtime
+    state = rt._actor_states[v.actor_id]
+    assert state.dead_reason is None
+
+    # Kill via the GCS directly — as another driver would — so OUR
+    # submit path learns about it purely through the push channel.
+    rt._gcs.call("KillActor", {"actor_id": v.actor_id,
+                               "no_restart": True}, retries=3)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and state.dead_reason is None:
+        time.sleep(0.05)
+    assert state.dead_reason is not None
+
+    t0 = time.monotonic()
+    with pytest.raises(ActorDiedError):
+        art.get(v.ping.remote(), timeout=30)
+    # Fast-fail: no 120s WaitActorAlive round.
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_kill_with_restart_allowed(cluster):
+    """kill(no_restart=False) on a restartable actor restarts it instead
+    of terminating (ref: GcsActorManager kill semantics)."""
+    @art.remote(max_restarts=1)
+    class Cat:
+        def __init__(self):
+            self.lives = 1
+
+        def ping(self):
+            return self.lives
+
+    c = Cat.remote()
+    assert art.get(c.ping.remote()) == 1
+    art.kill(c, no_restart=False)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            assert art.get(c.ping.remote(), timeout=20) == 1
+            break
+        except ActorDiedError:
+            time.sleep(0.2)
+    else:
+        raise AssertionError("actor never restarted after soft kill")
+    art.kill(c)  # terminal
