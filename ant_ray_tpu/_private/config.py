@@ -93,6 +93,16 @@ class Config:
     # testing — ref: src/ray/rpc/rpc_chaos.h).
     testing_rpc_failure: str = ""
 
+    # ---- memory monitor (ref: src/ray/common/memory_monitor.h +
+    # worker_killing_policy.h) ----
+    # Check node memory pressure this often; 0 disables the monitor.
+    memory_monitor_interval_s: float = 1.0
+    # Above this used fraction, the daemon kills a worker to relieve
+    # pressure (retriable task workers first, largest RSS first).
+    memory_usage_threshold: float = 0.95
+    # Where to read meminfo (tests point this at a fake file).
+    meminfo_path: str = "/proc/meminfo"
+
     # ---- accelerators ----
     # Override detected TPU chip count (testing).
     tpu_chips_override: int = -1

@@ -749,6 +749,13 @@ class ClusterRuntime(CoreRuntime):
                     logger.warning("task %s attempt %d/%d failed: %s",
                                    spec.function_name, attempt + 1,
                                    attempts, e)
+                    # Brief backoff so daemons reap dead workers before
+                    # the retry leases again (ref: retry delays in
+                    # NormalTaskSubmitter) — skipped after the final
+                    # attempt (nothing left to wait for).
+                    if attempt + 1 < attempts:
+                        await asyncio.sleep(
+                            min(0.05 * (attempt + 1), 0.5))
             err = exceptions.WorkerCrashedError(
                 f"task {spec.function_name} failed after {attempts} "
                 f"attempts: {last_error}")

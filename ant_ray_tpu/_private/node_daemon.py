@@ -140,6 +140,9 @@ class NodeManager:
             self._heartbeat_loop(), self._io.loop))
         self._tasks.append(asyncio.run_coroutine_threadsafe(
             self._monitor_workers_loop(), self._io.loop))
+        if global_config().memory_monitor_interval_s > 0:
+            self._tasks.append(asyncio.run_coroutine_threadsafe(
+                self._memory_monitor_loop(), self._io.loop))
         prestart = global_config().num_prestart_workers
         if prestart < 0:
             prestart = min(2, self._max_workers)
@@ -314,6 +317,72 @@ class NodeManager:
         logger.warning("giving up reporting death of worker %s",
                        worker_id)
 
+    # ------------------------------------------------- memory monitor
+    # (ref: src/ray/common/memory_monitor.h — cgroup/proc-based node OOM
+    #  detection; src/ray/raylet/worker_killing_policy.h — retriable
+    #  tasks die before actors, largest first, so the node survives
+    #  memory pressure instead of being OOM-killed wholesale)
+
+    @staticmethod
+    def _read_memory_used_fraction(meminfo_path: str) -> float | None:
+        try:
+            fields = {}
+            with open(meminfo_path) as f:
+                for line in f:
+                    key, _, rest = line.partition(":")
+                    fields[key.strip()] = int(rest.strip().split()[0])
+            total = fields.get("MemTotal", 0)
+            available = fields.get("MemAvailable")
+            if total <= 0 or available is None:
+                # No MemAvailable (old kernel / minimal proc fake):
+                # better no monitoring than reading "100% used" and
+                # killing healthy workers every tick.
+                return None
+            return 1.0 - available / total
+        except (OSError, ValueError, IndexError):
+            return None
+
+    def _worker_rss_kb(self, handle: WorkerHandle) -> int:
+        try:
+            with open(f"/proc/{handle.proc.pid}/statm") as f:
+                return int(f.read().split()[1]) * 4  # pages → ~KiB
+        except (OSError, ValueError, IndexError):
+            return 0
+
+    def _pick_oom_victim(self) -> WorkerHandle | None:
+        """Retriable first (leased task workers — their tasks retry),
+        then actors (they may restart); idle/starting workers are free
+        memory already being reclaimed, never victims."""
+        candidates = [h for h in self._workers.values()
+                      if h.state in (LEASED, ACTOR)]
+        if not candidates:
+            return None
+        return max(candidates,
+                   key=lambda h: (h.state == LEASED,
+                                  self._worker_rss_kb(h)))
+
+    async def _memory_monitor_loop(self):
+        cfg = global_config()
+        while not self._stopping:
+            await asyncio.sleep(cfg.memory_monitor_interval_s)
+            used = self._read_memory_used_fraction(cfg.meminfo_path)
+            if used is None or used < cfg.memory_usage_threshold:
+                continue
+            victim = self._pick_oom_victim()
+            if victim is None:
+                continue
+            logger.warning(
+                "memory pressure (%.1f%% used >= %.1f%%): killing "
+                "worker %s (%s, rss=%dKiB) to relieve it",
+                100 * used, 100 * cfg.memory_usage_threshold,
+                victim.worker_id.hex()[:8], victim.state,
+                self._worker_rss_kb(victim))
+            self._terminate_worker(victim)
+            # Death propagation (task retry / actor restart) runs via
+            # the normal worker monitor; pause a beat so the kill lands
+            # before the next pressure reading.
+            await asyncio.sleep(cfg.memory_monitor_interval_s)
+
     def _terminate_worker(self, handle: WorkerHandle):
         if handle.proc.poll() is None:
             handle.proc.terminate()
@@ -382,6 +451,12 @@ class NodeManager:
         for handle in self._workers.values():
             if (handle.state == IDLE and handle.address
                     and handle.env_key == env_key):
+                # Liveness check at grant: a worker that died while
+                # leased gets ReturnWorker'd back to IDLE by its driver
+                # before the reaper runs — handing out the corpse makes
+                # every fast retry burn an attempt on a dead port.
+                if handle.proc.poll() is not None:
+                    continue  # reaper will collect it
                 return handle
         return None
 

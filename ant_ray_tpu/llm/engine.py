@@ -61,8 +61,10 @@ def _bucket(n: int, cap: int) -> int:
 class LLMEngine:
     """Synchronous engine core; Serve replicas and batch stages drive it.
 
-    ``model`` is a config name from models/llama.CONFIGS or a
-    LlamaConfig; ``params`` defaults to random init (tests/bench).
+    ``model`` is a config name from models/llama.CONFIGS, a LOCAL
+    CHECKPOINT DIRECTORY (HF Llama layout — real weights, loaded via
+    models/checkpoint.py), or a LlamaConfig; ``params`` overrides both
+    (random init remains the default for named configs: tests/bench).
     """
 
     def __init__(self, model="tiny", params=None, *, slots: int = 8,
@@ -77,14 +79,30 @@ class LLMEngine:
         from ant_ray_tpu.models import llama  # noqa: PLC0415
 
         self._llama = llama
-        self.config = (llama.CONFIGS[model] if isinstance(model, str)
-                       else model)
+        loaded = None
+        if isinstance(model, str):
+            from ant_ray_tpu.models import checkpoint as ckpt  # noqa: PLC0415
+            from ant_ray_tpu.models.llama import CONFIGS  # noqa: PLC0415
+
+            if params is not None and model not in CONFIGS:
+                # Explicit (e.g. pre-sharded) params: only the config is
+                # needed — don't read gigabytes of weights to drop them.
+                self.config = ckpt.config_from_hf(model)
+            else:
+                loaded, self.config = ckpt.resolve_model(model)
+            if tokenizer is None and model not in CONFIGS:
+                tokenizer = get_tokenizer(model)  # checkpoint dir
+        else:
+            self.config = model
         self.max_seq = min(max_seq or self.config.max_seq,
                            self.config.max_seq)
         self.slots = slots
         self.tokenizer = tokenizer or get_tokenizer(None)
-        self.params = params if params is not None else llama.init_params(
-            self.config, jax.random.PRNGKey(seed))
+        if params is None:
+            params = (loaded if loaded is not None
+                      else llama.init_params(self.config,
+                                             jax.random.PRNGKey(seed)))
+        self.params = params
         self.cache = llama.init_kv_cache(self.config, slots, self.max_seq)
         # Host-side mirror of each slot's most recent token: mutated in
         # numpy and uploaded once per decode call, so the scheduling

@@ -1,0 +1,226 @@
+"""Llama checkpoint loading — real weights into the functional param tree.
+
+Capability mirror of the reference's checkpoint path (ref: the vLLM
+engine loads HF checkpoints, llm/_internal/serve/engines/vllm/; the repo
+previously only ever ran randomly-initialized params).  Supports the
+HuggingFace Llama layout from a local directory:
+
+* ``*.safetensors`` (preferred — zero-copy numpy views), else
+* ``pytorch_model*.bin`` via torch (CPU), else
+* a ``params.npz`` flat dump of our own tree (save_params/load_params).
+
+HF stores linear weights as (out_features, in_features); this model
+applies ``h @ W`` with (in, out), so every projection transposes on
+load.  HF's q/k weights are already permuted for the rotate-half rope
+convention, which is exactly ops/rope.py's layout — no re-permutation.
+Weights load host-side as numpy and are placed on device (with whatever
+sharding) by the caller, so a multi-host loader can shard-then-put.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Any
+
+import numpy as np
+
+from ant_ray_tpu.models.llama import CONFIGS, LlamaConfig, param_shapes
+
+_LAYER_RE = re.compile(r"model\.layers\.(\d+)\.(.+)")
+
+# HF tensor name (per layer) → (our leaf name, transpose?)
+_PER_LAYER = {
+    "input_layernorm.weight": ("ln_attn", False),
+    "self_attn.q_proj.weight": ("wq", True),
+    "self_attn.k_proj.weight": ("wk", True),
+    "self_attn.v_proj.weight": ("wv", True),
+    "self_attn.o_proj.weight": ("wo", True),
+    "post_attention_layernorm.weight": ("ln_mlp", False),
+    "mlp.gate_proj.weight": ("w_gate", True),
+    "mlp.up_proj.weight": ("w_up", True),
+    "mlp.down_proj.weight": ("w_down", True),
+}
+
+_TOP_LEVEL = {
+    "model.embed_tokens.weight": ("embed", False),
+    "model.norm.weight": ("norm_f", False),
+    "lm_head.weight": ("lm_head", True),
+}
+
+
+def config_from_hf(path: str) -> LlamaConfig:
+    """Build a LlamaConfig from a HF ``config.json``."""
+    with open(os.path.join(path, "config.json")) as f:
+        cfg = json.load(f)
+    if cfg.get("torch_dtype") in ("float32", "float64"):
+        dtype: Any = np.float32
+    else:  # bf16/f16 checkpoints compute in bf16 (the TPU dtype)
+        import jax.numpy as jnp  # noqa: PLC0415
+
+        dtype = jnp.bfloat16
+    return LlamaConfig(
+        dtype=dtype,
+        vocab_size=cfg["vocab_size"],
+        dim=cfg["hidden_size"],
+        n_layers=cfg["num_hidden_layers"],
+        n_heads=cfg["num_attention_heads"],
+        n_kv_heads=cfg.get("num_key_value_heads",
+                           cfg["num_attention_heads"]),
+        mlp_dim=cfg["intermediate_size"],
+        max_seq=cfg.get("max_position_embeddings", 8192),
+        rope_theta=float(cfg.get("rope_theta", 500000.0)),
+        norm_eps=float(cfg.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(cfg.get("tie_word_embeddings", False)),
+    )
+
+
+def _iter_hf_tensors(path: str):
+    """Yield (name, np.ndarray) from whatever weight files exist."""
+    st_files = sorted(f for f in os.listdir(path)
+                      if f.endswith(".safetensors"))
+    if st_files:
+        from safetensors import safe_open  # noqa: PLC0415
+
+        for fname in st_files:
+            with safe_open(os.path.join(path, fname), framework="np") as f:
+                for name in f.keys():
+                    yield name, f.get_tensor(name)
+        return
+    bin_files = sorted(f for f in os.listdir(path)
+                       if f.startswith("pytorch_model")
+                       and f.endswith(".bin"))
+    if bin_files:
+        import torch  # noqa: PLC0415
+
+        for fname in bin_files:
+            state = torch.load(os.path.join(path, fname),
+                               map_location="cpu", weights_only=True)
+            for name, tensor in state.items():
+                yield name, tensor.float().numpy()
+        return
+    raise FileNotFoundError(
+        f"no *.safetensors or pytorch_model*.bin under {path}")
+
+
+def load_llama_params(path: str, config: LlamaConfig | None = None,
+                      dtype: Any = None) -> tuple[dict, LlamaConfig]:
+    """Load a HF-format Llama checkpoint directory into our param tree.
+
+    Returns (params, config); ``params`` leaves are host numpy arrays in
+    ``dtype`` (default: the config's dtype) — device placement/sharding
+    is the caller's job (``jax.device_put(params, shardings)``)."""
+    npz = os.path.join(path, "params.npz")
+    if os.path.exists(npz):
+        if config is None:
+            raise ValueError("params.npz needs an explicit config")
+        return load_params(npz, config), config
+
+    if config is None:
+        config = config_from_hf(path)
+    shapes = param_shapes(config)
+    out_dtype = dtype if dtype is not None else config.dtype
+    layers: dict[str, list] = {
+        name: [None] * config.n_layers
+        for name in shapes["layers"]
+    }
+    top: dict[str, Any] = {}
+
+    for name, tensor in _iter_hf_tensors(path):
+        m = _LAYER_RE.match(name)
+        if m:
+            index, leaf_name = int(m.group(1)), m.group(2)
+            entry = _PER_LAYER.get(leaf_name)
+            if entry is None:
+                continue  # rotary caches etc.
+            ours, transpose = entry
+            layers[ours][index] = (tensor.T if transpose else tensor)
+        else:
+            entry = _TOP_LEVEL.get(name)
+            if entry is None:
+                continue
+            ours, transpose = entry
+            top[ours] = tensor.T if transpose else tensor
+
+    params: dict = {"layers": {}}
+    for ours, per_layer in layers.items():
+        missing = [i for i, t in enumerate(per_layer) if t is None]
+        if missing:
+            raise ValueError(
+                f"checkpoint is missing layer tensors for "
+                f"{ours!r}: layers {missing}")
+        params["layers"][ours] = np.stack(per_layer).astype(out_dtype)
+    for ours in ("embed", "norm_f"):
+        if ours not in top:
+            raise ValueError(f"checkpoint is missing {ours!r}")
+        params[ours] = np.asarray(top[ours]).astype(out_dtype)
+    if config.tie_embeddings:
+        pass  # lm head is embed.T at use sites
+    elif "lm_head" in top:
+        params["lm_head"] = np.asarray(top["lm_head"]).astype(out_dtype)
+    else:
+        # Tied checkpoints sometimes omit lm_head with the flag unset.
+        params["lm_head"] = params["embed"].T.copy()
+
+    _check_shapes(params, shapes)
+    return params, config
+
+
+def _check_shapes(params: dict, shapes: dict) -> None:
+    def walk(p, s, path):
+        if isinstance(s, dict):
+            for key, sub in s.items():
+                if key not in p:
+                    raise ValueError(f"missing param {path}/{key}")
+                walk(p[key], sub, f"{path}/{key}")
+        else:
+            if tuple(p.shape) != tuple(s):
+                raise ValueError(
+                    f"shape mismatch at {path}: checkpoint "
+                    f"{tuple(p.shape)} vs model {tuple(s)}")
+
+    walk(params, shapes, "")
+
+
+def save_params(params: dict, path: str) -> None:
+    """Flat npz dump of our own tree (round-trip format for tests and
+    single-host snapshots; training checkpoints use train/checkpoint)."""
+    flat = {}
+
+    def walk(tree, prefix):
+        for key, value in tree.items():
+            name = f"{prefix}{key}"
+            if isinstance(value, dict):
+                walk(value, name + ".")
+            else:
+                flat[name] = np.asarray(value)
+
+    walk(params, "")
+    np.savez(path, **flat)
+
+
+def load_params(path: str, config: LlamaConfig) -> dict:
+    data = np.load(path)
+    params: dict = {}
+    for name in data.files:
+        parts = name.split(".")
+        node = params
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = data[name]
+    _check_shapes(params, param_shapes(config))
+    return params
+
+
+def resolve_model(model: str) -> tuple[dict | None, LlamaConfig]:
+    """The engine-facing entry: a named config ("tiny", "llama3-8b")
+    returns (None, config) — random init; a local checkpoint directory
+    returns (loaded params, config-from-json)."""
+    if model in CONFIGS:
+        return None, CONFIGS[model]
+    if os.path.isdir(model):
+        return load_llama_params(model)
+    raise ValueError(
+        f"model {model!r} is neither a named config {sorted(CONFIGS)} "
+        "nor a local checkpoint directory")

@@ -53,12 +53,11 @@ class XLAGroup(BaseGroup):
                  devices=None):
         super().__init__(world_size, rank, group_name)
         jax = _jax()
-        if world_size > 1 and jax.process_count() < world_size:
-            raise RuntimeError(
-                f"xla group {group_name!r} needs {world_size} federated "
-                f"processes but jax.process_count() == {jax.process_count()}."
-                " Initialize jax.distributed before creating multi-host "
-                "groups.")
+        # Mesh-based verbs need one process per rank (jax.distributed);
+        # the KV-mailbox p2p verbs (send/recv) work without it, so the
+        # check is deferred to the verbs that actually need the mesh.
+        self._federated_ok = (world_size <= 1
+                              or jax.process_count() >= world_size)
         self._devices = (list(devices) if devices is not None
                          else list(jax.devices()))
         # One representative device per member process for per-rank verbs.
@@ -112,6 +111,16 @@ class XLAGroup(BaseGroup):
             if verb == "reducescatter_sum":
                 # x[0]: (d0, *rest) with d0 % n == 0 → (d0/n, *rest)
                 return jax.lax.psum_scatter(x[0], axis, tiled=True)
+            if verb.startswith("reducescatter_"):
+                # MIN/MAX/AVERAGE: no fused XLA op — gather, reduce
+                # locally, keep this rank's tile.
+                g = jax.lax.all_gather(x[0], axis)   # (n, d0, *rest)
+                red = {"min": g.min(axis=0), "max": g.max(axis=0),
+                       "average": g.mean(axis=0)}[verb.split("_", 1)[1]]
+                tile = red.shape[0] // n_dev
+                index = jax.lax.axis_index(axis)
+                return jax.lax.dynamic_slice_in_dim(
+                    red, index * tile, tile, axis=0)
             raise ValueError(verb)
 
         fn = _shard_map()(op, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
@@ -146,6 +155,13 @@ class XLAGroup(BaseGroup):
     def _run_rank_verb(self, verb: str, tensor, extra=None):
         """One tensor per member process; returns this rank's out block."""
         jax = _jax()
+        if not self._federated_ok:
+            raise RuntimeError(
+                f"xla group {self._group_name!r} needs "
+                f"{self._world_size} federated processes but "
+                f"jax.process_count() == {jax.process_count()}. "
+                "Initialize jax.distributed before using mesh "
+                "collectives (send/recv work without it).")
         t = np.asarray(tensor)
         jitted, mesh, sharding = self._compiled(
             verb, tuple(t.shape), str(t.dtype), len(self._rank_devices),
@@ -184,10 +200,14 @@ class XLAGroup(BaseGroup):
             self._run_rank_verb("allreduce_sum", np.zeros((1,), np.float32))
 
     def reduce(self, tensors, opts: types.ReduceOptions):
-        # SPMD collectives give everyone the reduction; a superset of the
-        # reference's "result lands on root_rank" contract.
-        return self.allreduce(
+        # The SPMD collective gives every rank the reduction; the
+        # reference contract is "result lands on root_rank, other
+        # buffers untouched" — so non-roots hand back their input.
+        reduced = self.allreduce(
             tensors, types.AllReduceOptions(reduce_op=opts.reduce_op))
+        if self._world_size > 1 and self._rank != opts.root_rank:
+            return [tensors[0]]
+        return reduced
 
     def broadcast(self, tensors, opts: types.BroadcastOptions):
         if self._world_size == 1:
@@ -202,12 +222,31 @@ class XLAGroup(BaseGroup):
         block = self._run_rank_verb("allgather", tensors[0])
         return [[block[i] for i in range(self._world_size)]]
 
+    _SCATTER_VERBS = {
+        types.ReduceOp.SUM: "reducescatter_sum",
+        types.ReduceOp.MIN: "reducescatter_min",
+        types.ReduceOp.MAX: "reducescatter_max",
+        types.ReduceOp.AVERAGE: "reducescatter_average",
+    }
+
+    def _scatter_verb(self, op: types.ReduceOp, tensor, n: int) -> str:
+        verb = self._SCATTER_VERBS.get(op)
+        if verb is None:
+            raise NotImplementedError(
+                f"{op} is not supported by xla reducescatter")
+        d0 = np.asarray(tensor).shape[0]
+        if d0 % n != 0:
+            raise ValueError(
+                f"reducescatter leading dim {d0} not divisible by "
+                f"group size {n}")
+        return verb
+
     def reducescatter(self, tensors, opts: types.ReduceScatterOptions):
-        if opts.reduce_op != types.ReduceOp.SUM:
-            raise NotImplementedError("reducescatter supports SUM only")
         if self._world_size == 1:
             return [tensors[0]]
-        block = self._run_rank_verb("reducescatter_sum", tensors[0])
+        verb = self._scatter_verb(opts.reduce_op, tensors[0],
+                                  self._world_size)
+        block = self._run_rank_verb(verb, tensors[0])
         return [block]
 
     # ---- multi-device variants (parity: reference *_multigpu verbs)
@@ -231,21 +270,68 @@ class XLAGroup(BaseGroup):
 
     def reducescatter_multidevice(self, tensors: list,
                                   opts: types.ReduceScatterOptions):
-        if opts.reduce_op != types.ReduceOp.SUM:
-            raise NotImplementedError("reducescatter supports SUM only")
-        return self._run_multidevice("reducescatter_sum", tensors)
+        verb = self._scatter_verb(opts.reduce_op, tensors[0],
+                                  len(self._devices))
+        return self._run_multidevice(verb, tensors)
 
     # ---- p2p
+    # Host-level point-to-point rides the control plane through GCS KV
+    # mailboxes (ICI p2p belongs to compiled step-graph channels / the
+    # ppermute inside sharded programs).  Each (src → dst) pair keeps a
+    # sequence so repeated sends pair with recvs in order, matching the
+    # reference's NCCL send/recv contract
+    # (ref: collective.py:601,664).
+
+    def _mailbox_key(self, src: int, dst: int, seq: int) -> str:
+        return (f"collective_p2p:{self._group_name}:"
+                f"{src}->{dst}:{seq}")
 
     def send(self, tensors, opts: types.SendOptions):
-        raise NotImplementedError(
-            "xla-backend host-level send/recv goes through the object "
-            "plane; ICI p2p lives in compiled step-graph channels")
+        import pickle  # noqa: PLC0415
+        import time as _time  # noqa: PLC0415
+
+        from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+        seq_attr = f"_send_seq_{opts.dst_rank}"
+        seq = getattr(self, seq_attr, 0)
+        key = self._mailbox_key(self._rank, opts.dst_rank, seq)
+        blob = pickle.dumps(np.asarray(tensors[0]), protocol=5)
+        gcs = global_worker.runtime._gcs
+        gcs.call("KVPut", {"key": key, "value": blob}, retries=3)
+        # Block until the receiver consumed it (deletes the key) — send
+        # is synchronous like the reference's.  The sequence advances
+        # only on success, and a timed-out blob is withdrawn, so one
+        # failure never desyncs the pair.
+        deadline = _time.monotonic() + opts.timeout_ms / 1000.0
+        while _time.monotonic() < deadline:
+            if gcs.call("KVGet", {"key": key}, retries=3) is None:
+                setattr(self, seq_attr, seq + 1)
+                return
+            _time.sleep(0.005)
+        gcs.call("KVDel", {"key": key}, retries=3)
+        raise TimeoutError(
+            f"send to rank {opts.dst_rank} not consumed in time")
 
     def recv(self, tensors, opts: types.RecvOptions):
-        raise NotImplementedError(
-            "xla-backend host-level send/recv goes through the object "
-            "plane; ICI p2p lives in compiled step-graph channels")
+        import pickle  # noqa: PLC0415
+        import time as _time  # noqa: PLC0415
+
+        from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+        seq_attr = f"_recv_seq_{opts.src_rank}"
+        seq = getattr(self, seq_attr, 0)
+        key = self._mailbox_key(opts.src_rank, self._rank, seq)
+        gcs = global_worker.runtime._gcs
+        deadline = _time.monotonic() + opts.timeout_ms / 1000.0
+        while _time.monotonic() < deadline:
+            blob = gcs.call("KVGet", {"key": key}, retries=3)
+            if blob is not None:
+                gcs.call("KVDel", {"key": key}, retries=3)
+                setattr(self, seq_attr, seq + 1)  # success only
+                return [pickle.loads(blob)]
+            _time.sleep(0.005)
+        raise TimeoutError(
+            f"recv from rank {opts.src_rank} timed out")
 
     def destroy_group(self):
         self._compiled.cache_clear()

@@ -1,0 +1,165 @@
+"""Checkpoint loading: HF-format Llama weights (safetensors / torch
+.bin) into the functional param tree, with transpose correctness proven
+by forward equivalence (ref capability: vLLM engine checkpoint loading,
+llm/_internal/serve/engines/vllm/)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from ant_ray_tpu.models import checkpoint, llama
+
+CFG = llama.LlamaConfig(
+    vocab_size=64, dim=32, n_layers=2, n_heads=4, n_kv_heads=2,
+    mlp_dim=48, max_seq=128, dtype=np.float32)
+
+
+def _hf_config_json():
+    return {
+        "vocab_size": CFG.vocab_size,
+        "hidden_size": CFG.dim,
+        "num_hidden_layers": CFG.n_layers,
+        "num_attention_heads": CFG.n_heads,
+        "num_key_value_heads": CFG.n_kv_heads,
+        "intermediate_size": CFG.mlp_dim,
+        "max_position_embeddings": CFG.max_seq,
+        "rope_theta": CFG.rope_theta,
+        "rms_norm_eps": CFG.norm_eps,
+        "torch_dtype": "float32",
+    }
+
+
+def _make_hf_state(rng):
+    """A synthetic HF-layout state dict (out, in) + our expected tree."""
+    hd = CFG.head_dim
+    state = {}
+    expected = {"layers": {}}
+
+    def lin(out_dim, in_dim):
+        return rng.standard_normal((out_dim, in_dim)).astype(np.float32)
+
+    state["model.embed_tokens.weight"] = \
+        rng.standard_normal((CFG.vocab_size, CFG.dim)).astype(np.float32)
+    state["model.norm.weight"] = \
+        rng.standard_normal((CFG.dim,)).astype(np.float32)
+    state["lm_head.weight"] = lin(CFG.vocab_size, CFG.dim)
+    expected["embed"] = state["model.embed_tokens.weight"]
+    expected["norm_f"] = state["model.norm.weight"]
+    expected["lm_head"] = state["lm_head.weight"].T
+
+    per = {name: [] for name in ("ln_attn", "wq", "wk", "wv", "wo",
+                                 "ln_mlp", "w_gate", "w_up", "w_down")}
+    for i in range(CFG.n_layers):
+        p = f"model.layers.{i}."
+        state[p + "input_layernorm.weight"] = \
+            rng.standard_normal((CFG.dim,)).astype(np.float32)
+        state[p + "self_attn.q_proj.weight"] = lin(CFG.n_heads * hd,
+                                                   CFG.dim)
+        state[p + "self_attn.k_proj.weight"] = lin(CFG.n_kv_heads * hd,
+                                                   CFG.dim)
+        state[p + "self_attn.v_proj.weight"] = lin(CFG.n_kv_heads * hd,
+                                                   CFG.dim)
+        state[p + "self_attn.o_proj.weight"] = lin(CFG.dim,
+                                                   CFG.n_heads * hd)
+        state[p + "post_attention_layernorm.weight"] = \
+            rng.standard_normal((CFG.dim,)).astype(np.float32)
+        state[p + "mlp.gate_proj.weight"] = lin(CFG.mlp_dim, CFG.dim)
+        state[p + "mlp.up_proj.weight"] = lin(CFG.mlp_dim, CFG.dim)
+        state[p + "mlp.down_proj.weight"] = lin(CFG.dim, CFG.mlp_dim)
+        per["ln_attn"].append(state[p + "input_layernorm.weight"])
+        per["wq"].append(state[p + "self_attn.q_proj.weight"].T)
+        per["wk"].append(state[p + "self_attn.k_proj.weight"].T)
+        per["wv"].append(state[p + "self_attn.v_proj.weight"].T)
+        per["wo"].append(state[p + "self_attn.o_proj.weight"].T)
+        per["ln_mlp"].append(state[p + "post_attention_layernorm.weight"])
+        per["w_gate"].append(state[p + "mlp.gate_proj.weight"].T)
+        per["w_up"].append(state[p + "mlp.up_proj.weight"].T)
+        per["w_down"].append(state[p + "mlp.down_proj.weight"].T)
+    for name, stack in per.items():
+        expected["layers"][name] = np.stack(stack)
+    return state, expected
+
+
+def _assert_trees_equal(a, b):
+    import jax
+
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def _write_config(path):
+    (path / "config.json").write_text(json.dumps(_hf_config_json()))
+
+
+def test_load_safetensors(tmp_path):
+    from safetensors.numpy import save_file
+
+    state, expected = _make_hf_state(np.random.default_rng(0))
+    _write_config(tmp_path)
+    save_file(state, str(tmp_path / "model.safetensors"))
+
+    params, config = checkpoint.load_llama_params(str(tmp_path))
+    assert config.dim == CFG.dim and config.n_layers == CFG.n_layers
+    _assert_trees_equal(params, expected)
+
+    # Forward equivalence: loaded tree behaves exactly like the
+    # hand-assembled one (proves every transpose).
+    import jax.numpy as jnp
+
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, CFG.vocab_size, (1, 16)),
+        jnp.int32)
+    out_loaded = llama.forward(params, tokens, config)
+    out_expected = llama.forward(expected, tokens, CFG)
+    np.testing.assert_allclose(np.asarray(out_loaded),
+                               np.asarray(out_expected),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_load_torch_bin(tmp_path):
+    import torch
+
+    state, expected = _make_hf_state(np.random.default_rng(1))
+    _write_config(tmp_path)
+    torch.save({k: torch.from_numpy(v) for k, v in state.items()},
+               str(tmp_path / "pytorch_model.bin"))
+
+    params, _config = checkpoint.load_llama_params(str(tmp_path))
+    _assert_trees_equal(params, expected)
+
+
+def test_missing_tensor_errors(tmp_path):
+    from safetensors.numpy import save_file
+
+    state, _ = _make_hf_state(np.random.default_rng(2))
+    del state["model.layers.1.mlp.up_proj.weight"]
+    _write_config(tmp_path)
+    save_file(state, str(tmp_path / "model.safetensors"))
+    with pytest.raises(ValueError, match="missing layer tensors"):
+        checkpoint.load_llama_params(str(tmp_path))
+
+
+def test_save_load_roundtrip(tmp_path):
+    params = llama.init_params(CFG, __import__("jax").random.PRNGKey(3))
+    path = str(tmp_path / "params.npz")
+    checkpoint.save_params(params, path)
+    loaded = checkpoint.load_params(path, CFG)
+    _assert_trees_equal(params, loaded)
+
+
+def test_engine_loads_checkpoint_dir(tmp_path):
+    """LLMEngine(model=<dir>) serves REAL weights end to end."""
+    from safetensors.numpy import save_file
+
+    from ant_ray_tpu.llm.engine import LLMEngine
+    from ant_ray_tpu.llm.sampling import SamplingParams
+
+    state, expected = _make_hf_state(np.random.default_rng(4))
+    _write_config(tmp_path)
+    save_file(state, str(tmp_path / "model.safetensors"))
+
+    engine = LLMEngine(str(tmp_path), slots=2, max_seq=64)
+    _assert_trees_equal(engine.params, expected)
+    out = engine.generate(["ab"], SamplingParams(max_tokens=3))[0]
+    assert 1 <= len(out.token_ids) <= 3
