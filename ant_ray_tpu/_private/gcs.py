@@ -100,6 +100,7 @@ class GcsServer:
             "KVPut": self._kv_put,
             "KVGet": self._kv_get,
             "KVDel": self._kv_del,
+            "KVTake": self._kv_take,
             "KVKeys": self._kv_keys,
             "RegisterJob": self._register_job,
             "CreateActor": self._create_actor,
@@ -556,6 +557,16 @@ class GcsServer:
     async def _kv_del(self, payload):
         self._persist_del("kv", payload["key"])
         return self._kv.pop(payload["key"], None) is not None
+
+    async def _kv_take(self, payload):
+        """Atomic get-and-delete (one event-loop turn — no reader can
+        interleave between the read and the removal).  The p2p mailbox
+        protocol (xla_group.py send/recv) relies on this to make
+        exactly one of {receiver-take, sender-withdraw} win."""
+        value = self._kv.pop(payload["key"], None)
+        if value is not None:
+            self._persist_del("kv", payload["key"])
+        return value
 
     async def _kv_keys(self, payload):
         prefix = payload.get("prefix", "")

@@ -354,7 +354,8 @@ class NodeManager:
         then actors (they may restart); idle/starting workers are free
         memory already being reclaimed, never victims."""
         candidates = [h for h in self._workers.values()
-                      if h.state in (LEASED, ACTOR)]
+                      if h.state in (LEASED, ACTOR)
+                      and h.proc.poll() is None]  # corpses free nothing
         if not candidates:
             return None
         return max(candidates,

@@ -15,8 +15,11 @@ the HBM-resident object tier lives in device_store.py).
 from __future__ import annotations
 
 import io
+import os
 import pickle
+import sys
 import threading
+import types
 from dataclasses import dataclass
 from typing import Any, Callable
 
@@ -79,6 +82,61 @@ class SerializedObject:
 
 _thread_local = threading.local()
 
+_by_value_modules: set[str] = set()
+_installed_top_levels: set[str] | None = None
+
+
+def _is_installed_distribution(top_level: str) -> bool:
+    """True if ``top_level`` belongs to any installed distribution
+    (covers editable installs, whose __file__ points at the checkout)."""
+    global _installed_top_levels
+    if _installed_top_levels is None:
+        try:
+            from importlib import metadata  # noqa: PLC0415
+
+            _installed_top_levels = set(metadata.packages_distributions())
+        except Exception:  # noqa: BLE001 — no metadata, assume script
+            _installed_top_levels = set()
+    return top_level in _installed_top_levels
+
+
+def _register_driver_module_by_value(obj: Any) -> None:
+    """Ship driver-script code by value.
+
+    cloudpickle pickles module-level functions/classes by reference,
+    which breaks when the worker can't import the driver's module (a
+    test file, a user script run from a checkout).  The reference's
+    cloudpickle fork pickles driver code by value unconditionally; here
+    we register any module that isn't installed (not under
+    site-/dist-packages, not stdlib, not ant_ray_tpu itself) for
+    by-value pickling, so classes and functions defined in driver
+    scripts serialize self-contained.
+    """
+    module_name = getattr(obj, "__module__", None)
+    if not module_name or module_name in _by_value_modules:
+        return
+    top = module_name.split(".")[0]
+    if top in ("ant_ray_tpu", "__main__", "builtins") or \
+            top in sys.stdlib_module_names:
+        return  # __main__ is already by-value in cloudpickle
+    module = sys.modules.get(module_name)
+    file = getattr(module, "__file__", None)
+    if module is None or not file:
+        return
+    norm = file.replace(os.sep, "/")
+    if "site-packages" in norm or "dist-packages" in norm:
+        return
+    if _is_installed_distribution(top):
+        # pip install -e / conda source checkouts: importable on workers
+        # under their own name — shipping by value would fork the class
+        # identity (worker-side isinstance against its own import fails).
+        return
+    try:
+        cloudpickle.register_pickle_by_value(module)
+        _by_value_modules.add(module_name)
+    except Exception:  # noqa: BLE001 — fall back to by-reference
+        pass
+
 
 def serialize(value: Any) -> SerializedObject:
     buffers: list = []
@@ -105,6 +163,8 @@ def serialize(value: Any) -> SerializedObject:
                 # NEXT_BUFFER consumption order of other buffers.
                 host = np.asarray(jax.device_get(obj))
                 return (_rebuild_jax_array, (host,))
+            if isinstance(obj, (type, types.FunctionType)):
+                _register_driver_module_by_value(obj)
             # Defer to cloudpickle's own reducer_override (it implements
             # local-function/class support there, not in dispatch).
             return super().reducer_override(obj)
@@ -143,6 +203,7 @@ def record_contained_ref(ref) -> None:
 
 def dumps_code(obj: Any) -> bytes:
     """Serialize a function/class definition (cloudpickle)."""
+    _register_driver_module_by_value(obj)
     return cloudpickle.dumps(obj)
 
 

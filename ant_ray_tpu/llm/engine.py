@@ -22,6 +22,7 @@ vllm_engine_stage.py) designed for TPU/XLA rather than around CUDA:
 from __future__ import annotations
 
 import itertools
+import os
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -87,6 +88,11 @@ class LLMEngine:
             if params is not None and model not in CONFIGS:
                 # Explicit (e.g. pre-sharded) params: only the config is
                 # needed — don't read gigabytes of weights to drop them.
+                if not os.path.isdir(model):
+                    raise ValueError(
+                        f"model {model!r} is neither a named config "
+                        f"{sorted(CONFIGS)} nor a local checkpoint "
+                        "directory")
                 self.config = ckpt.config_from_hf(model)
             else:
                 loaded, self.config = ckpt.resolve_model(model)

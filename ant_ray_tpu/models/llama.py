@@ -317,6 +317,14 @@ def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
         block = jax.checkpoint(
             block,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+    elif remat == "matmuls":
+        # Saves every matmul output (batch dims included) — in a
+        # transformer block that is all the expensive ops, so backward
+        # recomputes only the elementwise tail.  ~3× the activation HBM
+        # of "full", near-"none" step time; the single-chip bench sweet
+        # spot when "none" OOMs.
+        block = jax.checkpoint(
+            block, policy=jax.checkpoint_policies.dots_saveable)
     elif remat != "none":
         raise ValueError(f"unknown remat policy {remat!r}")
 

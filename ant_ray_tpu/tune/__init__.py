@@ -1,6 +1,19 @@
-"""Hyperparameter tuning (ref capability: ray.tune — Tuner over trial
-tasks with search spaces)."""
+"""Hyperparameter tuning (ref capability: ray.tune — a trial-actor
+controller with searchers and early-stopping/PBT schedulers)."""
 
+from ant_ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ant_ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    Searcher,
+    TPESearcher,
+)
+from ant_ray_tpu.tune.trainable import Trainable
 from ant_ray_tpu.tune.tuner import (
     Result,
     ResultGrid,
@@ -15,8 +28,17 @@ from ant_ray_tpu.tune.tuner import (
 )
 
 __all__ = [
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
     "Result",
     "ResultGrid",
+    "Searcher",
+    "TPESearcher",
+    "Trainable",
+    "TrialScheduler",
     "TuneConfig",
     "Tuner",
     "choice",

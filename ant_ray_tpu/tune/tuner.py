@@ -1,12 +1,16 @@
-"""Tuner: trial generation (grid × random search spaces) + bounded-
-concurrency execution of trials as cluster tasks.
+"""Tuner: the trial-driving controller event loop.
 
-Scaled-down mirror of the reference (SURVEY §2.4 Tune: Tuner →
-TuneController event loop over trial actors, searchers, schedulers): trial
-configs expand from the param space, each trial runs the trainable as a
-task, in-trial ``tune.report`` streams metric rows back with the result,
-and the ResultGrid picks winners.  ASHA-style early stopping and trial
-checkpointing layer on later.
+Mirror of the reference architecture (SURVEY §2.4 Tune: Tuner →
+TuneController event loop over trial actors, searchers, schedulers; ref
+python/ray/tune/execution/tune_controller.py): a Searcher suggests
+configs, each trial runs as an actor stepped by the controller, every
+reported result flows through the TrialScheduler (ASHA / median rule /
+PBT — schedulers.py) which may stop the trial early or, for PBT, clone a
+better trial's checkpoint into it, and the ResultGrid picks winners.
+
+Function trainables are adapted onto the same step() surface by running
+on a thread inside the trial actor (trainable.py) — each ``tune.report``
+call becomes one controller-visible result.
 """
 
 from __future__ import annotations
@@ -101,17 +105,34 @@ def report(metrics: dict) -> None:
     sink.append(dict(metrics))
 
 
-def _run_trial(trainable: Callable, config: dict) -> dict:
-    _trial_reports.sink = []
-    try:
-        returned = trainable(config)
-        reports = _trial_reports.sink
-    finally:
-        _trial_reports.sink = None
-    last = dict(reports[-1]) if reports else {}
-    if isinstance(returned, dict):
-        last.update(returned)
-    return {"config": config, "metrics": last, "history": reports}
+class _TrialActor:
+    """The per-trial actor: hosts one Trainable and exposes the
+    step/save/restore surface the controller drives (ref: the trainable
+    actor in tune_controller.py)."""
+
+    def __init__(self, trainable_cls: type, config: dict):
+        self._cls = trainable_cls
+        self._config = dict(config)
+        self._t = trainable_cls()
+        self._t.setup(dict(config))
+
+    def step(self) -> dict:
+        return self._t.step()
+
+    def save(self):
+        return self._t.save_checkpoint()
+
+    def restore(self, state, config: dict | None = None) -> None:
+        if config is not None and config != self._config:
+            if not self._t.reset_config(dict(config)):
+                self._t.cleanup()
+                self._t = self._cls()
+                self._t.setup(dict(config))
+            self._config = dict(config)
+        self._t.load_checkpoint(state)
+
+    def shutdown(self) -> None:
+        self._t.cleanup()
 
 
 # ------------------------------------------------------------ results
@@ -166,46 +187,144 @@ class TuneConfig:
     mode: str = "min"
     seed: int | None = None
     resources_per_trial: dict = field(default_factory=dict)
+    scheduler: Any = None                # TrialScheduler (schedulers.py)
+    search_alg: Any = None               # Searcher (search.py)
+    stop: dict | None = None             # e.g. {"training_iteration": 8}
+
+
+@dataclass
+class _Trial:
+    id: str
+    config: dict
+    actor: Any
+    iter: int = 0
+    history: list = field(default_factory=list)
+    last: dict = field(default_factory=dict)
 
 
 class Tuner:
     """(ref: python/ray/tune/tuner.py:43)"""
 
-    def __init__(self, trainable: Callable, *, param_space: dict,
+    def __init__(self, trainable, *, param_space: dict | None = None,
                  tune_config: TuneConfig | None = None):
         self._trainable = trainable
-        self._param_space = dict(param_space)
+        self._param_space = dict(param_space or {})
         self._config = tune_config or TuneConfig()
+
+    def _trainable_cls(self) -> type:
+        from ant_ray_tpu.tune.trainable import Trainable, wrap_function  # noqa: PLC0415
+
+        if isinstance(self._trainable, type) and \
+                issubclass(self._trainable, Trainable):
+            return self._trainable
+        if callable(self._trainable):
+            return wrap_function(self._trainable)
+        raise TypeError(f"trainable must be a callable or Trainable "
+                        f"subclass, got {type(self._trainable)}")
 
     def fit(self) -> ResultGrid:
         import ant_ray_tpu as art  # noqa: PLC0415
+        from ant_ray_tpu.tune import schedulers as _sched  # noqa: PLC0415
+        from ant_ray_tpu.tune.search import BasicVariantGenerator  # noqa: PLC0415
+        from ant_ray_tpu.tune.trainable import DONE, RETURN  # noqa: PLC0415
 
         if not art.is_initialized():
             art.init()
-        configs = expand_param_space(
-            self._param_space, self._config.num_samples, self._config.seed)
-        run_remote = art.remote(_run_trial).options(
-            **({"resources": self._config.resources_per_trial}
-               if self._config.resources_per_trial else {}))
+        cfg = self._config
+        searcher = cfg.search_alg or BasicVariantGenerator(
+            self._param_space, cfg.num_samples, cfg.seed)
+        scheduler = cfg.scheduler or _sched.FIFOScheduler()
+        trainable_cls = self._trainable_cls()
+        actor_opts = ({"resources": cfg.resources_per_trial}
+                      if cfg.resources_per_trial else {})
+        actor_cls = art.remote(_TrialActor).options(**actor_opts)
 
-        max_conc = self._config.max_concurrent_trials or len(configs)
-        pending = list(configs)
-        running: dict = {}
+        max_conc = cfg.max_concurrent_trials or 16
         results: list[Result] = []
-        while pending or running:
-            while pending and len(running) < max_conc:
-                config = pending.pop(0)
-                ref = run_remote.remote(self._trainable, config)
-                running[ref] = config
-            ready, _ = art.wait(list(running), num_returns=1, timeout=300)
+        trials: dict[str, _Trial] = {}        # running, by id
+        step_refs: dict = {}                  # outstanding step ref → id
+        exhausted = False
+        next_id = 0
+
+        def _launch() -> bool:
+            nonlocal next_id, exhausted
+            tid = f"trial_{next_id}"
+            config = searcher.suggest(tid)
+            if config is None:
+                exhausted = True
+                return False
+            next_id += 1
+            actor = actor_cls.remote(trainable_cls, config)
+            trial = _Trial(id=tid, config=config, actor=actor)
+            trials[tid] = trial
+            scheduler.on_trial_add(tid, config)
+            step_refs[actor.step.remote()] = tid
+            return True
+
+        def _finish(trial: _Trial, *, error: Exception | None = None):
+            trials.pop(trial.id, None)
+            scheduler.on_trial_complete(trial.id,
+                                        None if error else trial.last)
+            searcher.on_trial_complete(trial.id,
+                                       None if error else trial.last,
+                                       error=error is not None)
+            results.append(Result(config=trial.config, metrics=trial.last
+                                  if error is None else {},
+                                  history=trial.history, error=error))
+            try:
+                art.kill(trial.actor)
+            except Exception:  # noqa: BLE001 — already dead is fine
+                pass
+
+        def _should_stop(trial: _Trial, result: dict) -> bool:
+            for key, bound in (cfg.stop or {}).items():
+                if result.get(key) is not None and result[key] >= bound:
+                    return True
+            return False
+
+        while not exhausted or step_refs:
+            while not exhausted and len(trials) < max_conc:
+                if not _launch():
+                    break
+            if not step_refs:
+                break
+            ready, _ = art.wait(list(step_refs), num_returns=1, timeout=300)
             for ref in ready:
-                config = running.pop(ref)
+                tid = step_refs.pop(ref)
+                trial = trials.get(tid)
+                if trial is None:
+                    continue
                 try:
-                    out = art.get(ref)
-                    results.append(Result(config=out["config"],
-                                          metrics=out["metrics"],
-                                          history=out["history"]))
+                    result = art.get(ref)
                 except Exception as e:  # noqa: BLE001 — trial failure
-                    results.append(Result(config=config, metrics={},
-                                          error=e))
+                    _finish(trial, error=e)
+                    continue
+                if result.get(DONE):
+                    ret = result.get(RETURN)
+                    if isinstance(ret, dict):
+                        trial.last = {**trial.last, **ret}
+                    _finish(trial)
+                    continue
+                trial.iter += 1
+                result.setdefault("training_iteration", trial.iter)
+                trial.history.append(dict(result))
+                trial.last = dict(result)
+                if _should_stop(trial, result):
+                    _finish(trial)
+                    continue
+                decision = scheduler.on_trial_result(tid, result)
+                if decision == _sched.STOP:
+                    _finish(trial)
+                    continue
+                if isinstance(decision, _sched.Exploit):
+                    source = trials.get(decision.source_trial_id)
+                    if source is not None:
+                        try:
+                            state = art.get(source.actor.save.remote())
+                            art.get(trial.actor.restore.remote(
+                                state, decision.config))
+                            trial.config = decision.config
+                        except Exception:  # noqa: BLE001 — skip exploit,
+                            pass           # keep training as-is
+                step_refs[trial.actor.step.remote()] = tid
         return ResultGrid(results)

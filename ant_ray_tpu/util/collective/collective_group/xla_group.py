@@ -298,17 +298,25 @@ class XLAGroup(BaseGroup):
         blob = pickle.dumps(np.asarray(tensors[0]), protocol=5)
         gcs = global_worker.runtime._gcs
         gcs.call("KVPut", {"key": key, "value": blob}, retries=3)
-        # Block until the receiver consumed it (deletes the key) — send
-        # is synchronous like the reference's.  The sequence advances
-        # only on success, and a timed-out blob is withdrawn, so one
-        # failure never desyncs the pair.
+        # Block until the receiver consumed it (took the key) — send is
+        # synchronous like the reference's.  At the deadline the sender
+        # tries to withdraw the blob with KVDel; the receiver consumes
+        # with atomic KVTake, so exactly one side wins: if the withdraw
+        # finds the key already gone, the message WAS delivered and the
+        # send succeeds (sequence advances) — a timeout can therefore
+        # never desync the pair.
         deadline = _time.monotonic() + opts.timeout_ms / 1000.0
+        poll = 0.002
         while _time.monotonic() < deadline:
             if gcs.call("KVGet", {"key": key}, retries=3) is None:
                 setattr(self, seq_attr, seq + 1)
                 return
-            _time.sleep(0.005)
-        gcs.call("KVDel", {"key": key}, retries=3)
+            _time.sleep(poll)
+            poll = min(poll * 2, 0.05)  # backoff: bounded GCS RPC rate
+        withdrawn = gcs.call("KVDel", {"key": key}, retries=3)
+        if not withdrawn:  # receiver took it at the wire — delivered
+            setattr(self, seq_attr, seq + 1)
+            return
         raise TimeoutError(
             f"send to rank {opts.dst_rank} not consumed in time")
 
@@ -323,13 +331,14 @@ class XLAGroup(BaseGroup):
         key = self._mailbox_key(opts.src_rank, self._rank, seq)
         gcs = global_worker.runtime._gcs
         deadline = _time.monotonic() + opts.timeout_ms / 1000.0
+        poll = 0.002
         while _time.monotonic() < deadline:
-            blob = gcs.call("KVGet", {"key": key}, retries=3)
-            if blob is not None:
-                gcs.call("KVDel", {"key": key}, retries=3)
+            blob = gcs.call("KVTake", {"key": key}, retries=3)
+            if blob is not None:  # atomic take: beat any sender withdraw
                 setattr(self, seq_attr, seq + 1)  # success only
                 return [pickle.loads(blob)]
-            _time.sleep(0.005)
+            _time.sleep(poll)
+            poll = min(poll * 2, 0.05)  # backoff: bounded GCS RPC rate
         raise TimeoutError(
             f"recv from rank {opts.src_rank} timed out")
 

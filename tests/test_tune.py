@@ -79,3 +79,100 @@ def test_random_sampling_num_samples(cluster):
         param_space={"lr": tune.uniform(0, 1)},
         tune_config=tune.TuneConfig(num_samples=6, seed=1)).fit()
     assert len(grid) == 6
+
+
+# ---------------------------------------------------- schedulers/searchers
+
+
+class _StepDecay(tune.Trainable):
+    """loss = offset + 1/iter — trials with larger offset are strictly
+    worse at every iteration, the shape ASHA separates immediately."""
+
+    def setup(self, config):
+        self.offset = config["offset"]
+        self.iter = 0
+
+    def step(self):
+        self.iter += 1
+        return {"loss": self.offset + 1.0 / self.iter}
+
+    def save_checkpoint(self):
+        return {"iter": self.iter, "offset": self.offset}
+
+    def load_checkpoint(self, state):
+        self.iter = state["iter"]
+        self.offset = state["offset"]
+
+
+def test_asha_stops_bad_trials_early(cluster):
+    tuner = tune.Tuner(
+        _StepDecay,
+        param_space={"offset": tune.grid_search([0.0, 1.0, 2.0, 3.0])},
+        tune_config=tune.TuneConfig(
+            stop={"training_iteration": 12},
+            scheduler=tune.AsyncHyperBandScheduler(
+                metric="loss", mode="min", max_t=12, grace_period=2,
+                reduction_factor=2),
+        ))
+    grid = tuner.fit()
+    assert not grid.errors
+    best = grid.get_best_result("loss", mode="min")
+    assert best.config["offset"] == 0.0
+    iters = {r.config["offset"]: len(r.history) for r in grid}
+    # the best trial ran to the stop bound; the worst was culled early
+    assert iters[0.0] == 12
+    assert iters[3.0] < 12
+
+
+def test_median_stopping_rule_decisions():
+    rule = tune.MedianStoppingRule(metric="score", mode="max",
+                                   grace_period=2, min_samples_required=2)
+    # three trials report at iteration 3: two good, one bad
+    for tid, score in (("a", 10.0), ("b", 9.0)):
+        for it in (1, 2, 3):
+            assert rule.on_trial_result(
+                tid, {"score": score, "training_iteration": it}) \
+                == "CONTINUE"
+    decision = rule.on_trial_result(
+        "c", {"score": 1.0, "training_iteration": 3})
+    assert decision == "STOP"
+
+
+def test_pbt_exploits_checkpoint_and_mutates_config(cluster):
+    tuner = tune.Tuner(
+        _StepDecay,
+        param_space={"offset": tune.grid_search([0.0, 5.0])},
+        tune_config=tune.TuneConfig(
+            stop={"training_iteration": 8},
+            scheduler=tune.PopulationBasedTraining(
+                metric="loss", mode="min", perturbation_interval=3,
+                quantile_fraction=0.5,
+                hyperparam_mutations={"offset": [0.0, 5.0]}, seed=0),
+        ))
+    grid = tuner.fit()
+    assert not grid.errors
+    # The offset=5 trial exploited the offset=0 trial: its checkpoint
+    # (and thus its offset attribute) was cloned, so its final loss is
+    # far below what offset=5 could ever reach (minimum 5.125).
+    worst_start = min(r.metrics["loss"] for r in grid)
+    assert worst_start < 5.0
+    assert all(r.metrics["loss"] < 5.0 for r in grid)
+
+
+def test_tpe_searcher_beats_random_on_quadratic(cluster):
+    space = {"x": tune.uniform(-10.0, 10.0)}
+
+    def objective(config):
+        tune.report({"loss": (config["x"] - 3.0) ** 2})
+
+    tuner = tune.Tuner(
+        objective, param_space=space,
+        tune_config=tune.TuneConfig(
+            search_alg=tune.TPESearcher(
+                space, metric="loss", mode="min", num_samples=30,
+                n_initial=8, seed=0),
+            max_concurrent_trials=4))
+    grid = tuner.fit()
+    assert len(grid) == 30
+    best = grid.get_best_result("loss", mode="min")
+    assert abs(best.config["x"] - 3.0) < 1.5
