@@ -200,10 +200,43 @@ def save_params(params: dict, path: str) -> None:
     np.savez(path, **flat)
 
 
+def save_params_with_config(params: dict, path: str,
+                            config: LlamaConfig) -> None:
+    """save_params plus the head-split metadata load_params validates.
+
+    Projection shapes alone cannot distinguish head splits (16×64 and
+    8×128 heads both give a (dim, dim) wq), so a checkpoint loaded
+    under the wrong split would silently scramble the head structure.
+    """
+    flat = {"__head_split__": np.asarray(
+        [config.n_heads, config.n_kv_heads, config.head_dim])}
+
+    def walk(tree, prefix):
+        for key, value in tree.items():
+            name = f"{prefix}{key}"
+            if isinstance(value, dict):
+                walk(value, name + ".")
+            else:
+                flat[name] = np.asarray(value)
+
+    walk(params, "")
+    np.savez(path, **flat)
+
+
 def load_params(path: str, config: LlamaConfig) -> dict:
     data = np.load(path)
     params: dict = {}
     for name in data.files:
+        if name == "__head_split__":
+            saved = tuple(int(x) for x in data[name])
+            want = (config.n_heads, config.n_kv_heads, config.head_dim)
+            if saved != want:
+                raise ValueError(
+                    f"checkpoint head split (n_heads, n_kv_heads, "
+                    f"head_dim)={saved} does not match the target "
+                    f"config {want} — same tensor shapes, different "
+                    "head structure; loading would scramble attention")
+            continue
         parts = name.split(".")
         node = params
         for part in parts[:-1]:

@@ -78,9 +78,12 @@ CONFIGS: dict[str, LlamaConfig] = {
     "llama3-1b": LlamaConfig(
         vocab_size=128256, dim=2048, n_layers=16, n_heads=32, n_kv_heads=8,
         mlp_dim=8192, max_seq=8192),
-    # small enough to train on one v5e chip (bench fallback)
+    # small enough to train on one v5e chip (bench fallback).
+    # head_dim=128 (not 64): the MXU contracts 128 lanes per pass, so
+    # 64-deep attention matmuls run the array half-empty — measured 1.8×
+    # slower end-to-end.  Matches Llama-3's head_dim at every scale.
     "llama-400m": LlamaConfig(
-        vocab_size=32768, dim=1024, n_layers=24, n_heads=16, n_kv_heads=8,
+        vocab_size=32768, dim=1024, n_layers=24, n_heads=8, n_kv_heads=4,
         mlp_dim=4096, max_seq=4096),
     "tiny": LlamaConfig(
         vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
@@ -318,13 +321,16 @@ def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
             block,
             policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
     elif remat == "matmuls":
-        # Saves every matmul output (batch dims included) — in a
+        # Saves every matmul output (batch dims included) plus the flash
+        # kernel's named residuals (attention output + logsumexp) — in a
         # transformer block that is all the expensive ops, so backward
-        # recomputes only the elementwise tail.  ~3× the activation HBM
-        # of "full", near-"none" step time; the single-chip bench sweet
-        # spot when "none" OOMs.
-        block = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.dots_saveable)
+        # recomputes only the elementwise tail and never re-runs the
+        # attention forward.  ~3× the activation HBM of "full",
+        # near-"none" step time; the single-chip bench sweet spot when
+        # "none" OOMs.
+        from ant_ray_tpu.ops.attention import saveable_attention_policy  # noqa: PLC0415
+
+        block = jax.checkpoint(block, policy=saveable_attention_policy())
     elif remat != "none":
         raise ValueError(f"unknown remat policy {remat!r}")
 

@@ -97,25 +97,46 @@ def _pallas_available() -> bool:
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash_with_blockwise_bwd(q, k, v, causal, scale):
+def _flash(q, k, v, causal, scale):
     from ant_ray_tpu.ops.pallas.flash_attention import flash_attention_forward  # noqa: PLC0415
 
     return flash_attention_forward(q, k, v, causal=causal, scale=scale)
 
 
 def _flash_fwd(q, k, v, causal, scale):
-    return _flash_with_blockwise_bwd(q, k, v, causal, scale), (q, k, v)
+    from ant_ray_tpu.ops.pallas.flash_attention import flash_attention_fwd_lse  # noqa: PLC0415
+
+    from jax.ad_checkpoint import checkpoint_name  # noqa: PLC0415
+
+    out, lse = flash_attention_fwd_lse(q, k, v, causal=causal, scale=scale)
+    # Named so remat policies can keep the attention output + softmax
+    # stats without saving (or recomputing) anything inside the kernel:
+    # saveable_attention_policy() below matches these names.
+    out = checkpoint_name(out, "attn_out")
+    lse = checkpoint_name(lse, "attn_lse")
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, scale, residuals, g):
-    q, k, v = residuals
-    _out, vjp = jax.vjp(
-        lambda q_, k_, v_: blockwise_attention(q_, k_, v_, causal=causal,
-                                               scale=scale), q, k, v)
-    return vjp(g)
+    from ant_ray_tpu.ops.pallas.flash_attention import flash_attention_backward  # noqa: PLC0415
+
+    q, k, v, out, lse = residuals
+    return flash_attention_backward(q, k, v, out, lse, g, causal=causal,
+                                    scale=scale)
 
 
-_flash_with_blockwise_bwd.defvjp(_flash_fwd, _flash_bwd)
+_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash_with_blockwise_bwd = _flash  # back-compat alias
+
+
+def saveable_attention_policy():
+    """Remat policy: save matmul outputs AND the flash kernel's named
+    residuals (attention output + logsumexp), so the backward pass never
+    re-runs the attention forward.  Combine with jax.checkpoint."""
+    cp = jax.checkpoint_policies
+    return cp.save_from_both_policies(
+        cp.dots_saveable,
+        cp.save_only_these_names("attn_out", "attn_lse"))
 
 
 def attention(q, k, v, *, causal: bool = True, scale: float | None = None,

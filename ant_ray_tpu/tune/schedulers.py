@@ -68,6 +68,12 @@ class AsyncHyperBandScheduler(TrialScheduler):
                  time_attr: str = "training_iteration"):
         if mode not in ("min", "max"):
             raise ValueError(f"mode must be min|max, got {mode!r}")
+        if reduction_factor <= 1:
+            raise ValueError("reduction_factor must be > 1 "
+                             f"(got {reduction_factor})")
+        if grace_period < 1 or max_t < 1:
+            raise ValueError("grace_period and max_t must be >= 1 "
+                             f"(got {grace_period}, {max_t})")
         self._metric, self._mode, self._time_attr = metric, mode, time_attr
         self._rf = reduction_factor
         # Rung levels, ascending, excluding max_t itself.
@@ -183,8 +189,14 @@ class PopulationBasedTraining(TrialScheduler):
             return CONTINUE
         source = self._rng.choice(upper)
         new_config = self._explore(self._configs[source])
-        self._configs[trial_id] = new_config
+        # The config record is updated only when the controller confirms
+        # the exploit (on_exploit_applied) — a failed checkpoint clone
+        # must not leave the bookkeeping claiming a config the trial
+        # never received.
         return Exploit(source_trial_id=source, config=new_config)
+
+    def on_exploit_applied(self, trial_id: str, config: dict) -> None:
+        self._configs[trial_id] = dict(config)
 
     def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
         self._scores.pop(trial_id, None)

@@ -16,10 +16,13 @@ call becomes one controller-visible result.
 from __future__ import annotations
 
 import itertools
+import logging
 import random as _random
 import threading
 from dataclasses import dataclass, field
 from typing import Any, Callable
+
+logger = logging.getLogger(__name__)
 
 
 # ------------------------------------------------------------ search space
@@ -235,6 +238,14 @@ class Tuner:
             self._param_space, cfg.num_samples, cfg.seed)
         scheduler = cfg.scheduler or _sched.FIFOScheduler()
         trainable_cls = self._trainable_cls()
+        from ant_ray_tpu.tune.trainable import FunctionTrainable  # noqa: PLC0415
+
+        if isinstance(scheduler, _sched.PopulationBasedTraining) and \
+                issubclass(trainable_cls, FunctionTrainable):
+            raise ValueError(
+                "PopulationBasedTraining exploits trial checkpoints — it "
+                "requires a class Trainable implementing save_checkpoint/"
+                "load_checkpoint, not a function trainable")
         actor_opts = ({"resources": cfg.resources_per_trial}
                       if cfg.resources_per_trial else {})
         actor_cls = art.remote(_TrialActor).options(**actor_opts)
@@ -324,7 +335,14 @@ class Tuner:
                             art.get(trial.actor.restore.remote(
                                 state, decision.config))
                             trial.config = decision.config
-                        except Exception:  # noqa: BLE001 — skip exploit,
-                            pass           # keep training as-is
+                            applied = getattr(scheduler,
+                                              "on_exploit_applied", None)
+                            if applied is not None:
+                                applied(tid, decision.config)
+                        except Exception as e:  # noqa: BLE001
+                            logger.warning(
+                                "PBT exploit of %s from %s failed "
+                                "(%r); trial continues unperturbed",
+                                tid, decision.source_trial_id, e)
                 step_refs[trial.actor.step.remote()] = tid
         return ResultGrid(results)

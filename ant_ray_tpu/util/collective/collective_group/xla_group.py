@@ -297,25 +297,34 @@ class XLAGroup(BaseGroup):
         key = self._mailbox_key(self._rank, opts.dst_rank, seq)
         blob = pickle.dumps(np.asarray(tensors[0]), protocol=5)
         gcs = global_worker.runtime._gcs
+        # Exchange protocol (retry-safe): the outcome of each sequence
+        # number is decided exactly once by a put-if-absent race on an
+        # arbitration key — "delivered" (receiver claims after reading
+        # the blob) vs "withdrawn" (sender claims at its deadline).
+        # Every operation either is idempotent (KVGet, re-KVPut of the
+        # same value) or resolves ambiguity by re-reading the
+        # arbitration key, so an RPC connection retry can never lose a
+        # message or desync the pair's sequence numbers.  Keys for
+        # seq-2 are garbage-collected here — by the time seq N+2 is
+        # sent, the receiver has fully finished seq N.
+        arb = key + ":arb"
+        for stale_seq in (seq - 2,) if seq >= 2 else ():
+            stale = self._mailbox_key(self._rank, opts.dst_rank, stale_seq)
+            gcs.call("KVDel", {"key": stale}, retries=3)
+            gcs.call("KVDel", {"key": stale + ":arb"}, retries=3)
         gcs.call("KVPut", {"key": key, "value": blob}, retries=3)
-        # Block until the receiver consumed it (took the key) — send is
-        # synchronous like the reference's.  At the deadline the sender
-        # tries to withdraw the blob with KVDel; the receiver consumes
-        # with atomic KVTake, so exactly one side wins: if the withdraw
-        # finds the key already gone, the message WAS delivered and the
-        # send succeeds (sequence advances) — a timeout can therefore
-        # never desync the pair.
         deadline = _time.monotonic() + opts.timeout_ms / 1000.0
         poll = 0.002
         while _time.monotonic() < deadline:
-            if gcs.call("KVGet", {"key": key}, retries=3) is None:
+            if gcs.call("KVGet", {"key": arb}, retries=3) == b"delivered":
                 setattr(self, seq_attr, seq + 1)
                 return
             _time.sleep(poll)
             poll = min(poll * 2, 0.05)  # backoff: bounded GCS RPC rate
-        withdrawn = gcs.call("KVDel", {"key": key}, retries=3)
-        if not withdrawn:  # receiver took it at the wire — delivered
-            setattr(self, seq_attr, seq + 1)
+        gcs.call("KVPut", {"key": arb, "value": b"withdrawn",
+                           "overwrite": False}, retries=3)
+        if gcs.call("KVGet", {"key": arb}, retries=3) == b"delivered":
+            setattr(self, seq_attr, seq + 1)  # receiver won at the wire
             return
         raise TimeoutError(
             f"send to rank {opts.dst_rank} not consumed in time")
@@ -330,13 +339,25 @@ class XLAGroup(BaseGroup):
         seq = getattr(self, seq_attr, 0)
         key = self._mailbox_key(opts.src_rank, self._rank, seq)
         gcs = global_worker.runtime._gcs
+        arb = key + ":arb"
         deadline = _time.monotonic() + opts.timeout_ms / 1000.0
         poll = 0.002
         while _time.monotonic() < deadline:
-            blob = gcs.call("KVTake", {"key": key}, retries=3)
-            if blob is not None:  # atomic take: beat any sender withdraw
-                setattr(self, seq_attr, seq + 1)  # success only
-                return [pickle.loads(blob)]
+            blob = gcs.call("KVGet", {"key": key}, retries=3)
+            if blob is not None:
+                # Claim delivery via put-if-absent on the arbitration
+                # key; on a lost reply the re-read below resolves who
+                # won (see the protocol note in send()).
+                won = gcs.call("KVPut", {"key": arb, "value": b"delivered",
+                                         "overwrite": False}, retries=3)
+                verdict = (b"delivered" if won else
+                           gcs.call("KVGet", {"key": arb}, retries=3))
+                if verdict == b"delivered":
+                    setattr(self, seq_attr, seq + 1)  # success only
+                    return [pickle.loads(blob)]
+                # "withdrawn": the sender gave up on this seq — it will
+                # not advance; fall through to our own timeout so the
+                # pair stays in step.
             _time.sleep(poll)
             poll = min(poll * 2, 0.05)  # backoff: bounded GCS RPC rate
         raise TimeoutError(

@@ -35,6 +35,12 @@ _TRANSIENT_MARKERS = (
     "backend setup/compile error", "Socket closed", "Connection reset",
 )
 _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Out of memory", "OOM")
+# The axon remote-compile helper dies (HTTP 500, subprocess exit 1)
+# instead of reporting RESOURCE_EXHAUSTED when a program's buffer
+# assignment exceeds HBM — treat it like OOM and fall through to a
+# cheaper plan rather than aborting the attempt.
+_PLAN_FAIL_MARKERS = _OOM_MARKERS + (
+    "remote_compile", "tpu_compile_helper", "HTTP 500")
 
 
 def measure(remat: str, batch_scale: float):
@@ -120,7 +126,12 @@ def run_child() -> None:
     """Run one measurement; falls back through remat policies / batch on
     OOM inside this process (backend is known-alive once the first
     compile succeeds)."""
-    plans = [("none", 1.0), ("dots", 1.0), ("full", 1.0), ("full", 0.5)]
+    # "matmuls" (dots_saveable + saved flash residuals) measured best on
+    # v5e: no backward recompute, fits HBM at batch 8.  "none" is
+    # deliberately absent — it OOMs at 400m/batch-8 and the failed
+    # compile costs a full helper round-trip.
+    plans = [("matmuls", 1.0), ("full", 1.0), ("full", 0.5),
+             ("matmuls", 0.25)]
     last_err = None
     for remat, scale in plans:
         try:
@@ -130,7 +141,7 @@ def run_child() -> None:
         except Exception as e:  # noqa: BLE001
             msg = repr(e)
             last_err = msg
-            if any(m in msg for m in _OOM_MARKERS):
+            if any(m in msg for m in _PLAN_FAIL_MARKERS):
                 continue  # next (cheaper) plan
             break  # non-OOM: report it — parent decides about retry
     print(json.dumps({"metric": "bench_error", "value": 0.0, "unit": "MFU",

@@ -163,3 +163,23 @@ def test_engine_loads_checkpoint_dir(tmp_path):
     _assert_trees_equal(engine.params, expected)
     out = engine.generate(["ab"], SamplingParams(max_tokens=3))[0]
     assert 1 <= len(out.token_ids) <= 3
+
+def test_head_split_metadata_rejects_mismatch(tmp_path):
+    """Same tensor shapes, different head split → loud error, not a
+    silently scrambled attention (16×64 vs 8×128 heads both give a
+    (dim, dim) wq)."""
+    import jax
+
+    cfg_a = llama.LlamaConfig(vocab_size=256, dim=256, n_layers=1,
+                              n_heads=4, n_kv_heads=2, mlp_dim=256,
+                              max_seq=128)
+    cfg_b = llama.LlamaConfig(vocab_size=256, dim=256, n_layers=1,
+                              n_heads=2, n_kv_heads=1, mlp_dim=256,
+                              max_seq=128)
+    params = llama.init_params(cfg_a, jax.random.PRNGKey(0))
+    path = str(tmp_path / "p.npz")
+    checkpoint.save_params_with_config(params, path, cfg_a)
+    # same config loads fine
+    checkpoint.load_params(path, cfg_a)
+    with pytest.raises(ValueError, match="head split"):
+        checkpoint.load_params(path, cfg_b)
