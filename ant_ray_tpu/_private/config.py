@@ -86,6 +86,12 @@ class Config:
     # flags, ray_config_def.ant.h).
     vc_fence_ttl_s: float = 5.0
 
+    # ---- autoscaler ----
+    # How long an infeasible task waits for the autoscaler to provision
+    # a node before failing (only applies while an autoscaler heartbeat
+    # is live; without one infeasible fails fast).
+    infeasible_wait_s: float = 300.0
+
     # ---- rpc ----
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 60.0

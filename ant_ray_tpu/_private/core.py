@@ -798,6 +798,20 @@ class ClusterRuntime(CoreRuntime):
                 f"{len(cached)} bundles")
         return self._clients.get(cached[bundle_index])
 
+    async def _autoscaling_enabled(self) -> bool:
+        """Cached (10s) GCS check for a live autoscaler heartbeat."""
+        now = time.monotonic()
+        cached = getattr(self, "_autoscaling_cache", None)
+        if cached is not None and now - cached[1] < 10.0:
+            return cached[0]
+        try:
+            enabled = bool(await self._gcs.call_async(
+                "AutoscalingEnabled", {}, timeout=5))
+        except Exception:  # noqa: BLE001 — GCS briefly away: fail fast
+            enabled = False
+        self._autoscaling_cache = (enabled, now)
+        return enabled
+
     async def _lease_and_push(self, spec: TaskSpec) -> dict:
         """Lease a worker (following spillback redirects), push the task,
         return the worker reply (ref: NormalTaskSubmitter::SubmitTask)."""
@@ -812,7 +826,10 @@ class ClusterRuntime(CoreRuntime):
                                    spec.placement_group_bundle_index)
         else:
             node = self._node
-        for _hop in range(16):
+        infeasible_deadline: float | None = None
+        hops = 0
+        while hops < 16:
+            hops += 1
             reply = await node.call_async(
                 "LeaseWorker", lease_payload, timeout=-1)
             if "granted" in reply:
@@ -832,6 +849,18 @@ class ClusterRuntime(CoreRuntime):
             elif "spill" in reply:
                 node = self._clients.get(reply["spill"])
             elif "infeasible" in reply:
+                # With a live autoscaler the recorded demand may
+                # provision a node — wait and retry instead of failing
+                # (ref: infeasible tasks queue until the autoscaler
+                # satisfies them).  Without one, fail fast as before.
+                if await self._autoscaling_enabled():
+                    if infeasible_deadline is None:
+                        infeasible_deadline = time.monotonic() + \
+                            global_config().infeasible_wait_s
+                    if time.monotonic() < infeasible_deadline:
+                        hops -= 1  # waiting is not a spillback hop
+                        await asyncio.sleep(1.0)
+                        continue
                 raise exceptions.ArtError(
                     f"task {spec.function_name} requests resources "
                     f"{spec.resources} that no node can ever satisfy")

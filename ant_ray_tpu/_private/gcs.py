@@ -11,6 +11,7 @@ abstraction for Redis persistence comes with HA).
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import os
 import time
@@ -85,6 +86,12 @@ class GcsServer:
         self._pub_seq = 0
         self._pub_cond: asyncio.Condition | None = None  # lazy (io loop)
         self._pub_notify_pending = False
+        # Unfulfilled scheduling demands (autoscaler input): canonical
+        # (resources, selector) -> {count, first_seen, last_seen}.
+        self._demands: dict[str, dict] = {}
+        # None until the first heartbeat — 0.0 would read as "recently
+        # seen" on a host whose monotonic clock is near boot.
+        self._autoscaler_seen: float | None = None
         self._clients = ClientPool()
         self._io = IoThread.get()
         self._health_task = None
@@ -115,6 +122,9 @@ class GcsServer:
             "ObjectLocationsGet": self._object_locations_get,
             "FreeObject": self._free_object,
             "SelectNode": self._select_node,
+            "ResourceDemands": self._resource_demands,
+            "AutoscalerHeartbeat": self._autoscaler_heartbeat,
+            "AutoscalingEnabled": self._autoscaling_enabled,
             "ClusterResources": self._cluster_resources,
             "AvailableResources": self._available_resources,
             "CreatePlacementGroup": self._create_placement_group,
@@ -615,7 +625,14 @@ class GcsServer:
     async def _schedule_actor_inner(self, record: ActorRecord):
         spec = record.spec
         placement = spec.placement_resources or spec.resources
-        for _attempt in range(60):
+        start = time.monotonic()
+        while True:
+            # 30s without a feasible node kills the actor — unless an
+            # autoscaler is alive, in which case the recorded demand may
+            # provision one (give it the reference's 10-minute window).
+            limit = 600.0 if self._has_live_autoscaler() else 30.0
+            if time.monotonic() - start > limit:
+                break
             if spec.placement_group_id is not None:
                 node = self._pg_bundle_node(
                     spec.placement_group_id,
@@ -636,6 +653,9 @@ class GcsServer:
                     logger.warning("actor %s placement on %s failed: %s",
                                    spec.actor_id.hex()[:8],
                                    node.node_id.hex()[:8], e)
+            elif spec.placement_group_id is None:
+                # Unplaceable actor: surface the shape to the autoscaler.
+                self._record_demand(placement, spec.label_selector)
             await asyncio.sleep(0.5)
         record.state = ACTOR_DEAD
         record.death_reason = "no node with required resources"
@@ -1143,7 +1163,55 @@ class GcsServer:
 
         # Prefer a node that can run now; fall back to one that is merely
         # busy (the lease queues there) before declaring infeasibility.
-        return _excluding(True) or _excluding(False)
+        node = _excluding(True) or _excluding(False)
+        if node is None:
+            self._record_demand(resources, selector)
+        return node
+
+    # ---------------------------------------------- autoscaler surface
+    # (ref: the v2 autoscaler's cluster-status input —
+    # python/ray/autoscaler/v2/autoscaler.py:50; demand shapes come
+    # from SelectNode misses the way the reference's come from the
+    # resource-demand scheduler reports.)
+
+    _DEMAND_TTL_S = 60.0
+
+    def _record_demand(self, resources: dict, selector: dict | None):
+        key = json.dumps([sorted(resources.items()),
+                          sorted((selector or {}).items())])
+        now = time.monotonic()
+        entry = self._demands.get(key)
+        if entry is None:
+            self._demands[key] = {
+                "resources": dict(resources),
+                "label_selector": dict(selector or {}),
+                "count": 1, "first_seen": now, "last_seen": now}
+        else:
+            entry["count"] += 1
+            entry["last_seen"] = now
+
+    async def _resource_demands(self, _payload):
+        now = time.monotonic()
+        for key in [k for k, e in self._demands.items()
+                    if now - e["last_seen"] > self._DEMAND_TTL_S]:
+            del self._demands[key]
+        return [{"resources": e["resources"],
+                 "label_selector": e["label_selector"],
+                 "count": e["count"],
+                 "age_s": now - e["first_seen"],
+                 "idle_s": now - e["last_seen"]}
+                for e in self._demands.values()]
+
+    async def _autoscaler_heartbeat(self, _payload):
+        self._autoscaler_seen = time.monotonic()
+        return True
+
+    async def _autoscaling_enabled(self, _payload):
+        return self._has_live_autoscaler()
+
+    def _has_live_autoscaler(self) -> bool:
+        return (self._autoscaler_seen is not None
+                and time.monotonic() - self._autoscaler_seen < 30.0)
 
     async def _cluster_resources(self, _payload):
         totals: dict[str, float] = {}

@@ -1,0 +1,19 @@
+"""Autoscaler (ref capability: ray.autoscaler v2 — demand-driven node
+provisioning over pluggable node providers)."""
+
+from ant_ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
+from ant_ray_tpu.autoscaler.node_provider import (
+    GkeTpuNodePoolProvider,
+    LocalSubprocessProvider,
+    NodeProvider,
+    NodeTypeConfig,
+)
+
+__all__ = [
+    "Autoscaler",
+    "AutoscalerConfig",
+    "GkeTpuNodePoolProvider",
+    "LocalSubprocessProvider",
+    "NodeProvider",
+    "NodeTypeConfig",
+]

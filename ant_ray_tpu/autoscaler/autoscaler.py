@@ -1,0 +1,248 @@
+"""The autoscaler control loop.
+
+Re-design of the reference's v2 autoscaler (ref:
+python/ray/autoscaler/v2/autoscaler.py:50 — Reconciler over cluster
+status + instance manager + scheduler) on this framework's primitives:
+
+* **input**: the GCS's unfulfilled-demand table (``ResourceDemands`` —
+  recorded on every SelectNode / actor-scheduling miss) plus the live
+  node table (``GetAllNodes``);
+* **decision**: first-fit bin-packing of demand shapes onto configured
+  node types, bounded by per-type max_workers; min_workers backfill;
+  idle-node termination after ``idle_timeout_s`` (only nodes this
+  autoscaler launched — the head and statically-provisioned nodes are
+  never touched);
+* **actuation**: a NodeProvider (node_provider.py).
+
+Run it in-process (``start()`` spawns the loop thread next to the
+driver/head) or drive ``run_once()`` from a supervisor.  Heartbeats to
+the GCS flip the cluster into "infeasible demands wait for capacity"
+mode (core.py lease loop, gcs.py actor scheduling).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from ant_ray_tpu._private.protocol import ClientPool
+from ant_ray_tpu.autoscaler.node_provider import (
+    NodeProvider,
+    NodeTypeConfig,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: list[NodeTypeConfig] = field(default_factory=list)
+    idle_timeout_s: float = 60.0
+    interval_s: float = 5.0
+    # Max nodes launched per reconcile round (upscaling_speed analogue).
+    max_launches_per_round: int = 8
+
+
+def _fits(demand: dict, node_type: NodeTypeConfig,
+          selector: dict | None = None) -> bool:
+    if selector:
+        labels = {**node_type.labels, "art/node-type": node_type.name,
+                  "art/autoscaled": "1"}
+        if not all(labels.get(k) == v for k, v in selector.items()):
+            return False
+    return all(node_type.resources.get(k, 0.0) >= v
+               for k, v in demand.items())
+
+
+class Autoscaler:
+    def __init__(self, gcs_address: str, provider: NodeProvider,
+                 config: AutoscalerConfig):
+        self._gcs_address = gcs_address
+        self._provider = provider
+        self._config = config
+        self._clients = ClientPool()
+        self._launched: dict[str, str] = {}      # provider id -> type
+        self._idle_since: dict[str, float] = {}  # provider id -> ts
+        self._no_address_warned: set[str] = set()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="art-autoscaler")
+        self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._config.interval_s):
+            try:
+                self.run_once()
+            except Exception:  # noqa: BLE001 — keep reconciling
+                logger.exception("autoscaler reconcile failed")
+
+    # --------------------------------------------------------- one round
+
+    def run_once(self) -> dict:
+        """One reconcile: returns {"launched": [...], "terminated": [...]}
+        for observability/tests."""
+        gcs = self._clients.get(self._gcs_address)
+        gcs.call("AutoscalerHeartbeat", {}, retries=3)
+        demands = gcs.call("ResourceDemands", {}, retries=3) or []
+        nodes = list((gcs.call("GetAllNodes", {}, retries=3)
+                      or {}).values())
+
+        launched = self._scale_up(demands, nodes)
+        budget = self._config.max_launches_per_round - len(launched)
+        launched += self._backfill_min_workers(budget)
+        terminated = self._scale_down(nodes)
+        return {"launched": launched, "terminated": terminated}
+
+    # --------------------------------------------------------- scale up
+
+    def _counts_by_type(self) -> dict[str, int]:
+        counts: dict[str, int] = {}
+        for type_name in self._provider.non_terminated_nodes().values():
+            counts[type_name] = counts.get(type_name, 0) + 1
+        return counts
+
+    @staticmethod
+    def _node_satisfies(info, shape: dict, selector: dict | None) -> bool:
+        """Can this live node EVER run the shape?  (total capacity +
+        labels — mirrors the GCS's own infeasibility test, so a demand
+        recorded before a node arrived stops driving launches once the
+        node registers.)"""
+        if not getattr(info, "alive", False):
+            return False
+        labels = getattr(info, "labels", {}) or {}
+        if selector and not all(labels.get(k) == v
+                                for k, v in selector.items()):
+            return False
+        total = getattr(info, "total_resources", {}) or {}
+        return all(total.get(k, 0.0) >= v for k, v in shape.items())
+
+    def _scale_up(self, demands: list[dict], nodes: list) -> list[str]:
+        counts = self._counts_by_type()
+        launched: list[str] = []
+        budget = self._config.max_launches_per_round
+        for demand in demands:
+            if budget <= 0:
+                break
+            shape = demand.get("resources", {})
+            selector = demand.get("label_selector") or None
+            # Stale demand: some live node can already run it (leases
+            # queue there); launching more would double-provision.
+            if any(self._node_satisfies(n, shape, selector)
+                   for n in nodes):
+                continue
+            # Skip shapes a pending node will satisfy — launched this
+            # round, or launched earlier and still registering with the
+            # GCS (provider sees it, the node table doesn't yet).
+            pending_types = launched + list(
+                self._provider.non_terminated_nodes().values())
+            if any(_fits(shape, self._type_by_name(t), selector)
+                   for t in pending_types):
+                continue
+            choice = self._pick_type(shape, selector, counts)
+            if choice is None:
+                logger.warning(
+                    "demand %s (selector %s) fits no configured node "
+                    "type within max_workers", shape, selector)
+                continue
+            pid = self._provider.create_node(choice)
+            self._launched[pid] = choice.name
+            counts[choice.name] = counts.get(choice.name, 0) + 1
+            launched.append(choice.name)
+            budget -= 1
+            logger.info("autoscaler launched %s (%s) for demand %s",
+                        pid, choice.name, shape)
+        return launched
+
+    def _backfill_min_workers(self, budget: int) -> list[str]:
+        counts = self._counts_by_type()
+        launched = []
+        for node_type in self._config.node_types:
+            while counts.get(node_type.name, 0) < node_type.min_workers:
+                if budget <= 0:  # rest next round — keep rounds short
+                    return launched
+                pid = self._provider.create_node(node_type)
+                self._launched[pid] = node_type.name
+                counts[node_type.name] = counts.get(node_type.name, 0) + 1
+                launched.append(node_type.name)
+                budget -= 1
+        return launched
+
+    def _type_by_name(self, name: str) -> NodeTypeConfig:
+        for node_type in self._config.node_types:
+            if node_type.name == name:
+                return node_type
+        raise KeyError(name)
+
+    def _pick_type(self, shape: dict, selector: dict | None,
+                   counts: dict[str, int]) -> NodeTypeConfig | None:
+        """Smallest feasible type with headroom (first fit by total
+        resource sum — the v2 scheduler's utilization-score analogue)."""
+        feasible = [t for t in self._config.node_types
+                    if _fits(shape, t, selector)
+                    and counts.get(t.name, 0) < t.max_workers]
+        if not feasible:
+            return None
+        return min(feasible, key=lambda t: sum(t.resources.values()))
+
+    # --------------------------------------------------------- scale down
+
+    def _scale_down(self, nodes: list) -> list[str]:
+        """Terminate autoscaled nodes idle past the timeout (never below
+        min_workers for their type)."""
+        provider_nodes = self._provider.non_terminated_nodes()
+        counts = self._counts_by_type()
+        now = time.monotonic()
+        terminated: list[str] = []
+
+        # Which GCS nodes are idle?  (all resources back to total and no
+        # leases — the heartbeat view.)
+        idle_addresses = set()
+        for info in nodes:
+            if not getattr(info, "alive", False):
+                continue
+            total = getattr(info, "total_resources", {})
+            available = getattr(info, "available_resources", {})
+            if all(available.get(k, 0.0) >= v for k, v in total.items()):
+                idle_addresses.add(getattr(info, "address", ""))
+
+        for pid, type_name in list(provider_nodes.items()):
+            if pid not in self._launched:
+                continue  # not ours (statically provisioned)
+            address = self._provider.node_address(pid)
+            if address is None:
+                if pid not in self._no_address_warned:
+                    self._no_address_warned.add(pid)
+                    logger.warning(
+                        "provider gives no address for %s — idle "
+                        "scale-down disabled for it; terminate via the "
+                        "provider explicitly when it drains", pid)
+                continue
+            if address not in idle_addresses:
+                self._idle_since.pop(pid, None)
+                continue
+            node_type = self._type_by_name(type_name)
+            if counts.get(type_name, 0) <= node_type.min_workers:
+                continue
+            first_idle = self._idle_since.setdefault(pid, now)
+            if now - first_idle < self._config.idle_timeout_s:
+                continue
+            logger.info("autoscaler terminating idle node %s (%s)",
+                        pid, type_name)
+            self._provider.terminate_node(pid)
+            self._launched.pop(pid, None)
+            self._idle_since.pop(pid, None)
+            counts[type_name] -= 1
+            terminated.append(type_name)
+        return terminated

@@ -183,33 +183,21 @@ def _check_shapes(params: dict, shapes: dict) -> None:
     walk(params, shapes, "")
 
 
-def save_params(params: dict, path: str) -> None:
+def save_params(params: dict, path: str,
+                config: LlamaConfig | None = None) -> None:
     """Flat npz dump of our own tree (round-trip format for tests and
-    single-host snapshots; training checkpoints use train/checkpoint)."""
-    flat = {}
+    single-host snapshots; training checkpoints use train/checkpoint).
 
-    def walk(tree, prefix):
-        for key, value in tree.items():
-            name = f"{prefix}{key}"
-            if isinstance(value, dict):
-                walk(value, name + ".")
-            else:
-                flat[name] = np.asarray(value)
-
-    walk(params, "")
-    np.savez(path, **flat)
-
-
-def save_params_with_config(params: dict, path: str,
-                            config: LlamaConfig) -> None:
-    """save_params plus the head-split metadata load_params validates.
-
-    Projection shapes alone cannot distinguish head splits (16×64 and
-    8×128 heads both give a (dim, dim) wq), so a checkpoint loaded
-    under the wrong split would silently scramble the head structure.
+    Pass ``config`` to stamp head-split metadata that load_params
+    validates: projection shapes alone cannot distinguish head splits
+    (16×64 and 8×128 heads both give a (dim, dim) wq), so a checkpoint
+    loaded under the wrong split would otherwise silently scramble the
+    head structure.
     """
-    flat = {"__head_split__": np.asarray(
-        [config.n_heads, config.n_kv_heads, config.head_dim])}
+    flat = {}
+    if config is not None:
+        flat["__head_split__"] = np.asarray(
+            [config.n_heads, config.n_kv_heads, config.head_dim])
 
     def walk(tree, prefix):
         for key, value in tree.items():

@@ -293,31 +293,39 @@ class XLAGroup(BaseGroup):
         from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
 
         seq_attr = f"_send_seq_{opts.dst_rank}"
+        attempt_attr = f"_send_attempt_{opts.dst_rank}"
         seq = getattr(self, seq_attr, 0)
-        key = self._mailbox_key(self._rank, opts.dst_rank, seq)
+        attempt = getattr(self, attempt_attr, 0)
+        key = self._mailbox_key(self._rank, opts.dst_rank, seq) \
+            + f"#a{attempt}"
         blob = pickle.dumps(np.asarray(tensors[0]), protocol=5)
         gcs = global_worker.runtime._gcs
-        # Exchange protocol (retry-safe): the outcome of each sequence
-        # number is decided exactly once by a put-if-absent race on an
-        # arbitration key — "delivered" (receiver claims after reading
-        # the blob) vs "withdrawn" (sender claims at its deadline).
-        # Every operation either is idempotent (KVGet, re-KVPut of the
-        # same value) or resolves ambiguity by re-reading the
-        # arbitration key, so an RPC connection retry can never lose a
-        # message or desync the pair's sequence numbers.  Keys for
-        # seq-2 are garbage-collected here — by the time seq N+2 is
-        # sent, the receiver has fully finished seq N.
+        # Exchange protocol (retry-safe): the outcome of each
+        # (seq, attempt) is decided exactly once by a put-if-absent race
+        # on an arbitration key — "delivered" (receiver claims after
+        # reading the blob) vs "withdrawn" (sender claims at its
+        # deadline).  Every operation either is idempotent (KVGet,
+        # re-KVPut of the same value) or resolves ambiguity by
+        # re-reading the arbitration key, so an RPC connection retry can
+        # never lose a message or desync the pair.  A withdrawn attempt
+        # stays decided (deciding it twice is what reintroduces the
+        # race); both sides move to attempt+1, so an application retry
+        # of a timed-out send starts fresh.  Keys two sequences back are
+        # garbage-collected here — by the time seq N+2 is sent, the
+        # receiver has fully finished seq N.
         arb = key + ":arb"
-        for stale_seq in (seq - 2,) if seq >= 2 else ():
-            stale = self._mailbox_key(self._rank, opts.dst_rank, stale_seq)
-            gcs.call("KVDel", {"key": stale}, retries=3)
-            gcs.call("KVDel", {"key": stale + ":arb"}, retries=3)
+        if seq >= 2:
+            prefix = self._mailbox_key(self._rank, opts.dst_rank, seq - 2)
+            for stale in gcs.call("KVKeys", {"prefix": prefix},
+                                  retries=3) or []:
+                gcs.call("KVDel", {"key": stale}, retries=3)
         gcs.call("KVPut", {"key": key, "value": blob}, retries=3)
         deadline = _time.monotonic() + opts.timeout_ms / 1000.0
         poll = 0.002
         while _time.monotonic() < deadline:
             if gcs.call("KVGet", {"key": arb}, retries=3) == b"delivered":
                 setattr(self, seq_attr, seq + 1)
+                setattr(self, attempt_attr, 0)
                 return
             _time.sleep(poll)
             poll = min(poll * 2, 0.05)  # backoff: bounded GCS RPC rate
@@ -325,7 +333,9 @@ class XLAGroup(BaseGroup):
                            "overwrite": False}, retries=3)
         if gcs.call("KVGet", {"key": arb}, retries=3) == b"delivered":
             setattr(self, seq_attr, seq + 1)  # receiver won at the wire
+            setattr(self, attempt_attr, 0)
             return
+        setattr(self, attempt_attr, attempt + 1)  # retry starts fresh
         raise TimeoutError(
             f"send to rank {opts.dst_rank} not consumed in time")
 
@@ -336,13 +346,16 @@ class XLAGroup(BaseGroup):
         from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
 
         seq_attr = f"_recv_seq_{opts.src_rank}"
+        attempt_attr = f"_recv_attempt_{opts.src_rank}"
         seq = getattr(self, seq_attr, 0)
-        key = self._mailbox_key(opts.src_rank, self._rank, seq)
+        attempt = getattr(self, attempt_attr, 0)
         gcs = global_worker.runtime._gcs
-        arb = key + ":arb"
         deadline = _time.monotonic() + opts.timeout_ms / 1000.0
         poll = 0.002
         while _time.monotonic() < deadline:
+            key = self._mailbox_key(opts.src_rank, self._rank, seq) \
+                + f"#a{attempt}"
+            arb = key + ":arb"
             blob = gcs.call("KVGet", {"key": key}, retries=3)
             if blob is not None:
                 # Claim delivery via put-if-absent on the arbitration
@@ -354,10 +367,12 @@ class XLAGroup(BaseGroup):
                            gcs.call("KVGet", {"key": arb}, retries=3))
                 if verdict == b"delivered":
                     setattr(self, seq_attr, seq + 1)  # success only
+                    setattr(self, attempt_attr, 0)
                     return [pickle.loads(blob)]
-                # "withdrawn": the sender gave up on this seq — it will
-                # not advance; fall through to our own timeout so the
-                # pair stays in step.
+                # "withdrawn": the sender gave up on this attempt; its
+                # retry (if any) arrives at attempt+1 — move with it.
+                attempt += 1
+                setattr(self, attempt_attr, attempt)
             _time.sleep(poll)
             poll = min(poll * 2, 0.05)  # backoff: bounded GCS RPC rate
         raise TimeoutError(

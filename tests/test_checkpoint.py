@@ -178,7 +178,7 @@ def test_head_split_metadata_rejects_mismatch(tmp_path):
                               max_seq=128)
     params = llama.init_params(cfg_a, jax.random.PRNGKey(0))
     path = str(tmp_path / "p.npz")
-    checkpoint.save_params_with_config(params, path, cfg_a)
+    checkpoint.save_params(params, path, config=cfg_a)
     # same config loads fine
     checkpoint.load_params(path, cfg_a)
     with pytest.raises(ValueError, match="head split"):
