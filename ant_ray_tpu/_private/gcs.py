@@ -1182,6 +1182,15 @@ class GcsServer:
         now = time.monotonic()
         entry = self._demands.get(key)
         if entry is None:
+            # Prune here too — without an autoscaler polling
+            # ResourceDemands, unique shapes would otherwise accumulate
+            # in head memory for the cluster's lifetime.
+            if len(self._demands) >= 256:
+                self._prune_demands(now)
+            if len(self._demands) >= 512:  # still full: drop the oldest
+                oldest = min(self._demands,
+                             key=lambda k: self._demands[k]["last_seen"])
+                del self._demands[oldest]
             self._demands[key] = {
                 "resources": dict(resources),
                 "label_selector": dict(selector or {}),
@@ -1190,11 +1199,14 @@ class GcsServer:
             entry["count"] += 1
             entry["last_seen"] = now
 
-    async def _resource_demands(self, _payload):
-        now = time.monotonic()
+    def _prune_demands(self, now: float) -> None:
         for key in [k for k, e in self._demands.items()
                     if now - e["last_seen"] > self._DEMAND_TTL_S]:
             del self._demands[key]
+
+    async def _resource_demands(self, _payload):
+        now = time.monotonic()
+        self._prune_demands(now)
         return [{"resources": e["resources"],
                  "label_selector": e["label_selector"],
                  "count": e["count"],

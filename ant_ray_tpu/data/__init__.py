@@ -1,8 +1,42 @@
-"""Streaming datasets (ref capability: ray.data — lazy logical plan,
-block-parallel execution, streaming iteration)."""
+"""Streaming datasets (ref capability: ray.data — logical plan with
+operator fusion, Arrow/list blocks, pull-based streaming execution,
+map-reduce shuffles, datasources)."""
 
-from ant_ray_tpu.data.dataset import Dataset, from_items, from_numpy, range_
+from ant_ray_tpu.data.aggregate import Count, Max, Mean, Min, Sum
+from ant_ray_tpu.data.dataset import (
+    Dataset,
+    GroupedData,
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range_,
+    read_csv,
+    read_datasource,
+    read_jsonl,
+    read_parquet,
+)
+from ant_ray_tpu.data.datasource import Datasource, ReadTask
 
 range = range_  # noqa: A001 — mirrors ray.data.range
 
-__all__ = ["Dataset", "from_items", "from_numpy", "range"]
+__all__ = [
+    "Count",
+    "Dataset",
+    "Datasource",
+    "GroupedData",
+    "Max",
+    "Mean",
+    "Min",
+    "ReadTask",
+    "Sum",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_csv",
+    "read_datasource",
+    "read_jsonl",
+    "read_parquet",
+]

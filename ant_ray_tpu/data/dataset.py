@@ -1,11 +1,13 @@
-"""Dataset: lazy block-parallel transforms with streaming execution.
+"""Dataset: the public handle over a logical plan.
 
-Architecture (scaled-down mirror of the reference, SURVEY §2.4 Data):
-data is a list of *blocks* (object refs to item lists), transforms build a
-lazy chain of fused per-block functions (the reference's OneToOne operator
-fusion), and consumption streams blocks through tasks with a bounded
-in-flight window (the StreamingExecutor's backpressure, ref:
-execution/streaming_executor.py:67) so datasets larger than memory flow.
+Architecture (mirror of the reference, SURVEY §2.4 Data): a Dataset is
+(source, logical operator chain).  Transforms append logical operators
+(logical.py); consumption optimizes the chain (one-to-one runs fuse
+into one task per block) and streams block refs through the pull-based
+executor (executor.py) with bounded in-flight tasks, so datasets larger
+than memory flow.  Blocks are list or Arrow blocks (block.py);
+all-to-all ops (shuffle / sort / groupby / repartition) run as
+map-reduce task graphs, never materializing in the driver.
 """
 
 from __future__ import annotations
@@ -13,8 +15,22 @@ from __future__ import annotations
 import builtins
 from typing import Any, Callable, Iterable, Iterator
 
+from ant_ray_tpu.data import aggregate as agg
+from ant_ray_tpu.data import logical as L
+from ant_ray_tpu.data.block import BlockAccessor, concat_blocks
+from ant_ray_tpu.data.datasource import (
+    CSVDatasource,
+    Datasource,
+    JSONLDatasource,
+    ParquetDatasource,
+    RangeDatasource,
+    ReadTask,
+    write_jsonl_block,
+    write_parquet_block,
+)
+from ant_ray_tpu.data.executor import DEFAULT_IN_FLIGHT, execute
+
 DEFAULT_PARALLELISM = 8
-DEFAULT_IN_FLIGHT = 8
 
 
 def _art():
@@ -23,119 +39,197 @@ def _art():
     return art
 
 
+def _run_read_task(task: ReadTask):
+    return task.fn()
+
+
+def _block_schema(block):
+    return None if isinstance(block, list) else block.schema
+
+
 class Dataset:
-    def __init__(self, block_refs: list, transforms: tuple = ()):
-        self._block_refs = list(block_refs)
-        self._transforms = tuple(transforms)
+    def __init__(self, block_refs: list | None = None,
+                 operators: tuple = (),
+                 read_tasks: list | None = None):
+        self._block_refs = list(block_refs or [])
+        self._read_tasks = list(read_tasks or [])
+        self._operators = tuple(operators)
 
-    # -------------------------------------------------------- transforms
+    # ---------------------------------------------------------- source
 
-    def _with(self, fn: Callable[[list], list]) -> "Dataset":
-        return Dataset(self._block_refs, self._transforms + (fn,))
+    def _source(self) -> Iterator:
+        """Iterator of input block refs; read tasks launch lazily with
+        the executor's window providing backpressure."""
+        if self._read_tasks:
+            art = _art()
+            run_read = art.remote(_run_read_task)
+            for task in self._read_tasks:
+                yield run_read.remote(task)
+        yield from self._block_refs
+
+    def _with(self, op) -> "Dataset":
+        return Dataset(self._block_refs, self._operators + (op,),
+                       self._read_tasks)
+
+    # ------------------------------------------------------- transforms
 
     def map(self, fn: Callable[[Any], Any]) -> "Dataset":
-        return self._with(lambda block: [fn(x) for x in block])
+        return self._with(L.MapRows(fn))
 
     def filter(self, fn: Callable[[Any], bool]) -> "Dataset":
-        return self._with(lambda block: [x for x in block if fn(x)])
+        return self._with(L.FilterRows(fn))
 
     def flat_map(self, fn: Callable[[Any], Iterable]) -> "Dataset":
-        return self._with(
-            lambda block: [y for x in block for y in fn(x)])
+        return self._with(L.FlatMapRows(fn))
 
-    def map_batches(self, fn: Callable[[list], list],
-                    batch_size: int | None = None) -> "Dataset":
-        def apply(block: list) -> list:
-            if batch_size is None:
-                return list(fn(block))
-            out: list = []
-            for i in builtins.range(0, len(block), batch_size):
-                out.extend(fn(block[i:i + batch_size]))
-            return out
+    def map_batches(self, fn: Callable, batch_size: int | None = None,
+                    batch_format: str = "default") -> "Dataset":
+        return self._with(L.MapBatches(fn, batch_size, batch_format))
 
-        return self._with(apply)
+    def limit(self, n: int) -> "Dataset":
+        return self._with(L.Limit(n))
+
+    # ------------------------------------------------------- all-to-all
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._with(L.Repartition(num_blocks))
+
+    def random_shuffle(self, seed: int | None = None) -> "Dataset":
+        return self._with(L.RandomShuffle(seed))
+
+    def sort(self, key=None, descending: bool = False) -> "Dataset":
+        return self._with(L.Sort(key, descending))
+
+    def groupby(self, key) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # ---------------------------------------------------- set operations
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        """Concatenate datasets (each side materializes its own plan)."""
+        datasets = (self,) + others
+        refs: list = []
+        for ds in datasets:
+            refs.extend(ds.materialize()._block_refs)
+        return Dataset(refs)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        """Row-aligned zip into (row_a, row_b) tuples."""
+        a = self.take_all()
+        b = other.take_all()
+        if len(a) != len(b):
+            raise ValueError(
+                f"zip needs equal row counts, got {len(a)} vs {len(b)}")
+        return from_items(list(builtins.zip(a, b)))
 
     # -------------------------------------------------------- execution
 
-    def _fused_fn(self):
-        transforms = self._transforms
-
-        def run(block: list) -> list:
-            for t in transforms:
-                block = t(block)
-            return block
-
-        return run
-
-    def materialize(self) -> "Dataset":
-        """Execute all pending transforms; returns a transform-free
-        Dataset over new blocks."""
-        if not self._transforms:
-            return self
-        art = _art()
-        run = self._fused_fn()
-        apply_block = art.remote(lambda block: run(block))
-        new_refs = [apply_block.remote(ref) for ref in self._block_refs]
-        return Dataset(new_refs)
+    def _iter_result_refs(self, in_flight: int = DEFAULT_IN_FLIGHT
+                          ) -> Iterator:
+        return execute(self._source, self._operators, in_flight)
 
     def _iter_result_blocks(self, in_flight: int = DEFAULT_IN_FLIGHT
-                            ) -> Iterator[list]:
-        """Stream blocks through the transform chain with bounded
-        in-flight tasks (backpressure)."""
+                            ) -> Iterator:
         art = _art()
-        if not self._transforms:
-            for ref in self._block_refs:
-                yield art.get(ref)
-            return
-        run = self._fused_fn()
-        apply_block = art.remote(lambda block: run(block))
-        pending_input = list(self._block_refs)
-        running: list = []
-        while pending_input or running:
-            while pending_input and len(running) < in_flight:
-                running.append(apply_block.remote(pending_input.pop(0)))
-            ready, running = art.wait(running, num_returns=1, timeout=30.0)
-            for ref in ready:
-                yield art.get(ref)
+        for ref in self._iter_result_refs(in_flight):
+            yield art.get(ref)
 
-    # -------------------------------------------------------- consumption
+    def materialize(self) -> "Dataset":
+        """Execute the plan; returns an operator-free Dataset over the
+        result blocks (held by refs, not driver memory)."""
+        if not self._operators and not self._read_tasks:
+            return self
+        return Dataset(list(self._iter_result_refs()))
+
+    # ------------------------------------------------------- consumption
 
     def iter_rows(self) -> Iterator[Any]:
         for block in self._iter_result_blocks():
-            yield from block
+            yield from BlockAccessor.for_block(block).to_rows()
 
-    def iter_batches(self, batch_size: int = 256) -> Iterator[list]:
-        buffer: list = []
+    def iter_batches(self, batch_size: int = 256,
+                     batch_format: str = "default") -> Iterator:
+        """Stream batches; for Arrow blocks with batch_format="numpy"
+        this is the TPU ingest path (dict of numpy columns →
+        jnp.asarray).  Batches assemble by block slice + concat, never
+        round-tripping rows through Python, so Arrow dtypes survive."""
+        pending: list = []     # (accessor, start offset) pieces
+        pending_rows = 0
         for block in self._iter_result_blocks():
-            buffer.extend(block)
-            while len(buffer) >= batch_size:
-                yield buffer[:batch_size]
-                buffer = buffer[batch_size:]
-        if buffer:
-            yield buffer
+            accessor = BlockAccessor.for_block(block)
+            if accessor.num_rows() == 0:
+                continue
+            pending.append([accessor, 0])
+            pending_rows += accessor.num_rows()
+            while pending_rows >= batch_size:
+                yield self._assemble_batch(pending, batch_size,
+                                           batch_format)
+                pending_rows -= batch_size
+        if pending_rows:
+            yield self._assemble_batch(pending, pending_rows,
+                                       batch_format)
+
+    @staticmethod
+    def _assemble_batch(pending: list, n: int, batch_format: str):
+        pieces = []
+        taken = 0
+        while taken < n:
+            accessor, start = pending[0]
+            available = accessor.num_rows() - start
+            use = min(available, n - taken)
+            pieces.append(accessor.slice(start, start + use))
+            taken += use
+            if use == available:
+                pending.pop(0)
+            else:
+                pending[0][1] = start + use
+        batch_block = concat_blocks(pieces)
+        if batch_format == "default" and isinstance(batch_block, list):
+            return batch_block
+        return BlockAccessor.for_block(batch_block).to_batch(
+            "numpy" if batch_format in ("default", "numpy") else
+            batch_format)
 
     def take(self, n: int = 20) -> list:
         out: list = []
-        for block in self._iter_result_blocks():
-            out.extend(block)
+        for block in self.limit(n)._iter_result_blocks():
+            out.extend(BlockAccessor.for_block(block).to_rows())
             if len(out) >= n:
                 return out[:n]
         return out
 
     def take_all(self) -> list:
-        return [x for block in self._iter_result_blocks() for x in block]
+        return [row for block in self._iter_result_blocks()
+                for row in BlockAccessor.for_block(block).to_rows()]
 
     def count(self) -> int:
+        from ant_ray_tpu.data.executor import _block_rows  # noqa: PLC0415
+
         art = _art()
-        run = self._fused_fn()
-        counter = art.remote(lambda block: len(run(block)))
-        return sum(art.get([counter.remote(r) for r in self._block_refs]))
+        rows_remote = art.remote(_block_rows)
+        refs = [rows_remote.remote(r) for r in self._iter_result_refs()]
+        return sum(art.get(refs))
+
+    def aggregate(self, *aggs: agg.AggregateFn) -> dict:
+        """Global aggregation (single implicit group)."""
+        grouped = self.groupby(lambda _row: 0)._aggregate(*aggs)
+        rows = grouped.take_all()
+        if not rows:
+            return {a.name: a.finalize(a.init()) for a in aggs}
+        row = dict(rows[0])
+        row.pop("key", None)
+        return row
+
+    def schema(self):
+        """Schema of the first block (Arrow) or None — only the schema
+        crosses the wire; the block itself stays in the cluster."""
+        art = _art()
+        schema_remote = art.remote(_block_schema)
+        for ref in self._iter_result_refs(in_flight=1):
+            return art.get(schema_remote.remote(ref))
+        return None
 
     # -------------------------------------------------------- reshaping
-
-    def repartition(self, num_blocks: int) -> "Dataset":
-        items = self.take_all()
-        return from_items(items, parallelism=num_blocks)
 
     def split(self, n: int) -> list["Dataset"]:
         """Split into n datasets block-wise (for per-worker shards)."""
@@ -145,20 +239,67 @@ class Dataset:
             shards[i % n].append(ref)
         return [Dataset(refs) for refs in shards]
 
-    def random_shuffle(self, seed: int | None = None) -> "Dataset":
-        import random as _random  # noqa: PLC0415
+    # ---------------------------------------------------------- writers
 
-        items = self.take_all()
-        _random.Random(seed).shuffle(items)
-        return from_items(items, parallelism=max(1, len(self._block_refs)))
+    def write_jsonl(self, directory: str) -> list[str]:
+        return self._write(directory, "jsonl", write_jsonl_block)
+
+    def write_parquet(self, directory: str) -> list[str]:
+        return self._write(directory, "parquet", write_parquet_block)
+
+    def _write(self, directory: str, ext: str, writer) -> list[str]:
+        import os  # noqa: PLC0415
+
+        os.makedirs(directory, exist_ok=True)
+        art = _art()
+        write_remote = art.remote(writer)
+        refs = []
+        for i, ref in enumerate(self._iter_result_refs()):
+            path = os.path.join(directory, f"part-{i:05d}.{ext}")
+            refs.append(write_remote.remote(ref, path))
+        return art.get(refs)
+
+    # ------------------------------------------------------------- info
 
     @property
     def num_blocks(self) -> int:
+        if self._read_tasks:
+            return len(self._read_tasks) + len(self._block_refs)
         return len(self._block_refs)
 
     def __repr__(self):
         return (f"Dataset(num_blocks={self.num_blocks}, "
-                f"pending_transforms={len(self._transforms)})")
+                f"pending_operators={len(self._operators)})")
+
+
+class GroupedData:
+    """(ref: python/ray/data/grouped_data.py)"""
+
+    def __init__(self, dataset: Dataset, key):
+        self._dataset = dataset
+        self._key = key
+
+    def _aggregate(self, *aggs: agg.AggregateFn) -> Dataset:
+        return self._dataset._with(
+            L.GroupByAggregate(self._key, tuple(aggs)))
+
+    def aggregate(self, *aggs: agg.AggregateFn) -> Dataset:
+        return self._aggregate(*aggs)
+
+    def count(self) -> Dataset:
+        return self._aggregate(agg.Count())
+
+    def sum(self, on=None) -> Dataset:
+        return self._aggregate(agg.Sum(on))
+
+    def min(self, on=None) -> Dataset:
+        return self._aggregate(agg.Min(on))
+
+    def max(self, on=None) -> Dataset:
+        return self._aggregate(agg.Max(on))
+
+    def mean(self, on=None) -> Dataset:
+        return self._aggregate(agg.Mean(on))
 
 
 # ------------------------------------------------------------ constructors
@@ -177,8 +318,36 @@ def from_items(items: list, parallelism: int = DEFAULT_PARALLELISM
 
 
 def range_(n: int, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
-    return from_items(list(builtins.range(n)), parallelism)
+    return read_datasource(RangeDatasource(n), parallelism)
 
 
 def from_numpy(array, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
     return from_items(list(array), parallelism)
+
+
+def from_arrow(table) -> Dataset:
+    art = _art()
+    return Dataset([art.put(table)])
+
+
+def from_pandas(df) -> Dataset:
+    import pyarrow  # noqa: PLC0415
+
+    return from_arrow(pyarrow.Table.from_pandas(df))
+
+
+def read_datasource(source: Datasource,
+                    parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return Dataset(read_tasks=source.get_read_tasks(parallelism))
+
+
+def read_csv(paths, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return read_datasource(CSVDatasource(paths), parallelism)
+
+
+def read_jsonl(paths, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return read_datasource(JSONLDatasource(paths), parallelism)
+
+
+def read_parquet(paths, parallelism: int = DEFAULT_PARALLELISM) -> Dataset:
+    return read_datasource(ParquetDatasource(paths), parallelism)

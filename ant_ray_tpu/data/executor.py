@@ -1,0 +1,299 @@
+"""Streaming executor: pull-based block streaming with backpressure.
+
+The reference's StreamingExecutor drives an operator topology with
+resource-aware backpressure policies (ref:
+data/_internal/execution/streaming_executor.py:67 +
+backpressure_policy/).  Here each stage is a generator of block refs
+pulling from the previous stage — demand propagates backwards, so at
+most ``max_in_flight`` map tasks run per stage and at most one barrier
+materializes at a time.  All-to-all stages (shuffle / sort / groupby /
+repartition) run as map-reduce task graphs over ``num_returns=k``
+splits, never materializing the dataset in the driver.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import random
+from typing import Any, Callable, Iterable, Iterator
+
+from ant_ray_tpu.data import block as B
+from ant_ray_tpu.data import logical as L
+
+DEFAULT_IN_FLIGHT = 8
+
+
+def _stable_hash(value) -> int:
+    """Deterministic across processes — builtin hash() is per-process
+    randomized for strings, which would split one group over several
+    hash partitions (double-counted aggregates)."""
+    digest = hashlib.md5(pickle.dumps(value, protocol=4)).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+def _art():
+    import ant_ray_tpu as art  # noqa: PLC0415
+
+    return art
+
+
+# ----------------------------------------------------------- remote fns
+
+def _apply_fused(fused: L.FusedMap, block):
+    return fused(block)
+
+
+def _split_block(block, k: int, mode: str, seed):
+    """Map side of a shuffle: one block → k partition pieces.
+
+    For k == 1 the single piece is returned bare (the task runs with
+    num_returns=1, where a list would be treated as one list-block)."""
+    accessor = B.BlockAccessor.for_block(block)
+    n = accessor.num_rows()
+    if k == 1:
+        return block
+    if mode == "even":
+        bounds = [round(i * n / k) for i in range(k + 1)]
+        return [accessor.slice(bounds[i], bounds[i + 1])
+                for i in range(k)]
+    rows = accessor.to_rows()
+    parts: list[list] = [[] for _ in range(k)]
+    if mode == "random":
+        # seed = (user seed, block index): distinct stream per block —
+        # one shared stream would send row i of every block to the same
+        # partition sequence.
+        rng = random.Random(seed)
+        for row in rows:
+            parts[rng.randrange(k)].append(row)
+    elif mode == "hash":
+        key = seed  # the group key rides the seed slot
+        for row in rows:
+            value = key(row) if callable(key) else row[key]
+            parts[_stable_hash(value) % k].append(row)
+    else:  # pragma: no cover — range mode uses _split_block_range
+        raise ValueError(mode)
+    return [B.rows_to_block(p, block) for p in parts]
+
+
+def _split_block_range(block, boundaries: list, key, descending: bool):
+    """Range partition for sort: rows → len(boundaries)+1 pieces (bare
+    block when there is a single piece — see _split_block)."""
+    import bisect  # noqa: PLC0415
+
+    if not boundaries:
+        return block
+    accessor = B.BlockAccessor.for_block(block)
+    rows = accessor.to_rows()
+    values = accessor.sort_key_values(key)
+    k = len(boundaries) + 1
+    parts: list[list] = [[] for _ in range(k)]
+    for row, value in zip(rows, values):
+        idx = bisect.bisect_left(boundaries, value)
+        if descending:
+            idx = k - 1 - idx
+        parts[idx].append(row)
+    return [B.rows_to_block(p, block) for p in parts]
+
+
+def _merge_blocks(*pieces):
+    return B.concat_blocks(list(pieces))
+
+
+def _merge_shuffled(seed, *pieces):
+    """Reduce side of random_shuffle: concat then Fisher-Yates within
+    the partition — split alone keeps source order inside each
+    partition (position-correlated training batches)."""
+    merged = B.concat_blocks(list(pieces))
+    rows = B.BlockAccessor.for_block(merged).to_rows()
+    random.Random(seed).shuffle(rows)
+    return B.rows_to_block(rows, merged)
+
+
+def _merge_sorted(key, descending: bool, *pieces):
+    merged = B.concat_blocks(list(pieces))
+    accessor = B.BlockAccessor.for_block(merged)
+    rows = accessor.to_rows()
+    values = accessor.sort_key_values(key)
+    order = sorted(range(len(rows)), key=values.__getitem__,
+                   reverse=descending)
+    return B.rows_to_block([rows[i] for i in order], merged)
+
+
+def _merge_grouped(key, aggs, *pieces):
+    """Reduce side of groupby: hash-partitioned rows → one row per
+    group with finalized aggregates."""
+    merged = B.concat_blocks(list(pieces))
+    accessor = B.BlockAccessor.for_block(merged)
+    groups: dict = {}
+    for row in accessor.to_rows():
+        group = key(row) if callable(key) else row[key]
+        accs = groups.get(group)
+        if accs is None:
+            accs = [agg.init() for agg in aggs]
+            groups[group] = accs
+        for i, agg in enumerate(aggs):
+            accs[i] = agg.accumulate(accs[i], agg.value_of(row))
+    out = []
+    key_name = key if isinstance(key, str) else "key"
+    for group, accs in groups.items():
+        row = {key_name: group}
+        for agg, acc in zip(aggs, accs):
+            row[agg.name] = agg.finalize(acc)
+        out.append(row)
+    return out
+
+
+def _sample_keys(block, key, k: int, seed: int):
+    accessor = B.BlockAccessor.for_block(block)
+    values = accessor.sort_key_values(key)
+    rng = random.Random(seed)
+    if len(values) <= k:
+        return list(values)
+    return rng.sample(list(values), k)
+
+
+def _block_rows(block) -> int:
+    return B.BlockAccessor.for_block(block).num_rows()
+
+
+def _slice_remote(block, start: int, end: int):
+    return B.BlockAccessor.for_block(block).slice(start, end)
+
+
+# ------------------------------------------------------------- stages
+
+def _map_stage(upstream: Iterator, fused: L.FusedMap,
+               in_flight: int) -> Iterator:
+    """Ordered, bounded map over a ref stream (backpressure: at most
+    ``in_flight`` outstanding tasks; upstream pulled only when a slot
+    frees)."""
+    art = _art()
+    apply_remote = art.remote(_apply_fused)
+    window: list = []
+    exhausted = False
+    while True:
+        while not exhausted and len(window) < in_flight:
+            try:
+                ref = next(upstream)
+            except StopIteration:
+                exhausted = True
+                break
+            window.append(apply_remote.remote(fused, ref))
+        if not window:
+            return
+        head = window.pop(0)
+        art.wait([head], num_returns=1, timeout=600)
+        yield head
+
+
+def _shuffle(refs: list, k: int, mode: str, seed) -> list:
+    """Generic map-reduce shuffle: split every block into k pieces, one
+    merge task per partition (pieces move store-to-store, never through
+    the driver).  mode="random" uses per-block split streams and a
+    within-partition permutation at the merge — together a real
+    two-stage uniform shuffle."""
+    art = _art()
+    split_remote = art.remote(_split_block).options(num_returns=k)
+    merge_remote = art.remote(_merge_blocks)
+    if mode == "random":
+        if seed is None:  # derived streams must differ run to run
+            seed = random.randrange(2**63)
+        pieces = [split_remote.remote(ref, k, mode,
+                                      _stable_hash(("split", seed, i)))
+                  for i, ref in enumerate(refs)]
+        merge_shuffled = art.remote(_merge_shuffled)
+        pieces = [p if isinstance(p, list) else [p] for p in pieces]
+        return [merge_shuffled.remote(_stable_hash(("merge", seed, j)),
+                                      *[row[j] for row in pieces])
+                for j in range(k)]
+    pieces = [split_remote.remote(ref, k, mode, seed) for ref in refs]
+    pieces = [p if isinstance(p, list) else [p] for p in pieces]
+    return [merge_remote.remote(*[row[j] for row in pieces])
+            for j in range(k)]
+
+
+def _sorted_refs(refs: list, key, descending: bool) -> list:
+    art = _art()
+    k = max(1, len(refs))
+    sample_remote = art.remote(_sample_keys)
+    samples: list = []
+    for chunk in art.get([sample_remote.remote(r, key, 8, i)
+                          for i, r in enumerate(refs)]):
+        samples.extend(chunk)
+    samples.sort()
+    if len(samples) > 1 and k > 1:
+        step = len(samples) / k
+        boundaries = [samples[min(int(step * i), len(samples) - 1)]
+                      for i in range(1, k)]
+    else:
+        boundaries = []
+    split_remote = art.remote(_split_block_range).options(
+        num_returns=len(boundaries) + 1)
+    merge_remote = art.remote(_merge_sorted)
+    pieces = [split_remote.remote(r, boundaries, key, descending)
+              for r in refs]
+    pieces = [p if isinstance(p, list) else [p] for p in pieces]
+    out = []
+    for j in range(len(boundaries) + 1):
+        out.append(merge_remote.remote(key, descending,
+                                       *[row[j] for row in pieces]))
+    return out
+
+
+def _grouped_refs(refs: list, key, aggs) -> list:
+    art = _art()
+    k = max(1, len(refs))
+    split_remote = art.remote(_split_block).options(num_returns=k)
+    merge_remote = art.remote(_merge_grouped)
+    pieces = [split_remote.remote(r, k, "hash", key) for r in refs]
+    pieces = [p if isinstance(p, list) else [p] for p in pieces]
+    return [merge_remote.remote(key, tuple(aggs),
+                                *[row[j] for row in pieces])
+            for j in range(k)]
+
+
+def _limit_stage(upstream: Iterator, n: int) -> Iterator:
+    art = _art()
+    rows_remote = art.remote(_block_rows)
+    slice_remote = art.remote(_slice_remote)
+    remaining = n
+    for ref in upstream:
+        if remaining <= 0:
+            return
+        rows = art.get(rows_remote.remote(ref))
+        if rows <= remaining:
+            remaining -= rows
+            yield ref
+        else:
+            yield slice_remote.remote(ref, 0, remaining)
+            remaining = 0
+
+
+# ------------------------------------------------------------ executor
+
+def execute(source: Callable[[], Iterator], operators: tuple,
+            in_flight: int = DEFAULT_IN_FLIGHT) -> Iterator:
+    """Stream block refs through the optimized operator chain."""
+    stream: Iterator = source()
+    for op in L.optimize(operators):
+        if isinstance(op, L.FusedMap):
+            stream = _map_stage(stream, op, in_flight)
+        elif isinstance(op, L.Repartition):
+            refs = list(stream)
+            stream = iter(_shuffle(refs, op.num_blocks, "even", None))
+        elif isinstance(op, L.RandomShuffle):
+            refs = list(stream)
+            k = op.num_blocks or max(1, len(refs))
+            stream = iter(_shuffle(refs, k, "random", op.seed))
+        elif isinstance(op, L.Sort):
+            refs = list(stream)
+            stream = iter(_sorted_refs(refs, op.key, op.descending))
+        elif isinstance(op, L.GroupByAggregate):
+            refs = list(stream)
+            stream = iter(_grouped_refs(refs, op.key, op.aggs))
+        elif isinstance(op, L.Limit):
+            stream = _limit_stage(stream, op.n)
+        else:  # pragma: no cover
+            raise ValueError(f"unknown operator {op}")
+    return stream

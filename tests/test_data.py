@@ -65,7 +65,7 @@ def test_random_shuffle(cluster):
 
 def test_materialize_executes_once(cluster):
     ds = data.range(16, parallelism=2).map(lambda x: x * 3).materialize()
-    assert ds._transforms == ()
+    assert ds._operators == ()
     assert sorted(ds.take_all()) == [x * 3 for x in range(16)]
 
 
@@ -88,3 +88,152 @@ def test_state_api(cluster):
     summary = state.summarize_cluster()
     assert summary["nodes"]["alive"] == 1
     assert "CPU" in summary["resources_total"]
+
+
+# ---------------------------------------------------- blocks & datasources
+
+
+def test_read_jsonl_roundtrip(cluster, tmp_path):
+    rows = [{"x": i, "y": f"s{i}"} for i in range(20)]
+    ds = data.from_items(rows)
+    paths = ds.write_jsonl(str(tmp_path / "out"))
+    assert len(paths) >= 1
+    back = data.read_jsonl(str(tmp_path / "out"))
+    got = sorted(back.take_all(), key=lambda r: r["x"])
+    assert got == rows
+
+
+def test_read_parquet_and_csv(cluster, tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from pyarrow import csv as pacsv
+
+    table = pa.table({"a": list(range(10)), "b": [i * 2.5 for i in range(10)]})
+    pq.write_table(table, str(tmp_path / "t.parquet"))
+    pacsv.write_csv(table, str(tmp_path / "t.csv"))
+
+    ds_pq = data.read_parquet(str(tmp_path / "t.parquet"))
+    assert ds_pq.count() == 10
+    assert ds_pq.schema().names == ["a", "b"]
+
+    ds_csv = data.read_csv(str(tmp_path / "t.csv"))
+    rows = sorted(ds_csv.take_all(), key=lambda r: r["a"])
+    assert rows[3] == {"a": 3, "b": 7.5}
+
+
+def test_map_batches_numpy_format_on_arrow(cluster):
+    import numpy as np
+    import pyarrow as pa
+
+    table = pa.table({"x": np.arange(32, dtype=np.int64)})
+    ds = data.from_arrow(table).map_batches(
+        lambda batch: {"x2": batch["x"] * 2}, batch_format="numpy")
+    out = ds.take_all()
+    assert sorted(r["x2"] for r in out) == [2 * i for i in range(32)]
+
+
+def test_sort_distributed(cluster):
+    import random
+    values = list(range(100))
+    random.Random(0).shuffle(values)
+    ds = data.from_items(values, parallelism=8).sort()
+    assert ds.take_all() == sorted(values)
+    assert data.from_items(values, parallelism=4).sort(
+        descending=True).take_all() == sorted(values, reverse=True)
+
+
+def test_sort_by_column_key(cluster):
+    rows = [{"k": i % 7, "v": i} for i in range(30)]
+    out = data.from_items(rows, parallelism=4).sort(key="k").take_all()
+    assert [r["k"] for r in out] == sorted(r["k"] for r in rows)
+
+
+def test_groupby_aggregates(cluster):
+    rows = [{"g": i % 3, "v": i} for i in range(30)]
+    out = data.from_items(rows, parallelism=4).groupby("g").aggregate(
+        data.Count(), data.Sum(on="v"), data.Mean(on="v")).take_all()
+    by_group = {r["g"]: r for r in out}
+    assert by_group[0]["count"] == 10
+    assert by_group[1]["sum(v)"] == sum(i for i in range(30) if i % 3 == 1)
+    assert abs(by_group[2]["mean(v)"]
+               - sum(i for i in range(30) if i % 3 == 2) / 10) < 1e-9
+
+
+def test_global_aggregate(cluster):
+    out = data.range(100, parallelism=8).aggregate(
+        data.Sum(), data.Min(), data.Max())
+    assert out["sum"] == 4950 and out["min"] == 0 and out["max"] == 99
+
+
+def test_repartition_is_distributed(cluster):
+    ds = data.range(64, parallelism=2).repartition(8).materialize()
+    assert ds.num_blocks == 8
+    assert sorted(ds.take_all()) == list(range(64))
+
+
+def test_limit_short_circuits(cluster):
+    ds = data.range(1000, parallelism=10).map(lambda x: x + 1).limit(15)
+    assert ds.take_all() == list(range(1, 16))
+    assert data.range(100).take(5) == [0, 1, 2, 3, 4]
+
+
+def test_union_and_zip(cluster):
+    a = data.from_items([1, 2, 3])
+    b = data.from_items([4, 5, 6])
+    assert sorted(a.union(b).take_all()) == [1, 2, 3, 4, 5, 6]
+    assert a.zip(b).take_all() == [(1, 4), (2, 5), (3, 6)]
+
+
+def test_operator_fusion(cluster):
+    from ant_ray_tpu.data import logical as L
+
+    ds = data.range(8).map(lambda x: x + 1).filter(
+        lambda x: x % 2 == 0).flat_map(lambda x: [x, x])
+    optimized = L.optimize(ds._operators)
+    assert len(optimized) == 1           # one fused stage
+    assert isinstance(optimized[0], L.FusedMap)
+    assert sorted(ds.take_all()) == sorted(
+        [x for i in range(8) if (i + 1) % 2 == 0 for x in [i + 1, i + 1]])
+
+
+def test_iter_batches_numpy_from_arrow(cluster):
+    import numpy as np
+    import pyarrow as pa
+
+    table = pa.table({"x": np.arange(10, dtype=np.float32)})
+    batches = list(data.from_arrow(table).iter_batches(
+        batch_size=4, batch_format="numpy"))
+    assert [len(b["x"]) for b in batches] == [4, 4, 2]
+    assert batches[0]["x"].dtype == np.float32
+
+
+def test_groupby_string_keys_across_workers(cluster):
+    """String group keys must hash identically in every worker process
+    (builtin hash is per-process randomized)."""
+    rows = [{"g": f"key{i % 4}", "v": 1} for i in range(40)]
+    out = data.from_items(rows, parallelism=8).groupby("g").count() \
+        .take_all()
+    assert sorted((r["g"], r["count"]) for r in out) == [
+        (f"key{j}", 10) for j in range(4)]
+
+
+def test_random_shuffle_breaks_runs(cluster):
+    """Shuffle must permute within partitions, not just route blocks."""
+    n = 512
+    shuffled = data.from_items(list(range(n)), parallelism=4) \
+        .random_shuffle(seed=7).take_all()
+    assert sorted(shuffled) == list(range(n))
+    ascending_pairs = sum(1 for a, b in zip(shuffled, shuffled[1:])
+                          if b == a + 1)
+    assert ascending_pairs < n // 8   # a sorted run would be ~n
+
+
+def test_union_mixed_kinds_batches(cluster):
+    import pyarrow as pa
+
+    mixed = data.from_items([1, 2, 3]).union(
+        data.from_arrow(pa.table({"x": [1, 2]})))
+    batches = list(mixed.iter_batches(batch_size=4))
+    total = sum(len(b) if isinstance(b, list) else
+                len(next(iter(b.values()))) for b in batches)
+    assert total == 5
