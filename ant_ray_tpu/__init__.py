@@ -22,6 +22,7 @@ from ant_ray_tpu.api import (
     put,
     remote,
     shutdown,
+    timeline,
     wait,
 )
 from ant_ray_tpu.object_ref import ObjectRef
@@ -51,5 +52,6 @@ __all__ = [
     "put",
     "remote",
     "shutdown",
+    "timeline",
     "wait",
 ]

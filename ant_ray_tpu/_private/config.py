@@ -56,6 +56,10 @@ class Config:
     include_dashboard: bool = True
     # Emit flow-insight call-graph events (ant-fork util/insight).
     enable_insight: bool = False
+    # Task lifecycle events (submitted/started/finished) buffered per
+    # process and batch-flushed to the GCS — feeds the Chrome-trace
+    # timeline and the state API (ref: task_event_buffer.h).
+    enable_task_events: bool = True
     # Evicted sealed objects spill to disk (session dir) and restore on
     # access instead of being dropped (ref: LocalObjectManager).
     enable_object_spilling: bool = True

@@ -692,6 +692,11 @@ class ClusterRuntime(CoreRuntime):
 
             insight.record_call_submit(spec.function_name,
                                        task_id.hex(), self.role)
+        if cfg.enable_task_events:
+            from ant_ray_tpu._private import task_events  # noqa: PLC0415
+
+            task_events.record(task_id.hex(), spec.function_name,
+                               "submitted")
         asyncio.run_coroutine_threadsafe(
             self._run_normal_task(spec, pinned), self._io.loop)
         if streaming:
@@ -1254,6 +1259,12 @@ class ClusterRuntime(CoreRuntime):
             actor_id=actor_id,
             method_name=method_name,
         )
+
+        if global_config().enable_task_events:
+            from ant_ray_tpu._private import task_events  # noqa: PLC0415
+
+            task_events.record(task_id.hex(), spec.function_name,
+                               "submitted", actor_id=actor_id.hex())
 
         def _enqueue():
             state = self._actor_states.get(actor_id)

@@ -247,6 +247,62 @@ def create_app(gcs_address: str, session_dir: str):
                     "graph": build_call_graph(events)}
         return web.json_response(await _call(build))
 
+    async def node_logs(req):
+        node_id = req.query.get("node_id")
+
+        def build():
+            infos = gcs.call("GetAllNodes", retries=3)
+            out = []
+            for info in infos.values():
+                if not info.alive:
+                    continue
+                if node_id and not info.node_id.hex().startswith(node_id):
+                    continue
+                files = clients.get(info.address).call(
+                    "ListLogs", {}, retries=3)
+                out.append({"node_id": info.node_id.hex(),
+                            "files": files})
+            return out
+        return web.json_response(await _call(build))
+
+    async def node_log_read(req):
+        filename = req.match_info["filename"]
+        node_id = req.query.get("node_id")
+        tail = req.query.get("tail")
+
+        def build():
+            infos = gcs.call("GetAllNodes", retries=3)
+            last_error = f"no alive node matches {node_id!r}"
+            for info in infos.values():
+                if not info.alive:
+                    continue
+                if node_id and not info.node_id.hex().startswith(node_id):
+                    continue
+                reply = clients.get(info.address).call(
+                    "ReadLog",
+                    {"filename": filename,
+                     "tail": int(tail) if tail else None}, retries=3)
+                if "error" in reply:
+                    # The file lives on exactly one node — keep trying
+                    # the other matches before reporting failure.
+                    last_error = reply["error"]
+                    continue
+                return {"node_id": info.node_id.hex(),
+                        "data": reply["data"].decode(
+                            "utf-8", errors="replace"),
+                        "eof": reply["eof"]}
+            return {"error": last_error}
+        return web.json_response(await _call(build))
+
+    async def timeline(_req):
+        def build():
+            from ant_ray_tpu.util.timeline import build_chrome_trace  # noqa: PLC0415
+
+            events = gcs.call("TaskEventsGet", {"limit": 50000},
+                              retries=3) or []
+            return build_chrome_trace(events)
+        return web.json_response(await _call(build))
+
     async def metrics(_req):
         def build():
             series = gcs.call("MetricsGet", retries=3)
@@ -311,6 +367,9 @@ def create_app(gcs_address: str, session_dir: str):
     app.router.add_get("/api/objects", objects)
     app.router.add_get("/api/cluster_status", cluster_status)
     app.router.add_get("/api/insight", insight)
+    app.router.add_get("/api/timeline", timeline)
+    app.router.add_get("/api/logs", node_logs)
+    app.router.add_get("/api/logs/{filename}", node_log_read)
     app.router.add_get("/metrics", metrics)
     app.router.add_post("/api/jobs", submit_job)
     app.router.add_get("/api/jobs", list_jobs)

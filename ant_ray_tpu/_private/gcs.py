@@ -74,9 +74,12 @@ class GcsServer:
         #  src/ray/gcs/gcs_virtual_cluster_manager.h:30)
         self._virtual_clusters: dict[str, dict] = {}
         self._job_vc: dict[JobID, str] = {}
-        # bounded ring of flow-insight events (ant-fork, util/insight)
         from collections import deque  # noqa: PLC0415
 
+        # bounded ring of task lifecycle events (ref: the GCS task-event
+        # aggregator fed by core-worker TaskEventBuffers)
+        self._task_events: deque = deque(maxlen=50000)
+        # bounded ring of flow-insight events (ant-fork, util/insight)
         self._insight_events: deque = deque(maxlen=10000)
         self._dirty_locations: set[ObjectID] = set()
         # ---- pubsub (ref: src/ray/pubsub/publisher.h — long-poll
@@ -143,6 +146,8 @@ class GcsServer:
             "GetJobVirtualCluster": self._get_job_virtual_cluster,
             "InsightRecord": self._insight_record,
             "InsightGet": self._insight_get,
+            "TaskEventsAdd": self._task_events_add,
+            "TaskEventsGet": self._task_events_get,
             "SubPoll": self._sub_poll,
             "Shutdown": self._shutdown_rpc,
         })
@@ -516,6 +521,20 @@ class GcsServer:
     async def _insight_get(self, payload):
         limit = int(payload.get("limit", 1000))
         events = list(self._insight_events)
+        return events[-limit:]
+
+    # ------------------------------------------------------ task events
+
+    async def _task_events_add(self, payload):
+        self._task_events.extend(payload.get("events", ()))
+        return True
+
+    async def _task_events_get(self, payload):
+        limit = int(payload.get("limit", 50000))
+        task_id = payload.get("task_id")
+        events = list(self._task_events)
+        if task_id is not None:
+            events = [e for e in events if e.get("task_id") == task_id]
         return events[-limit:]
 
     # -------------------------------------------------------- metrics

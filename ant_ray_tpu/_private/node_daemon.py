@@ -131,6 +131,8 @@ class NodeManager:
             "DeleteObject": self._delete_object,
             "ContainsObject": self._contains_object,
             "GetNodeInfo": self._get_node_info,
+            "ListLogs": self._list_logs,
+            "ReadLog": self._read_log,
             "Shutdown": self._shutdown_rpc,
         })
         self.address = self._server.start()
@@ -168,6 +170,47 @@ class NodeManager:
     async def _register(self):
         gcs = self._clients.get(self._gcs_address)
         await gcs.call_async("RegisterNode", self._node_info(), timeout=30)
+
+    # ------------------------------------------------------ log monitor
+    # (ref: python/ray/_private/log_monitor.py + the dashboard log
+    # agent — here the node daemon itself serves its session logs, so
+    # debugging worker N never needs ssh.)
+
+    def _logs_dir(self) -> str:
+        return os.path.join(self._session_dir, "logs")
+
+    async def _list_logs(self, _payload):
+        logs_dir = self._logs_dir()
+        if not os.path.isdir(logs_dir):
+            return []
+        out = []
+        for name in sorted(os.listdir(logs_dir)):
+            path = os.path.join(logs_dir, name)
+            try:
+                out.append({"filename": name,
+                            "size": os.path.getsize(path)})
+            except OSError:
+                continue
+        return out
+
+    async def _read_log(self, payload):
+        name = os.path.basename(payload["filename"])  # no traversal
+        path = os.path.join(self._logs_dir(), name)
+        max_bytes = min(int(payload.get("max_bytes", 65536)), 4 << 20)
+        tail = payload.get("tail")
+        try:
+            size = os.path.getsize(path)
+            offset = int(payload.get("offset", 0))
+            if tail is not None:  # last N bytes
+                offset = max(0, size - int(tail))
+            with open(path, "rb") as f:
+                f.seek(offset)
+                data = f.read(max_bytes)
+            return {"data": data, "offset": offset,
+                    "next_offset": offset + len(data),
+                    "eof": offset + len(data) >= size}
+        except OSError as e:
+            return {"error": str(e)}
 
     async def _get_node_info(self, _payload):
         return self._node_info()

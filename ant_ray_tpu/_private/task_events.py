@@ -1,0 +1,125 @@
+"""Task event buffer: per-process buffering of task lifecycle events,
+flushed in batches to the GCS aggregator.
+
+Mirror of the reference's TaskEventBuffer (ref:
+src/ray/core_worker/task_event_buffer.h — workers buffer status-change
+events and periodically flush to the GCS task-event aggregator; the
+timeline / state API read the aggregate).  Events here are plain dicts:
+
+    {"task_id", "name", "event", "ts", "pid", "node_id", "worker",
+     "parent_task_id", "actor_id"}
+
+``event`` ∈ {submitted, started, finished, failed}.  Flushes ride one
+oneway RPC per batch (size- or age-triggered from the record path plus
+an atexit drain — no dedicated thread on the hot path).  The executing
+task's id is kept in a contextvar so nested submissions record their
+parent, giving the timeline its span tree without a full OTel stack.
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextvars
+import os
+import threading
+import time
+
+_MAX_BUFFER = 512
+_FLUSH_AGE_S = 1.0
+
+current_task = contextvars.ContextVar("art_current_task", default=None)
+
+
+class TaskEventBuffer:
+    def __init__(self):
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._last_flush = time.monotonic()
+        self._registered = False
+        self._flusher: threading.Thread | None = None
+
+    def record(self, runtime, *, task_id: str, name: str, event: str,
+               actor_id: str | None = None,
+               parent_task_id: str | None = None) -> None:
+        entry = {
+            "task_id": task_id, "name": name, "event": event,
+            "ts": time.time(), "pid": os.getpid(),
+            "node_id": os.environ.get("ART_NODE_ID", ""),
+            "worker": getattr(runtime, "address", ""),
+            "actor_id": actor_id,
+            "parent_task_id": parent_task_id or current_task.get(),
+        }
+        flush_now = False
+        register = False
+        with self._lock:
+            self._events.append(entry)
+            now = time.monotonic()
+            if len(self._events) >= _MAX_BUFFER or \
+                    now - self._last_flush > _FLUSH_AGE_S:
+                flush_now = True
+            if not self._registered:  # decide under the lock — two
+                self._registered = True  # first-recording threads must
+                register = True          # not double-start the flusher
+        if flush_now:
+            self.flush()
+        if register:
+            atexit.register(self.flush)
+            # Periodic flusher: without it, the last events of a
+            # long-lived worker (e.g. "finished" for its final task)
+            # would sit buffered until the next record or process exit.
+            self._flusher = threading.Thread(
+                target=self._flush_loop, daemon=True,
+                name="art-task-events")
+            self._flusher.start()
+
+    def _flush_loop(self) -> None:
+        while True:
+            time.sleep(_FLUSH_AGE_S)
+            self.flush()
+
+    def flush(self) -> None:
+        # The runtime is resolved per flush — a captured one would
+        # outlive art.shutdown()/art.init() and drain this shared
+        # buffer into the previous cluster's dead GCS.
+        runtime = _runtime()
+        if runtime is None:
+            return
+        with self._lock:
+            if not self._events:
+                return
+            batch, self._events = self._events, []
+            self._last_flush = time.monotonic()
+        try:
+            runtime._send_oneway(runtime.gcs_address, "TaskEventsAdd",
+                                 {"events": batch})
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
+
+
+_buffer = TaskEventBuffer()
+
+
+def _runtime():
+    from ant_ray_tpu._private.config import global_config  # noqa: PLC0415
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    if not global_config().enable_task_events:
+        return None
+    if not global_worker.connected:
+        return None
+    runtime = global_worker.runtime
+    return runtime if hasattr(runtime, "_send_oneway") else None
+
+
+def record(task_id: str, name: str, event: str, *,
+           actor_id: str | None = None,
+           parent_task_id: str | None = None) -> None:
+    runtime = _runtime()
+    if runtime is None:
+        return
+    _buffer.record(runtime, task_id=task_id, name=name, event=event,
+                   actor_id=actor_id, parent_task_id=parent_task_id)
+
+
+def flush() -> None:
+    _buffer.flush()

@@ -112,6 +112,15 @@ class TaskExecutor:
             insight.record_call_begin(spec.function_name,
                                       spec.task_id.hex())
             started = time.monotonic()
+        events = None
+        if global_config().enable_task_events:
+            from ant_ray_tpu._private import task_events as events  # noqa: PLC0415
+
+            events.record(
+                spec.task_id.hex(), spec.function_name, "started",
+                actor_id=spec.actor_id.hex() if spec.actor_id else None)
+            # Nested submissions from this task record it as parent.
+            _task_token = events.current_task.set(spec.task_id.hex())
         try:
             if spec.actor_id is not None:
                 if self.actor_instance is None:
@@ -144,13 +153,36 @@ class TaskExecutor:
                 insight.record_call_end(
                     spec.function_name, spec.task_id.hex(),
                     time.monotonic() - started, error=True)
+            if events is not None:
+                events.current_task.reset(_task_token)
+                events.record(spec.task_id.hex(), spec.function_name,
+                              "failed")
             return self._error_returns(spec, err)
+        if spec.num_returns == -1:  # streaming generator task
+            # The stream is consumed HERE — events record after it
+            # drains (and with the contextvar still set, so tasks the
+            # generator body spawns keep their parent linkage).
+            out = self._stream_returns(spec, result)
+            _count, stream_err = out["returns"][0][1]
+            if insight is not None:
+                insight.record_call_end(
+                    spec.function_name, spec.task_id.hex(),
+                    time.monotonic() - started,
+                    error=stream_err is not None)
+            if events is not None:
+                events.current_task.reset(_task_token)
+                events.record(spec.task_id.hex(), spec.function_name,
+                              "failed" if stream_err is not None
+                              else "finished")
+            return out
         if insight is not None:
             insight.record_call_end(spec.function_name,
                                     spec.task_id.hex(),
                                     time.monotonic() - started)
-        if spec.num_returns == -1:  # streaming generator task
-            return self._stream_returns(spec, result)
+        if events is not None:
+            events.current_task.reset(_task_token)
+            events.record(spec.task_id.hex(), spec.function_name,
+                          "finished")
         values = [result] if spec.num_returns == 1 else list(result)
         if len(values) != spec.num_returns:
             err = exceptions.TaskError(

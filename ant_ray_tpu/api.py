@@ -128,6 +128,9 @@ _exported_config_env: list = []
 
 
 def shutdown() -> None:
+    from ant_ray_tpu._private import task_events  # noqa: PLC0415
+
+    task_events.flush()  # drain before the runtime goes away
     global_worker.shutdown()
     # Undo _system_config env exports (restoring any pre-existing user
     # value) so the next init() in this process starts clean.
@@ -224,3 +227,12 @@ def available_resources() -> dict:
 def nodes() -> list[dict]:
     global_worker._check_connected()
     return global_worker.runtime.nodes()
+
+
+def timeline(filename: str | None = None):
+    """Chrome-trace dump of the cluster's task schedule (ref:
+    ray.timeline)."""
+    global_worker._check_connected()
+    from ant_ray_tpu.util.timeline import timeline as _timeline  # noqa: PLC0415
+
+    return _timeline(filename)

@@ -62,6 +62,82 @@ def list_objects() -> list[dict]:
     return _gcs().call("ListObjects", retries=3)
 
 
+# State precedence — events may arrive out of order (the driver's
+# "submitted" batch can flush after the worker's "finished"), so a
+# task's state only ever moves forward through this ranking.
+_TASK_STATE_RANK = {"PENDING": 0, "PENDING_EXECUTION": 1, "RUNNING": 2,
+                    "FINISHED": 3, "FAILED": 3}
+
+
+def list_tasks(limit: int = 1000) -> list[dict]:
+    """Task lifecycle events aggregated per task (ref: state API
+    list_tasks over the GCS task-event table)."""
+    events = _gcs().call("TaskEventsGet", {"limit": 50000},
+                         retries=3) or []
+    by_task: dict[str, dict] = {}
+    for event in events:
+        record = by_task.setdefault(event["task_id"], {
+            "task_id": event["task_id"], "name": event["name"],
+            "state": "PENDING", "node_id": "", "actor_id":
+            event.get("actor_id")})
+        state = {"submitted": "PENDING_EXECUTION",
+                 "started": "RUNNING",
+                 "finished": "FINISHED",
+                 "failed": "FAILED"}.get(event["event"])
+        if state is not None and _TASK_STATE_RANK[state] >= \
+                _TASK_STATE_RANK[record["state"]]:
+            record["state"] = state
+        if event["event"] == "started":
+            record["node_id"] = event.get("node_id", "")
+    return list(by_task.values())[-limit:]
+
+
+def _matching_node_clients(node_id: str | None):
+    """Yield (client, node_id_hex) for every alive node matching the id
+    prefix — callers try each until one succeeds (a file lives on ONE
+    node; with no node_id given, the right node is unknown a priori)."""
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    nodes = _gcs().call("GetAllNodes", retries=3)
+    runtime = global_worker.runtime
+    matched = False
+    for info in nodes.values():
+        if not info.alive:
+            continue
+        if node_id is None or info.node_id.hex().startswith(node_id):
+            matched = True
+            yield runtime._clients.get(info.address), info.node_id.hex()
+    if not matched:
+        raise ValueError(f"no alive node matches {node_id!r}")
+
+
+def list_logs(node_id: str | None = None) -> dict:
+    """Log files available on a node (default: the first alive node).
+    (ref: ray.util.state.list_logs via the per-node log agent.)"""
+    for client, nid in _matching_node_clients(node_id):
+        return {"node_id": nid,
+                "files": client.call("ListLogs", {}, retries=3)}
+    raise ValueError(f"no alive node matches {node_id!r}")
+
+
+def get_log(filename: str, node_id: str | None = None, *,
+            tail: int | None = None, offset: int = 0,
+            max_bytes: int = 65536) -> str:
+    """Read a log file from a node without ssh (ref:
+    ray.util.state.get_log).  Without a node_id every alive node is
+    tried — the file lives on exactly one."""
+    last_error = "no nodes"
+    for client, _nid in _matching_node_clients(node_id):
+        reply = client.call("ReadLog", {
+            "filename": filename, "offset": offset, "tail": tail,
+            "max_bytes": max_bytes}, retries=3)
+        if "error" in reply:
+            last_error = reply["error"]
+            continue
+        return reply["data"].decode("utf-8", errors="replace")
+    raise FileNotFoundError(last_error)
+
+
 def summarize_cluster() -> dict:
     nodes = list_nodes()
     actors = list_actors()

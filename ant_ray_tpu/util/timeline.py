@@ -1,0 +1,83 @@
+"""Chrome-trace timeline export (ref capability: ``ray timeline`` —
+python/ray/_private/state.py chrome_tracing_dump over GCS task events).
+
+``timeline()`` pairs each task's started/finished events into complete
+("ph": "X") slices — rows grouped by node (pid) and worker process
+(tid) — plus flow arrows ("s"/"f") from submission to execution, so
+chrome://tracing / Perfetto renders the cluster's task schedule with
+cross-process causality.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def fetch_task_events(limit: int = 50000) -> list[dict]:
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    runtime = global_worker.runtime
+    from ant_ray_tpu._private import task_events  # noqa: PLC0415
+
+    task_events.flush()  # this process's tail
+    return runtime._gcs.call("TaskEventsGet", {"limit": limit},
+                             retries=3) or []
+
+
+def build_chrome_trace(events: list[dict]) -> list[dict]:
+    by_task: dict[str, dict] = {}
+    for event in events:
+        record = by_task.setdefault(event["task_id"], {"events": {}})
+        record["events"][event["event"]] = event
+    trace: list[dict] = []
+    flow_id = 0
+    for task_id, record in by_task.items():
+        started = record["events"].get("started")
+        done = (record["events"].get("finished")
+                or record["events"].get("failed"))
+        submitted = record["events"].get("submitted")
+        if started is None:
+            continue
+        pid = started.get("node_id") or "node"
+        tid = f"worker-{started.get('pid', 0)}"
+        ts_us = started["ts"] * 1e6
+        dur_us = ((done["ts"] - started["ts"]) * 1e6
+                  if done is not None else 0.0)
+        failed = "failed" in record["events"]
+        trace.append({
+            "ph": "X", "cat": "task",
+            "name": started.get("name", task_id),
+            "pid": pid, "tid": tid, "ts": ts_us, "dur": dur_us,
+            "args": {"task_id": task_id,
+                     # the parent is known at submission (the driver or
+                     # the executing task that spawned this one)
+                     "parent_task_id": (submitted or started).get(
+                         "parent_task_id"),
+                     "status": "failed" if failed else "ok"},
+            **({"cname": "terrible"} if failed else {}),
+        })
+        if submitted is not None:
+            flow_id += 1
+            trace.append({
+                "ph": "s", "cat": "submit", "id": flow_id,
+                "name": "submit",
+                "pid": submitted.get("node_id") or "driver",
+                "tid": f"worker-{submitted.get('pid', 0)}",
+                "ts": submitted["ts"] * 1e6})
+            trace.append({
+                "ph": "f", "cat": "submit", "id": flow_id,
+                "name": "submit", "bp": "e",
+                "pid": pid, "tid": tid, "ts": ts_us})
+    return trace
+
+
+def timeline(filename: str | None = None) -> list[dict] | str:
+    """Chrome trace of the cluster's task schedule.  With ``filename``
+    writes the JSON and returns the path (load in chrome://tracing or
+    https://ui.perfetto.dev); without, returns the event list."""
+    trace = build_chrome_trace(fetch_task_events())
+    if filename is None:
+        return trace
+    with open(filename, "w") as f:
+        json.dump(trace, f)
+    return filename

@@ -152,3 +152,65 @@ def test_runtime_env_validation():
         validate({"pip": ["requests"]})
     with pytest.raises(ValueError, match="str->str"):
         validate({"env_vars": {"A": 1}})
+
+
+# -------------------------------------------------- logs & timeline
+
+
+def test_log_monitor_endpoints(dashboard_cluster):
+    """Per-node log listing and reads through the dashboard — no ssh
+    (ref: log_monitor.py + dashboard log agent)."""
+    @art.remote
+    def noisy():
+        print("hello from the worker")
+        return 1
+
+    assert art.get(noisy.remote()) == 1
+    time.sleep(0.5)
+    listing = _get_json(dashboard_cluster + "/api/logs")
+    assert listing and listing[0]["files"], listing
+    names = [f["filename"] for f in listing[0]["files"]]
+    worker_logs = [n for n in names if n.startswith("worker-")]
+    assert worker_logs, names
+    body = _get_json(
+        dashboard_cluster + f"/api/logs/{worker_logs[0]}?tail=4096")
+    assert "data" in body and body["eof"]
+
+
+def test_state_api_logs_and_tasks(dashboard_cluster):
+    from ant_ray_tpu.util import state
+
+    @art.remote
+    def stately():
+        return 7
+
+    assert art.get(stately.remote()) == 7
+    listing = state.list_logs()
+    assert listing["files"]
+    text = state.get_log(listing["files"][0]["filename"], tail=2048)
+    assert isinstance(text, str)
+
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        tasks = [t for t in state.list_tasks()
+                 if t["name"].endswith("stately")]
+        if tasks and tasks[0]["state"] == "FINISHED":
+            break
+        time.sleep(0.3)
+    assert tasks and tasks[0]["state"] == "FINISHED"
+
+
+def test_timeline_dashboard_endpoint(dashboard_cluster):
+    @art.remote
+    def traced_for_dash():
+        return 1
+
+    assert art.get(traced_for_dash.remote()) == 1
+    deadline = time.monotonic() + 15
+    slices = []
+    while time.monotonic() < deadline and not slices:
+        trace = _get_json(dashboard_cluster + "/api/timeline")
+        slices = [t for t in trace if t.get("ph") == "X"
+                  and t["name"].endswith("traced_for_dash")]
+        time.sleep(0.3)
+    assert slices

@@ -1,0 +1,91 @@
+"""Task events + Chrome-trace timeline (ref test model:
+test_task_events.py + ray timeline)."""
+
+import json
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    art.init(num_cpus=2, num_tpus=0)
+    yield None
+    art.shutdown()
+
+
+def _events_for(name, deadline_s=20):
+    from ant_ray_tpu.util.timeline import fetch_task_events
+
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        events = [e for e in fetch_task_events()
+                  if e["name"].endswith(name)]
+        kinds = {e["event"] for e in events}
+        if {"submitted", "started"} <= kinds and \
+                ({"finished"} & kinds or {"failed"} & kinds):
+            return events
+        time.sleep(0.3)
+    raise AssertionError(f"no complete event set for {name}")
+
+
+def test_lifecycle_events_reach_gcs(cluster):
+    @art.remote
+    def traced_task(x):
+        return x + 1
+
+    assert art.get(traced_task.remote(1)) == 2
+    events = _events_for("traced_task")
+    kinds = {e["event"] for e in events}
+    assert {"submitted", "started", "finished"} <= kinds
+    started = next(e for e in events if e["event"] == "started")
+    assert started["pid"] > 0 and started["node_id"]
+
+
+def test_failed_task_records_failed_event(cluster):
+    @art.remote
+    def exploding():
+        raise ValueError("boom")
+
+    with pytest.raises(Exception, match="boom"):
+        art.get(exploding.remote())
+    events = _events_for("exploding")
+    assert any(e["event"] == "failed" for e in events)
+
+
+def test_nested_task_records_parent(cluster):
+    @art.remote
+    def inner_leaf():
+        return 1
+
+    @art.remote
+    def outer_parent():
+        import ant_ray_tpu as art2
+
+        return art2.get(inner_leaf.remote())
+
+    assert art.get(outer_parent.remote()) == 1
+    inner = _events_for("inner_leaf")
+    outer = _events_for("outer_parent")
+    outer_id = outer[0]["task_id"]
+    submitted = next(e for e in inner if e["event"] == "submitted")
+    assert submitted["parent_task_id"] == outer_id
+
+
+def test_chrome_trace_export(cluster, tmp_path):
+    @art.remote
+    def slice_me():
+        time.sleep(0.05)
+        return "ok"
+
+    assert art.get(slice_me.remote()) == "ok"
+    _events_for("slice_me")
+    path = art.timeline(str(tmp_path / "trace.json"))
+    trace = json.loads(open(path).read())
+    slices = [t for t in trace if t["ph"] == "X"
+              and t["name"].endswith("slice_me")]
+    assert slices and slices[0]["dur"] >= 50_000 * 0.5  # ≥ ~25ms in us
+    assert any(t["ph"] == "s" for t in trace)  # submit flow arrows
+    assert any(t["ph"] == "f" for t in trace)
