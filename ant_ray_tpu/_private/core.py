@@ -1120,6 +1120,15 @@ class ClusterRuntime(CoreRuntime):
     def create_actor(self, actor_class, args, kwargs, options: ActorOptions):
         from ant_ray_tpu.actor import ActorHandle  # noqa: PLC0415
 
+        declared = set(options.concurrency_groups or ())
+        undeclared = {g for g in actor_class.method_concurrency_groups()
+                      .values() if g not in declared}
+        if undeclared:
+            raise ValueError(
+                f"Methods of {actor_class._class_name} use concurrency "
+                f"group(s) {sorted(undeclared)} not declared in "
+                f"concurrency_groups={sorted(declared)} "
+                "(ref: @ray.remote(concurrency_groups=...))")
         cls_key = self.export(actor_class.cls, "cls")
         actor_id = ActorID.of(self.job_id)
         ser = serialization.serialize((args, kwargs))
@@ -1156,6 +1165,7 @@ class ClusterRuntime(CoreRuntime):
                           if options.max_restarts is not None
                           else cfg.actor_max_restarts_default),
             max_concurrency=options.max_concurrency,
+            concurrency_groups=options.concurrency_groups,
             name=options.name,
             namespace=options.namespace or "default",
             lifetime=options.lifetime,
@@ -1177,6 +1187,8 @@ class ClusterRuntime(CoreRuntime):
             "method_names": actor_class.method_names(),
             "method_num_returns": actor_class.method_num_returns(),
             "max_task_retries": options.max_task_retries,
+            "method_concurrency_groups":
+                actor_class.method_concurrency_groups(),
         }
         self._actor_meta_cache[actor_id] = meta
         self._gcs.call("KVPut", {
@@ -1186,7 +1198,9 @@ class ClusterRuntime(CoreRuntime):
                            meta["method_names"],
                            max_concurrency=options.max_concurrency,
                            method_num_returns=meta["method_num_returns"],
-                           max_task_retries=options.max_task_retries)
+                           max_task_retries=options.max_task_retries,
+                           method_concurrency_groups=meta[
+                               "method_concurrency_groups"])
 
     def get_actor(self, name: str, namespace: str | None):
         from ant_ray_tpu.actor import ActorHandle  # noqa: PLC0415
@@ -1206,7 +1220,9 @@ class ClusterRuntime(CoreRuntime):
         return ActorHandle(actor_id, info["class_name"],
                            meta["method_names"],
                            method_num_returns=meta["method_num_returns"],
-                           max_task_retries=meta.get("max_task_retries", 0))
+                           max_task_retries=meta.get("max_task_retries", 0),
+                           method_concurrency_groups=meta.get(
+                               "method_concurrency_groups", {}))
 
     def kill_actor(self, handle, no_restart: bool = True):
         self._gcs.call("KillActor", {
@@ -1258,6 +1274,7 @@ class ClusterRuntime(CoreRuntime):
                          getattr(handle, "_max_task_retries", 0)),
             actor_id=actor_id,
             method_name=method_name,
+            concurrency_group=options.concurrency_group,
         )
 
         if global_config().enable_task_events:

@@ -35,6 +35,9 @@ class TaskSpec:
     actor_id: ActorID | None = None
     method_name: str = ""
     sequence_no: int = -1         # per-submitter ordering for actor tasks
+    # Named executor pool this call runs in (ref: ConcurrencyGroupManager,
+    # src/ray/core_worker/task_execution/concurrency_group_manager.h)
+    concurrency_group: str = ""
     # Placement-group routing
     placement_group_id: "object | None" = None
     placement_group_bundle_index: int = -1
@@ -58,6 +61,8 @@ class ActorSpec:
     placement_resources: dict[str, float] = field(default_factory=dict)
     max_restarts: int = 0
     max_concurrency: int = 1
+    # name -> pool size; methods opt in via @method(concurrency_group=...)
+    concurrency_groups: dict[str, int] | None = None
     name: str = ""
     namespace: str = "default"
     lifetime: str | None = None

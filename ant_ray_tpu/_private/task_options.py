@@ -24,6 +24,9 @@ class TaskOptions:
     # Node-label constraint, e.g. {"tpu-pod-name": "slice-A"}
     # (ref: @ray.remote(label_selector=...))
     label_selector: dict | None = None
+    # Actor-method routing to a named executor pool (actor tasks only;
+    # ref: @ray.method(concurrency_group=...))
+    concurrency_group: str = ""
     _metadata: dict = dataclasses.field(default_factory=dict)
 
     def resource_demand(self, default_num_cpus: float = 1.0) -> dict[str, float]:
@@ -53,6 +56,9 @@ class ActorOptions(TaskOptions):
     max_restarts: int | None = None
     max_task_retries: int = 0
     max_concurrency: int = 1
+    # Named bounded thread pools, e.g. {"io": 2, "compute": 4} (ref:
+    # @ray.remote(concurrency_groups=...), concurrency_group_manager.h)
+    concurrency_groups: dict[str, int] | None = None
     max_pending_calls: int = -1
     lifetime: str | None = None            # None | "detached"
     namespace: str | None = None

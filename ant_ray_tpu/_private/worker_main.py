@@ -18,6 +18,7 @@ import queue
 import threading
 import time
 import traceback
+from concurrent.futures import ThreadPoolExecutor
 
 from ant_ray_tpu import exceptions
 from ant_ray_tpu._private import serialization
@@ -48,7 +49,12 @@ class TaskExecutor:
         self.actor_instance = None
         self.actor_spec: ActorSpec | None = None
         self._async_loop: asyncio.AbstractEventLoop | None = None
-        self._pool: list[threading.Thread] = []
+        # Named bounded executor pools (ref: ConcurrencyGroupManager,
+        # src/ray/core_worker/task_execution/concurrency_group_manager.h):
+        # "" is the default pool, sized by max_concurrency; each declared
+        # concurrency group gets its own pool so one group saturating
+        # never starves another.
+        self._group_pools: dict[str, "ThreadPoolExecutor"] = {}
         self._io = IoThread.get()
         self._main = threading.Thread(target=self._run_loop, daemon=True,
                                       name="art-executor")
@@ -70,13 +76,43 @@ class TaskExecutor:
             spec, fut = self.queue.get()
             if spec is None:
                 return
-            if (self.actor_spec is not None
-                    and self.actor_spec.max_concurrency > 1):
-                t = threading.Thread(target=self._execute_safely,
-                                     args=(spec, fut), daemon=True)
-                t.start()
+            aspec = self.actor_spec
+            group = getattr(spec, "concurrency_group", "") or ""
+            # Declaring ANY concurrency group makes the actor threaded
+            # (ref semantics: grouped actors give up per-call ordering),
+            # so a long default-group call can never starve the groups.
+            threaded = aspec is not None and (
+                aspec.max_concurrency > 1 or aspec.concurrency_groups)
+            if threaded:
+                try:
+                    self._pool_for(group).submit(
+                        self._execute_safely, spec, fut)
+                except Exception as e:  # noqa: BLE001 — bad group etc.
+                    self._reply_exc(fut, exceptions.ArtError(repr(e)))
             else:
                 self._execute_safely(spec, fut)
+
+    def _pool_for(self, group: str) -> "ThreadPoolExecutor":
+        pool = self._group_pools.get(group)
+        if pool is None:
+            aspec = self.actor_spec
+            if group:
+                limit = (aspec.concurrency_groups or {}).get(group)
+                if limit is None:
+                    # Loud failure, not a silent 1-wide pool: an
+                    # undeclared group (e.g. via .options()) is a caller
+                    # bug the creation-time check can't see.
+                    raise exceptions.ArtError(
+                        f"concurrency group {group!r} is not declared on "
+                        f"this actor (declared: "
+                        f"{sorted(aspec.concurrency_groups or ())})")
+            else:
+                limit = aspec.max_concurrency
+            pool = ThreadPoolExecutor(
+                max_workers=max(1, int(limit or 1)),
+                thread_name_prefix=f"art-cg-{group or 'default'}")
+            self._group_pools[group] = pool
+        return pool
 
     def _execute_safely(self, spec: TaskSpec, fut: asyncio.Future):
         try:

@@ -11,25 +11,29 @@ from ant_ray_tpu._private.task_options import ActorOptions, TaskOptions
 
 class ActorMethod:
     def __init__(self, handle: "ActorHandle", method_name: str,
-                 num_returns: int = 1):
+                 num_returns: int = 1, concurrency_group: str = ""):
         self._handle = handle
         self._method_name = method_name
         self._num_returns = num_returns
+        self._concurrency_group = concurrency_group
 
     def remote(self, *args, **kwargs):
         from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
 
         return global_worker.submit_actor_task(
             self._handle, self._method_name, args, kwargs,
-            TaskOptions(num_returns=self._num_returns),
+            TaskOptions(num_returns=self._num_returns,
+                        concurrency_group=self._concurrency_group),
         )
 
     def options(self, **options) -> "ActorMethod":
         num_returns = options.pop("num_returns", self._num_returns)
+        group = options.pop("concurrency_group", self._concurrency_group)
         if options:
             raise ValueError(
                 f"Unsupported actor-method options: {sorted(options)}")
-        return ActorMethod(self._handle, self._method_name, num_returns)
+        return ActorMethod(self._handle, self._method_name, num_returns,
+                           group)
 
     def bind(self, *args, **kwargs):
         try:
@@ -47,13 +51,15 @@ class ActorHandle:
     def __init__(self, actor_id: ActorID, class_name: str,
                  method_names: tuple[str, ...] = (), max_concurrency: int = 1,
                  method_num_returns: dict[str, int] | None = None,
-                 max_task_retries: int = 0):
+                 max_task_retries: int = 0,
+                 method_concurrency_groups: dict[str, str] | None = None):
         self._actor_id = actor_id
         self._class_name = class_name
         self._method_names = tuple(method_names)
         self._max_concurrency = max_concurrency
         self._method_num_returns = dict(method_num_returns or {})
         self._max_task_retries = max_task_retries
+        self._method_concurrency_groups = dict(method_concurrency_groups or {})
 
     @property
     def actor_id(self) -> ActorID:
@@ -70,7 +76,8 @@ class ActorHandle:
             raise AttributeError(
                 f"Actor {self._class_name} has no method {name!r}"
             )
-        return ActorMethod(self, name, self._method_num_returns.get(name, 1))
+        return ActorMethod(self, name, self._method_num_returns.get(name, 1),
+                           self._method_concurrency_groups.get(name, ""))
 
     def __repr__(self):
         return f"ActorHandle({self._class_name}, {self._actor_id.hex()})"
@@ -80,7 +87,7 @@ class ActorHandle:
             ActorHandle,
             (self._actor_id, self._class_name, self._method_names,
              self._max_concurrency, self._method_num_returns,
-             self._max_task_retries),
+             self._max_task_retries, self._method_concurrency_groups),
         )
 
     def __hash__(self):
@@ -133,6 +140,16 @@ class ActorClass:
             n = getattr(getattr(self._cls, name), "__art_num_returns__", 1)
             if n != 1:
                 out[name] = n
+        return out
+
+    def method_concurrency_groups(self) -> dict[str, str]:
+        """Per-method group declared with ``@method(concurrency_group=...)``."""
+        out = {}
+        for name in self.method_names():
+            g = getattr(getattr(self._cls, name),
+                        "__art_concurrency_group__", "")
+            if g:
+                out[name] = g
         return out
 
 

@@ -37,8 +37,11 @@ def init(
 
     - ``address=None``: start a fresh single-node cluster in subprocesses
       (head control store + node daemon + workers), like ``ray.init()``.
-    - ``address="art://host:port"`` or ``"host:port"``: connect to an
-      existing head.
+    - ``address="host:port"``: connect to an existing head as a driver
+      (the process must be on a cluster node).
+    - ``address="art://host:port"``: connect to a client proxy server
+      from OUTSIDE the cluster (ref: ray.init("ray://...") — Ray Client);
+      no daemons run locally, every call is proxied.
     - ``local_mode=True``: synchronous in-process execution, no daemons.
     """
     if global_worker.connected:
@@ -72,6 +75,15 @@ def init(
         global_worker.runtime = worker_mod.LocalModeRuntime(job_id)
         global_worker.mode = LOCAL_MODE
         return ClientContext(LOCAL_MODE)
+
+    if address is not None and address.startswith("art://"):
+        from ant_ray_tpu.util.client import ClientRuntime  # noqa: PLC0415
+
+        global_worker.runtime = ClientRuntime.connect(
+            address.removeprefix("art://"))
+        global_worker.mode = CLUSTER_MODE
+        _register_atexit_once()
+        return ClientContext(CLUSTER_MODE)
 
     try:
         from ant_ray_tpu._private.core import ClusterRuntime  # noqa: PLC0415
@@ -170,11 +182,13 @@ def _make_remote(fn_or_cls, options: dict):
     return RemoteFunction(fn_or_cls, opts)
 
 
-def method(num_returns: int = 1):
+def method(num_returns: int = 1, concurrency_group: str = ""):
     """Per-method options on actor classes (ref: ray.method)."""
 
     def decorator(fn):
         fn.__art_num_returns__ = num_returns
+        if concurrency_group:
+            fn.__art_concurrency_group__ = concurrency_group
         return fn
 
     return decorator

@@ -119,9 +119,12 @@ def test_asha_stops_bad_trials_early(cluster):
     best = grid.get_best_result("loss", mode="min")
     assert best.config["offset"] == 0.0
     iters = {r.config["offset"]: len(r.history) for r in grid}
-    # the best trial ran to the stop bound; the worst was culled early
+    # The best trial ran to the stop bound.  ASHA is *asynchronous*:
+    # which bad trial gets culled depends on rung-arrival order (a
+    # leader sets the cutoff others are judged by), so assert that
+    # early stopping happened — not which victim it picked.
     assert iters[0.0] == 12
-    assert iters[3.0] < 12
+    assert any(n < 12 for cfg, n in iters.items() if cfg != 0.0), iters
 
 
 def test_median_stopping_rule_decisions():
