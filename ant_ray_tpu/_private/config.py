@@ -77,6 +77,10 @@ class Config:
     worker_lease_timeout_s: float = 0.5
     # Spill a queued task to another node if it has waited this long locally.
     spillback_timeout_s: float = 0.2
+    # How long a task submission keeps following spillback redirects on
+    # a busy cluster before giving up (the redirect chain itself is
+    # unbounded, matching the reference submitter).
+    lease_retry_deadline_s: float = 120.0
 
     # ---- fault tolerance ----
     task_max_retries_default: int = 3

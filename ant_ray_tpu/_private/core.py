@@ -832,9 +832,19 @@ class ClusterRuntime(CoreRuntime):
         else:
             node = self._node
         infeasible_deadline: float | None = None
+        # Spillback is redirect-following, not a retry budget: on a
+        # saturated cluster two busy nodes legitimately bounce a lease
+        # between each other until capacity frees (the reference's
+        # submitter follows retry_at_raylet_address unboundedly,
+        # normal_task_submitter.cc:435).  Bound by TIME, not hops, and
+        # back off as the bounce count grows so the ping-pong doesn't
+        # melt the control plane.
+        deadline = time.monotonic() + global_config().lease_retry_deadline_s
         hops = 0
-        while hops < 16:
+        while time.monotonic() < deadline:
             hops += 1
+            if hops > 4:
+                await asyncio.sleep(min(0.05 * (hops - 4), 0.5))
             reply = await node.call_async(
                 "LeaseWorker", lease_payload, timeout=-1)
             if "granted" in reply:
@@ -862,8 +872,10 @@ class ClusterRuntime(CoreRuntime):
                     if infeasible_deadline is None:
                         infeasible_deadline = time.monotonic() + \
                             global_config().infeasible_wait_s
+                        # Provisioning may take longer than the lease
+                        # deadline — an infeasible wait extends it.
+                        deadline = max(deadline, infeasible_deadline + 1)
                     if time.monotonic() < infeasible_deadline:
-                        hops -= 1  # waiting is not a spillback hop
                         await asyncio.sleep(1.0)
                         continue
                 raise exceptions.ArtError(
@@ -871,7 +883,11 @@ class ClusterRuntime(CoreRuntime):
                     f"{spec.resources} that no node can ever satisfy")
             else:
                 raise exceptions.ArtError(f"bad lease reply {reply}")
-        raise exceptions.ArtError("too many scheduling spillbacks")
+        raise exceptions.ArtError(
+            f"task {spec.function_name} could not be scheduled within "
+            f"{global_config().lease_retry_deadline_s:.0f}s "
+            f"({hops} spillback hops) — cluster saturated or demand "
+            f"unsatisfiable")
 
     # --------------------------------------------------- streaming returns
 

@@ -268,7 +268,11 @@ class NodeManager:
         if actor_spec is not None and runtime_env is None:
             runtime_env = actor_spec.runtime_env
         worker_id = WorkerID.from_random()
-        env = dict(os.environ)
+        from ant_ray_tpu._private import services  # noqa: PLC0415
+
+        # Workers run accelerator code: restore the TPU-plugin trigger
+        # the control-plane env stashed (no-op under the CPU pin).
+        env = services.accelerator_env(dict(os.environ))
         cwd = None
         if runtime_env:
             # packages were prefetched by _ensure_runtime_env (async);

@@ -18,6 +18,37 @@ logger = logging.getLogger(__name__)
 _READY_TIMEOUT_S = 30.0
 
 
+_AXON_TRIGGER = "PALLAS_AXON_POOL_IPS"
+_AXON_STASH = "ART_AXON_POOL_IPS_STASH"
+
+
+def control_plane_env() -> dict:
+    """Environment for control-plane daemons (GCS / node daemon /
+    dashboard), with the site-level TPU plugin registration DEFERRED.
+
+    On this image, ``sitecustomize`` imports all of jax at interpreter
+    start whenever ``PALLAS_AXON_POOL_IPS`` is set (~1.7s per process on
+    one core) — pure overhead for daemons that never run accelerator
+    code.  The trigger is stashed, not dropped: spawners of jax-needing
+    children (worker pool, job drivers) call :func:`accelerator_env` to
+    restore it."""
+    env = os.environ.copy()
+    trigger = env.pop(_AXON_TRIGGER, None)
+    if trigger is not None:
+        env[_AXON_STASH] = trigger
+    return env
+
+
+def accelerator_env(env: dict) -> dict:
+    """Restore the stashed TPU-plugin trigger for a child that runs
+    accelerator code — unless the tree is pinned to the CPU backend
+    (tests), where the registration would be dead weight."""
+    stashed = env.get(_AXON_STASH)
+    if stashed is not None and env.get("ART_JAX_PLATFORM", "") != "cpu":
+        env[_AXON_TRIGGER] = stashed
+    return env
+
+
 def _wait_ready(proc: subprocess.Popen, marker: str) -> str:
     """Read the child's stdout until `<marker> <address>` appears."""
     deadline = time.monotonic() + _READY_TIMEOUT_S
@@ -46,7 +77,7 @@ def start_gcs(session_dir: str,
          "--port", str(port), "--store", store,
          "--monitor-pid", str(os.getpid())],
         stdout=subprocess.PIPE, stderr=_log_file(session_dir, "gcs.err"),
-        start_new_session=True)
+        env=control_plane_env(), start_new_session=True)
     address = _wait_ready(proc, "GCS_READY")
     return proc, address
 
@@ -61,7 +92,7 @@ def start_node(gcs_address: str, resources: dict, session_dir: str,
          "--labels", json.dumps(labels or {}),
          "--monitor-pid", str(os.getpid())],
         stdout=subprocess.PIPE, stderr=_log_file(session_dir, "noded.err"),
-        start_new_session=True)
+        env=control_plane_env(), start_new_session=True)
     address = _wait_ready(proc, "NODED_READY")
     return proc, address
 
@@ -103,7 +134,7 @@ def start_dashboard(gcs_address: str, session_dir: str
          "--session-dir", session_dir,
          "--monitor-pid", str(os.getpid())],
         stdout=subprocess.PIPE, stderr=_log_file(session_dir, "dash.err"),
-        start_new_session=True)
+        env=control_plane_env(), start_new_session=True)
     url = _wait_ready(proc, "DASH_READY")
     return proc, url
 

@@ -83,7 +83,14 @@ class TaskExecutor:
             # so a long default-group call can never starve the groups.
             threaded = aspec is not None and (
                 aspec.max_concurrency > 1 or aspec.concurrency_groups)
-            if threaded:
+            if group and not threaded:
+                # Same loud failure _pool_for gives grouped actors: a
+                # group name on an ungrouped actor is a caller bug, not
+                # something to silently run inline.
+                self._reply_exc(fut, exceptions.ArtError(
+                    f"concurrency group {group!r} requested but this "
+                    "actor declares no concurrency_groups"))
+            elif threaded:
                 try:
                     self._pool_for(group).submit(
                         self._execute_safely, spec, fut)
@@ -320,12 +327,18 @@ def main():  # pragma: no cover — exercised via subprocess in tests
         level=global_config().log_level,
         format="[worker %(levelname)s %(asctime)s] %(message)s")
 
-    if os.environ.get("ART_JAX_PLATFORM"):
+    _pin = os.environ.get("ART_JAX_PLATFORM")
+    if _pin and (os.environ.get("PALLAS_AXON_POOL_IPS")
+                 or os.environ.get("JAX_PLATFORMS") != _pin):
         # Apply the platform pin at the jax.config level BEFORE any user
         # code's raw `import jax` triggers backend resolution: in envs
         # with an eagerly-initializing TPU plugin (e.g. a down tunnel),
         # JAX_PLATFORMS alone doesn't prevent a minutes-long stall on
-        # the first op.
+        # the first op.  The ~1.5s eager import is skipped only when the
+        # env-var pin already covers raw imports (JAX_PLATFORMS set to
+        # the same platform — raw `import jax` honors it) AND the axon
+        # site plugin can't have eagerly registered (trigger stashed by
+        # the control-plane env) — then jax loads lazily at first use.
         from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
 
         import_jax()

@@ -6,10 +6,25 @@ rllib/core/learner/learner_group.py:101): sampling **EnvRunner actors**
 feed a **LearnerGroup** whose update step is a jitted JAX function —
 the learner's DDP gradient averaging becomes a mesh/`pmean` program on
 TPU instead of torch DDP.
+
+Algorithm families (each a config-builder → ``build()`` → ``train()``):
+
+* **PPO** — clipped-surrogate on-policy (ref: rllib/algorithms/ppo/)
+* **DQN** — double-Q with uniform replay + target net
+  (ref: rllib/algorithms/dqn/)
+* **IMPALA** — V-trace-corrected actor-critic
+  (ref: rllib/algorithms/impala/)
 """
 
-from ant_ray_tpu.rllib.algorithm import Algorithm, PPOConfig
+from ant_ray_tpu.rllib.algorithm import (
+    DQN,
+    IMPALA,
+    Algorithm,
+    DQNConfig,
+    IMPALAConfig,
+    PPOConfig,
+)
 from ant_ray_tpu.rllib.env import CartPoleEnv, make_env, register_env
 
-__all__ = ["Algorithm", "CartPoleEnv", "PPOConfig", "make_env",
-           "register_env"]
+__all__ = ["Algorithm", "CartPoleEnv", "DQN", "DQNConfig", "IMPALA",
+           "IMPALAConfig", "PPOConfig", "make_env", "register_env"]

@@ -129,6 +129,8 @@ class _EnvRunner:
         return {"obs": obs_buf, "actions": act_buf, "logp": logp_buf,
                 "values": val_buf, "rewards": rew_buf, "dones": done_buf,
                 "last_values": last_values,
+                # post-fragment obs: V-trace bootstraps from V(s_T)
+                "last_obs": self.obs.copy(),
                 "episode_returns": completed}
 
 
@@ -159,6 +161,8 @@ class Algorithm:
 
         self._runners = self._make_runners()
 
+    _runner_cls = _EnvRunner
+
     def _make_runners(self):
         import ant_ray_tpu as art  # noqa: PLC0415
 
@@ -166,11 +170,12 @@ class Algorithm:
 
         cfg = self.config
         ctor = env_mod.resolve_env(cfg.env)
+        base = type(self)._runner_cls
         if art.is_initialized():
-            runner_cls = art.remote(_EnvRunner)
+            runner_cls = art.remote(base)
             return [runner_cls.remote(cfg, i, ctor)
                     for i in range(cfg.num_env_runners)]
-        return [_EnvRunner(cfg, i, ctor)
+        return [base(cfg, i, ctor)
                 for i in range(cfg.num_env_runners)]
 
     def _runner_call(self, method: str, *args):
@@ -270,3 +275,283 @@ class Algorithm:
                 except Exception:  # noqa: BLE001
                     pass
         self._runners = []
+
+
+# --------------------------------------------------------------------- DQN
+
+@dataclass(frozen=True)
+class DQNConfig(PPOConfig):
+    """Off-policy Q-learning config (ref: rllib/algorithms/dqn/dqn.py
+    DQNConfig — same builder surface as PPOConfig; PPO-only fields are
+    inherited but unused)."""
+
+    lr: float = 1e-3
+    buffer_size: int = 50_000
+    train_batch_size: int = 64
+    num_updates_per_iteration: int = 32
+    learning_starts: int = 1_000
+    target_update_freq: int = 500          # in update steps
+    double_q: bool = True
+    epsilon_initial: float = 1.0
+    epsilon_final: float = 0.05
+    epsilon_timesteps: int = 10_000        # env steps to anneal over
+
+    def build(self) -> "DQN":
+        return DQN(self)
+
+
+class _DQNRunner:
+    """Actor: epsilon-greedy transition collection
+    (ref: rllib env runner in off-policy mode)."""
+
+    def __init__(self, config: DQNConfig, index: int, env_ctor=None):
+        from ant_ray_tpu.rllib import dqn  # noqa: PLC0415
+        from ant_ray_tpu.rllib import env as env_mod  # noqa: PLC0415
+
+        self._dqn = dqn
+        self.config = config
+        ctor = env_ctor or env_mod.resolve_env(config.env)
+        self.env = ctor(num_envs=config.num_envs_per_runner,
+                        seed=config.seed * 1000 + index)
+        self.obs = self.env.reset()
+        self.params = None
+        self._key = dqn.jax.random.PRNGKey(config.seed * 77 + index)
+        self._episode_returns = np.zeros(
+            config.num_envs_per_runner, np.float32)
+        self._completed: list[float] = []
+
+    def set_weights(self, params):
+        self.params = params
+
+    def sample(self, epsilon: float) -> dict:
+        """One fragment of flat transitions (T·N, ...)."""
+        dqn, cfg = self._dqn, self.config
+        T, N = cfg.rollout_fragment_length, cfg.num_envs_per_runner
+        obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
+        for _ in range(T):
+            self._key, sub = dqn.jax.random.split(self._key)
+            actions = np.asarray(
+                dqn.act(self.params, self.obs, sub, epsilon))
+            obs_l.append(self.obs)
+            self.obs, reward, done, truncated, final_obs = \
+                self.env.step(actions)
+            act_l.append(actions)
+            rew_l.append(reward)
+            # Q targets bootstrap through time-limit truncations: the
+            # transition's next state is the PRE-reset obs and its done
+            # flag is termination only (ref: RLlib truncation handling).
+            next_l.append(final_obs)
+            done_l.append((done & ~truncated).astype(np.float32))
+            self._episode_returns += reward
+            for i in np.nonzero(done)[0]:
+                self._completed.append(float(self._episode_returns[i]))
+                self._episode_returns[i] = 0.0
+        completed, self._completed = self._completed, []
+        return {
+            "obs": np.concatenate(obs_l, axis=0),
+            "actions": np.concatenate(act_l, axis=0),
+            "rewards": np.concatenate(rew_l, axis=0),
+            "next_obs": np.concatenate(next_l, axis=0),
+            "dones": np.concatenate(done_l, axis=0),
+            "episode_returns": completed,
+        }
+
+
+class DQN(Algorithm):
+    """Double-DQN with uniform replay and hard target sync
+    (ref: rllib/algorithms/dqn/)."""
+
+    _runner_cls = _DQNRunner
+
+    def __init__(self, config: DQNConfig):
+        from ant_ray_tpu.rllib import dqn  # noqa: PLC0415
+        from ant_ray_tpu.rllib import env as env_mod  # noqa: PLC0415
+        import optax  # noqa: PLC0415
+
+        self._dqn = dqn
+        self.config = config
+        probe = env_mod.make_env(config.env, num_envs=1)
+        self._obs_dim, self._n_actions = probe.obs_dim, probe.n_actions
+        key = dqn.jax.random.PRNGKey(config.seed)
+        self.params = dqn.init_qnet(key, self._obs_dim, self._n_actions,
+                                    config.hidden)
+        # jnp.copy, not identity: the update step DONATES params, so the
+        # target must own distinct buffers.
+        self._target_params = dqn.jax.tree.map(dqn.jnp.copy, self.params)
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(self.params)
+        self._update = dqn.make_update_step(
+            self._optimizer, gamma=config.gamma, double=config.double_q)
+        self._buffer = dqn.ReplayBuffer(config.buffer_size, self._obs_dim,
+                                        seed=config.seed)
+        self._iteration = 0
+        self._env_steps = 0
+        self._update_steps = 0
+        self._runners = self._make_runners()
+
+    @property
+    def epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._env_steps / max(1, cfg.epsilon_timesteps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final
+                                             - cfg.epsilon_initial)
+
+    def train(self) -> dict:
+        dqn, cfg = self._dqn, self.config
+        self._runner_call("set_weights", self.params)
+        samples = self._runner_call("sample", self.epsilon)
+        for s in samples:
+            self._buffer.add_batch(s["obs"], s["actions"], s["rewards"],
+                                   s["next_obs"], s["dones"])
+            self._env_steps += len(s["actions"])
+
+        metrics = {}
+        if len(self._buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iteration):
+                host = self._buffer.sample(cfg.train_batch_size)
+                batch = {k: dqn.jnp.asarray(v) for k, v in host.items()}
+                self.params, self._opt_state, metrics = self._update(
+                    self.params, self._opt_state, self._target_params,
+                    batch)
+                self._update_steps += 1
+                if self._update_steps % cfg.target_update_freq == 0:
+                    self._target_params = dqn.jax.tree.map(
+                        dqn.jnp.copy, self.params)
+
+        episode_returns = [r for s in samples
+                           for r in s["episode_returns"]]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(episode_returns))
+                                    if episode_returns else float("nan")),
+            "num_episodes": len(episode_returns),
+            "num_env_steps_sampled": self._env_steps,
+            "epsilon": self.epsilon,
+            "replay_buffer_size": len(self._buffer),
+            "learner": {k: float(v) for k, v in metrics.items()},
+        }
+
+    def get_weights(self):
+        return self._dqn.jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, params):
+        self.params = self._dqn.jax.tree.map(
+            self._dqn.jnp.asarray, params)
+        self._target_params = self._dqn.jax.tree.map(
+            self._dqn.jnp.copy, self.params)
+
+    def save(self, path: str):
+        """DQN checkpoints carry the full learner state — target net and
+        the step counters that drive epsilon/target-sync schedules — so a
+        restore RESUMES training rather than re-bootstrapping from an
+        untrained target at epsilon 1.0 (replay contents are not
+        persisted, matching the reference's default)."""
+        import pickle  # noqa: PLC0415
+
+        with open(path, "wb") as f:
+            pickle.dump({"params": self.params,
+                         "opt_state": self._opt_state,
+                         "target_params": self._target_params,
+                         "iteration": self._iteration,
+                         "env_steps": self._env_steps,
+                         "update_steps": self._update_steps,
+                         "config": self.config}, f)
+
+    @classmethod
+    def restore(cls, path: str) -> "DQN":
+        import pickle  # noqa: PLC0415
+
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        algo = cls(state["config"])
+        algo.params = state["params"]
+        algo._opt_state = state["opt_state"]
+        algo._target_params = state["target_params"]
+        algo._iteration = state["iteration"]
+        algo._env_steps = state["env_steps"]
+        algo._update_steps = state["update_steps"]
+        return algo
+
+
+# ------------------------------------------------------------------ IMPALA
+
+@dataclass(frozen=True)
+class IMPALAConfig(PPOConfig):
+    """V-trace actor-critic config (ref: rllib/algorithms/impala/).
+    Collection is synchronous here, but fragments are *reused* across
+    ``num_sgd_iter`` passes — V-trace corrects the resulting
+    off-policyness exactly as it corrects queue staleness upstream."""
+
+    lr: float = 5e-4
+    num_sgd_iter: int = 2
+    clip_rho_threshold: float = 1.0
+    clip_c_threshold: float = 1.0
+
+    def build(self) -> "IMPALA":
+        return IMPALA(self)
+
+
+class IMPALA(Algorithm):
+    """V-trace learner over behavior-policy fragments."""
+
+    def __init__(self, config: IMPALAConfig):
+        from ant_ray_tpu.rllib import env as env_mod  # noqa: PLC0415
+        from ant_ray_tpu.rllib import impala, ppo  # noqa: PLC0415
+        import optax  # noqa: PLC0415
+
+        self._ppo = ppo
+        self._impala = impala
+        self.config = config
+        probe = env_mod.make_env(config.env, num_envs=1)
+        self._obs_dim, self._n_actions = probe.obs_dim, probe.n_actions
+        key = ppo.jax.random.PRNGKey(config.seed)
+        self.params = ppo.init_policy(key, self._obs_dim, self._n_actions,
+                                      config.hidden)
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(self.params)
+        self._update = impala.make_update_step(
+            self._optimizer, gamma=config.gamma,
+            vf_coeff=config.vf_loss_coeff,
+            ent_coeff=config.entropy_coeff,
+            clip_rho=config.clip_rho_threshold,
+            clip_c=config.clip_c_threshold)
+        self._iteration = 0
+        self._env_steps = 0
+        self._runners = self._make_runners()
+
+    def train(self) -> dict:
+        impala, cfg = self._impala, self.config
+        jnp = impala.jnp
+        self._runner_call("set_weights", self.params)
+        samples = self._runner_call("sample")
+
+        def cat(key_, axis=1):
+            return np.concatenate([s[key_] for s in samples], axis=axis)
+
+        batch = {
+            "obs": jnp.asarray(cat("obs")),
+            "actions": jnp.asarray(cat("actions")),
+            "behavior_logp": jnp.asarray(cat("logp")),
+            "rewards": jnp.asarray(cat("rewards")),
+            "dones": jnp.asarray(cat("dones")),
+            "bootstrap_obs": jnp.asarray(cat("last_obs", axis=0)),
+        }
+        T, N = batch["actions"].shape
+        metrics = {}
+        for _ in range(cfg.num_sgd_iter):
+            self.params, self._opt_state, metrics = self._update(
+                self.params, self._opt_state, batch)
+
+        self._env_steps += T * N
+        episode_returns = [r for s in samples
+                           for r in s["episode_returns"]]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(episode_returns))
+                                    if episode_returns else float("nan")),
+            "num_episodes": len(episode_returns),
+            "num_env_steps_sampled": self._env_steps,
+            "learner": {k: float(v) for k, v in metrics.items()},
+        }

@@ -20,15 +20,8 @@ from ant_ray_tpu._private.protocol import RpcClient
 from ant_ray_tpu._private.worker import CoreRuntime
 from ant_ray_tpu.actor import ActorHandle
 from ant_ray_tpu.object_ref import ObjectRef, ObjectRefGenerator, set_refcount_hook
-
-
-def _pack(value: Any) -> bytes:
-    return serialization.serialize(value).to_payload()
-
-
-def _unpack(payload) -> Any:
-    return serialization.deserialize(
-        serialization.SerializedObject.from_payload(payload))
+from ant_ray_tpu.util.client.wire import pack as _pack
+from ant_ray_tpu.util.client.wire import unpack as _unpack
 
 
 class ClientRuntime(CoreRuntime):

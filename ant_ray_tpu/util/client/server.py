@@ -32,19 +32,12 @@ from ant_ray_tpu._private.protocol import RpcServer
 from ant_ray_tpu.actor import ActorClass, ActorHandle
 from ant_ray_tpu.object_ref import ObjectRef
 from ant_ray_tpu.remote_function import RemoteFunction
+from ant_ray_tpu.util.client.wire import pack as _pack
+from ant_ray_tpu.util.client.wire import unpack as _unpack
 
 logger = logging.getLogger(__name__)
 
 PROTOCOL_VERSION = 1
-
-
-def _unpack(payload: bytes) -> Any:
-    return serialization.deserialize(
-        serialization.SerializedObject.from_payload(payload))
-
-
-def _pack(value: Any) -> bytes:
-    return serialization.serialize(value).to_payload()
 
 
 class ClientServer:
@@ -137,7 +130,18 @@ class ClientServer:
         refs = [self._resolve_ref(w) for w in req["refs"]]
         values = await self._run_blocking(
             self._runtime.get, refs, req["timeout"])
-        return [_pack(v) for v in values]
+
+        def _pack_pinning(v):
+            # ObjectRefs nested inside a fetched value become client
+            # mirrors on deserialize — pin them here so the server-side
+            # borrow outlives this handler's transient value, released
+            # by the mirror's eventual ClientRelease.
+            ser = serialization.serialize(v)
+            for r in ser.contained_refs:
+                self._pin(r)
+            return ser.to_payload()
+
+        return [_pack_pinning(v) for v in values]
 
     async def _wait(self, req):
         import time  # noqa: PLC0415

@@ -1,0 +1,21 @@
+"""Client↔server value encoding — ONE definition for both ends.
+
+Values cross the proxy as the object plane's own serialized payloads
+(pickle-5 + out-of-band buffers), so the wire format changes in exactly
+one place.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ant_ray_tpu._private import serialization
+
+
+def pack(value: Any) -> bytes:
+    return serialization.serialize(value).to_payload()
+
+
+def unpack(payload) -> Any:
+    return serialization.deserialize(
+        serialization.SerializedObject.from_payload(payload))

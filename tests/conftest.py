@@ -16,6 +16,12 @@ os.environ["ART_JAX_PLATFORM"] = "cpu"
 # Spawned daemons/workers must never consult the GCE metadata server
 # (tests mock it explicitly where needed via ART_GCE_METADATA_URL).
 os.environ.setdefault("ART_DISABLE_GCE_METADATA", "1")
+# Persistent XLA compile cache, shared by every process of every run:
+# worker subprocesses re-jit the same tiny programs constantly, and on
+# one core those compiles dominate suite time.  (Verified to hit on the
+# CPU backend.)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/art_jax_test_cache")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
 
 from ant_ray_tpu._private.jax_utils import import_jax  # noqa: E402
 
