@@ -291,8 +291,12 @@ class NodeManager:
                                 f"worker-{worker_id.hex()[:8]}.log")
         os.makedirs(os.path.dirname(log_path), exist_ok=True)
         log_file = open(log_path, "ab")
+        # A pip env's workers run on its venv interpreter (built by
+        # _ensure_runtime_env before the spawn reaches here).
+        python = renv.venv_python(runtime_env, self._session_dir) \
+            or sys.executable
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ant_ray_tpu._private.worker_main"],
+            [python, "-m", "ant_ray_tpu._private.worker_main"],
             env=env, cwd=cwd, stdout=log_file, stderr=subprocess.STDOUT,
             start_new_session=True)
         log_file.close()
@@ -457,19 +461,28 @@ class NodeManager:
         self._lease_event.set()
 
     async def _ensure_runtime_env(self, wire: dict | None):
-        """Prefetch + extract a runtime env's working_dir package so the
-        (sync) worker spawn only touches local paths."""
+        """Prefetch + extract a runtime env's packages (working_dir +
+        py_modules) and build its pip venv, so the (sync) worker spawn
+        only touches local paths."""
         from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
 
-        key = (wire or {}).get("working_dir_key")
-        if not key or renv.is_extracted(key, self._session_dir):
-            return
+        wire = wire or {}
+        keys = ([wire["working_dir_key"]] if wire.get("working_dir_key")
+                else []) + list(wire.get("py_modules_keys") or ())
         gcs = self._clients.get(self._gcs_address)
-        blob = await gcs.call_async("KVGet", {"key": key}, timeout=60)
-        if blob is None:
-            raise RuntimeError(
-                f"runtime_env package {key} missing from GCS KV")
-        renv.extract(key, blob, self._session_dir)
+        for key in keys:
+            if renv.is_extracted(key, self._session_dir):
+                continue
+            blob = await gcs.call_async("KVGet", {"key": key}, timeout=60)
+            if blob is None:
+                raise RuntimeError(
+                    f"runtime_env package {key} missing from GCS KV")
+            renv.extract(key, blob, self._session_dir)
+        pip = wire.get("pip")
+        if pip:
+            # venv build is slow (subprocess pip) — off the event loop.
+            await asyncio.get_running_loop().run_in_executor(
+                None, renv.ensure_venv, pip, self._session_dir)
 
     async def _job_allowed_here(self, job_id) -> bool:
         """Virtual-cluster membership of this node for a job, cached

@@ -1,11 +1,19 @@
-"""Runtime environments: per-task/actor env_vars + working_dir
-(ref: python/ray/_private/runtime_env/ — the plugin architecture
-reduced to its two load-bearing plugins; URI-cached packages live in
-GCS KV exactly like the reference caches working_dir zips in the GCS'
-internal KV, ref: runtime_env/working_dir.py).
+"""Runtime environments: per-task/actor env_vars, working_dir,
+py_modules and pip venvs (ref: python/ray/_private/runtime_env/ — the
+plugin architecture reduced to its load-bearing plugins; URI-cached
+packages live in GCS KV exactly like the reference caches working_dir
+zips in the GCS' internal KV, ref: runtime_env/working_dir.py,
+py_modules.py, pip.py).
 
 Wire form (what travels in TaskSpec/ActorSpec/lease payloads):
-    {"env_vars": {...}, "working_dir_key": "renv:<sha256-16>"}
+    {"env_vars": {...}, "working_dir_key": "renv:<sha256-16>",
+     "py_modules_keys": ["renv:<sha>", ...], "pip": ["pkg==1.2", ...]}
+
+``pip`` builds one node-local venv per requirement set (content
+addressed, ``--system-site-packages`` so the framework and jax stay
+importable) and workers of that env run on the venv's interpreter —
+the reference's pip plugin semantics (runtime_env/pip.py) without
+per-worker virtualenv duplication.
 """
 
 from __future__ import annotations
@@ -20,29 +28,45 @@ MAX_WORKING_DIR_BYTES = 100 * 1024 * 1024
 
 
 def validate(runtime_env: dict) -> None:
-    unknown = set(runtime_env) - {"env_vars", "working_dir"}
+    unknown = set(runtime_env) - {"env_vars", "working_dir",
+                                  "py_modules", "pip"}
     if unknown:
         raise ValueError(
             f"unsupported runtime_env field(s) {sorted(unknown)}; "
-            "supported: env_vars, working_dir")
+            "supported: env_vars, working_dir, py_modules, pip")
     env_vars = runtime_env.get("env_vars") or {}
     if not all(isinstance(k, str) and isinstance(v, str)
                for k, v in env_vars.items()):
         raise ValueError("runtime_env env_vars must be str->str")
+    py_modules = runtime_env.get("py_modules") or []
+    if not isinstance(py_modules, (list, tuple)) or not all(
+            isinstance(p, (str, os.PathLike)) for p in py_modules):
+        raise ValueError(
+            "runtime_env py_modules must be a list of path strings "
+            "(or PathLike)")
+    pip = runtime_env.get("pip")
+    if pip is not None:
+        if isinstance(pip, dict):
+            pip = pip.get("packages")
+        if not (isinstance(pip, (list, tuple))
+                and all(isinstance(p, str) for p in pip)):
+            raise ValueError(
+                "runtime_env pip must be a list of requirement strings "
+                "or {'packages': [...]}")
 
 
-def _zip_dir(path: str) -> bytes:
+def _zip_dir(path: str, prefix: str = "") -> bytes:
     buf = io.BytesIO()
     total = 0
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
         for root, _dirs, files in os.walk(path):
             for name in files:
                 full = os.path.join(root, name)
-                rel = os.path.relpath(full, path)
+                rel = os.path.join(prefix, os.path.relpath(full, path))
                 total += os.path.getsize(full)
                 if total > MAX_WORKING_DIR_BYTES:
                     raise ValueError(
-                        f"working_dir exceeds "
+                        f"package {path!r} exceeds "
                         f"{MAX_WORKING_DIR_BYTES >> 20} MiB")
                 zf.write(full, rel)
     return buf.getvalue()
@@ -61,27 +85,67 @@ def ensure_framework_on_pythonpath(env: dict) -> None:
                              else pkg_root)
 
 
+def _dir_entries(path: str) -> list:
+    entries = []
+    for root, _dirs, files in os.walk(path):
+        for name in files:
+            full = os.path.join(root, name)
+            try:
+                st = os.stat(full)
+                entries.append((os.path.relpath(full, path),
+                                st.st_size, st.st_mtime_ns))
+            except OSError:
+                entries.append((os.path.relpath(full, path), -1, -1))
+    return sorted(entries)
+
+
 def content_fingerprint(runtime_env: dict) -> str:
-    """Cache identity for a runtime env INCLUDING working_dir contents
-    (path, size, mtime per file), so edits re-package instead of
-    silently reusing a stale zip."""
+    """Cache identity covering EVERY field that affects the wire form
+    (env_vars, working_dir and py_modules contents — path, size, mtime
+    per file — and the pip list), so edits re-package instead of
+    silently reusing a stale wire and two different envs can never
+    collide on an empty fingerprint."""
     parts = [repr(sorted((runtime_env.get("env_vars") or {}).items()))]
     working_dir = runtime_env.get("working_dir")
     if working_dir:
-        entries = []
-        for root, _dirs, files in os.walk(working_dir):
-            for name in files:
-                full = os.path.join(root, name)
-                try:
-                    st = os.stat(full)
-                    entries.append((os.path.relpath(full, working_dir),
-                                    st.st_size, st.st_mtime_ns))
-                except OSError:
-                    entries.append((os.path.relpath(full, working_dir),
-                                    -1, -1))
-        parts.append(repr(sorted(entries)))
-        parts.append(working_dir)
+        parts.append("wd:" + working_dir)
+        parts.append(repr(_dir_entries(working_dir)))
+    for mod_path in runtime_env.get("py_modules") or ():
+        mod_path = os.fspath(mod_path)
+        parts.append("mod:" + mod_path)
+        if os.path.isdir(mod_path):
+            parts.append(repr(_dir_entries(mod_path)))
+        else:
+            try:
+                st = os.stat(mod_path)
+                parts.append(repr((st.st_size, st.st_mtime_ns)))
+            except OSError:
+                parts.append("missing")
+    pip = runtime_env.get("pip")
+    if pip:
+        if isinstance(pip, dict):
+            pip = pip.get("packages") or []
+        parts.append("pip:" + repr(sorted(pip)))
     return hashlib.sha256("|".join(parts).encode()).hexdigest()
+
+
+def _zip_module(path: str) -> bytes:
+    """Package one py_module: a directory (kept under its basename, so
+    extraction + PYTHONPATH makes ``import <basename>`` work) or a
+    single ``.py`` file.  Same size cap as working_dir."""
+    if os.path.isdir(path):
+        return _zip_dir(path,
+                        prefix=os.path.basename(os.path.normpath(path)))
+    if os.path.isfile(path) and path.endswith(".py"):
+        if os.path.getsize(path) > MAX_WORKING_DIR_BYTES:
+            raise ValueError(f"py_module {path!r} exceeds "
+                             f"{MAX_WORKING_DIR_BYTES >> 20} MiB")
+        buf = io.BytesIO()
+        with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.write(path, os.path.basename(path))
+        return buf.getvalue()
+    raise ValueError(f"py_module {path!r} is neither a package "
+                     "directory nor a .py file")
 
 
 def package(runtime_env: dict | None, kv_put) -> dict | None:
@@ -104,6 +168,19 @@ def package(runtime_env: dict | None, kv_put) -> dict | None:
         key = f"renv:{hashlib.sha256(blob).hexdigest()[:16]}"
         kv_put(key, blob)
         wire["working_dir_key"] = key
+    keys = []
+    for mod_path in runtime_env.get("py_modules") or ():
+        blob = _zip_module(os.fspath(mod_path))
+        key = f"renv:{hashlib.sha256(blob).hexdigest()[:16]}"
+        kv_put(key, blob)
+        keys.append(key)
+    if keys:
+        wire["py_modules_keys"] = keys
+    pip = runtime_env.get("pip")
+    if pip:
+        if isinstance(pip, dict):
+            pip = pip.get("packages")
+        wire["pip"] = sorted(pip)
     return wire or None
 
 
@@ -152,6 +229,7 @@ def resolve(wire: dict | None, session_dir: str) -> tuple[dict, str | None]:
         return {}, None
     overlay = dict(wire.get("env_vars") or {})
     cwd = None
+    paths = []
     key = wire.get("working_dir_key")
     if key:
         if not is_extracted(key, session_dir):
@@ -160,8 +238,108 @@ def resolve(wire: dict | None, session_dir: str) -> tuple[dict, str | None]:
                 "before spawning")
         cwd = package_dir(key, session_dir)
         # The reference puts working_dir on sys.path of the worker.
+        paths.append(cwd)
+    for mkey in wire.get("py_modules_keys") or ():
+        if not is_extracted(mkey, session_dir):
+            raise RuntimeError(
+                f"runtime_env package {mkey} not extracted — prefetch "
+                "it before spawning")
+        paths.append(package_dir(mkey, session_dir))
+    if paths:
         existing = overlay.get("PYTHONPATH", os.environ.get(
             "PYTHONPATH", ""))
-        overlay["PYTHONPATH"] = (f"{cwd}:{existing}" if existing
-                                 else cwd)
+        joined = ":".join(paths)
+        overlay["PYTHONPATH"] = (f"{joined}:{existing}" if existing
+                                 else joined)
+    venv = wire.get("pip") and venv_dir(wire["pip"], session_dir)
+    if venv:
+        overlay["VIRTUAL_ENV"] = venv
+        overlay["PATH"] = (f"{venv}/bin:"
+                           + overlay.get("PATH", os.environ.get("PATH", "")))
     return overlay, cwd
+
+
+# ------------------------------------------------------------------ pip
+
+import threading as _threading
+
+_venv_build_locks: dict = {}
+_venv_build_locks_guard = _threading.Lock()
+
+
+def venv_dir(pip: list, session_dir: str) -> str:
+    ident = hashlib.sha256(json.dumps(sorted(pip)).encode()).hexdigest()[:16]
+    return os.path.join(session_dir, "venvs", ident)
+
+
+def venv_python(wire: dict | None, session_dir: str) -> str | None:
+    """Interpreter for the env's venv, or None when no pip field."""
+    pip = (wire or {}).get("pip")
+    if not pip:
+        return None
+    return os.path.join(venv_dir(pip, session_dir), "bin", "python")
+
+
+def ensure_venv(pip: list, session_dir: str) -> str:
+    """Build (once) the content-addressed venv for a requirement set.
+
+    ``--system-site-packages`` keeps the framework + jax importable from
+    the parent environment; pip only layers the requested packages on
+    top (ref: runtime_env/pip.py builds exactly this shape of env).
+    Blocking — call from a thread, not the event loop.
+    """
+    target = venv_dir(pip, session_dir)
+    ready = os.path.join(target, ".art_ready")
+    if os.path.exists(ready):
+        return target
+    # One build per requirement set per process (concurrent leases land
+    # on executor threads that share a pid); _build_venv's uuid suffix
+    # keeps cross-process builders off each other's tmp dirs.
+    with _venv_build_locks_guard:
+        lock = _venv_build_locks.setdefault(target, _threading.Lock())
+    with lock:
+        if os.path.exists(ready):
+            return target
+        return _build_venv(pip, target)
+
+
+def _build_venv(pip: list, target: str) -> str:
+    import subprocess  # noqa: PLC0415
+    import sys  # noqa: PLC0415
+    import uuid as _uuid  # noqa: PLC0415
+
+    tmp = target + f".tmp.{os.getpid()}.{_uuid.uuid4().hex[:8]}"
+    subprocess.run(
+        [sys.executable, "-m", "venv", "--system-site-packages", tmp],
+        check=True, capture_output=True)
+    # --system-site-packages chains to the BASE interpreter's
+    # site-packages; when this process itself runs in a venv (the
+    # common deployment), the parent's packages (jax, cloudpickle, …)
+    # live elsewhere — chain them explicitly with a .pth so child
+    # workers keep the full parent environment underneath the pip layer.
+    import glob  # noqa: PLC0415
+    import site  # noqa: PLC0415
+
+    parent_sites = [p for p in site.getsitepackages() if os.path.isdir(p)]
+    for sp in glob.glob(os.path.join(tmp, "lib", "python*",
+                                     "site-packages")):
+        with open(os.path.join(sp, "_art_parent.pth"), "w") as f:
+            f.write("\n".join(parent_sites) + "\n")
+    proc = subprocess.run(
+        [os.path.join(tmp, "bin", "python"), "-m", "pip", "install",
+         "--no-input", *pip],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        import shutil  # noqa: PLC0415
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeError(
+            f"pip install {pip} failed:\n{proc.stderr[-2000:]}")
+    open(os.path.join(tmp, ".art_ready"), "w").close()
+    try:
+        os.rename(tmp, target)
+    except OSError:  # lost the build race — use the winner's venv
+        import shutil  # noqa: PLC0415
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return target

@@ -177,6 +177,13 @@ class TaskExecutor:
 
                     result = exec_loop(self.actor_instance, *args,
                                        **kwargs)
+                elif spec.method_name == "__art_collective__":
+                    # Collective DAG node: the op runs against the
+                    # group this actor created with
+                    # init_collective_group (ref: collective_node.py).
+                    from ant_ray_tpu.dag.collective import execute_op  # noqa: PLC0415
+
+                    result = execute_op(*args, **kwargs)
                 else:
                     method = getattr(self.actor_instance,
                                      spec.method_name)

@@ -1,6 +1,11 @@
 """DAG layer: build static task/actor graphs with ``.bind()`` and execute
-them (ref capability: ray.dag / compiled graphs, SURVEY §2.3 aDAG)."""
+them (ref capability: ray.dag / compiled graphs, SURVEY §2.3 aDAG).
 
+``ant_ray_tpu.dag.collective`` binds collective ops (allreduce /
+allgather / reducescatter) as DAG nodes executed by the participating
+actors over their collective group."""
+
+from ant_ray_tpu.dag import collective
 from ant_ray_tpu.dag.nodes import (
     ActorMethodNode,
     DAGNode,
@@ -8,4 +13,5 @@ from ant_ray_tpu.dag.nodes import (
     InputNode,
 )
 
-__all__ = ["ActorMethodNode", "DAGNode", "FunctionNode", "InputNode"]
+__all__ = ["ActorMethodNode", "DAGNode", "FunctionNode", "InputNode",
+           "collective"]

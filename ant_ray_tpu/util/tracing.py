@@ -1,0 +1,186 @@
+"""Distributed tracing: task lifecycle events → OpenTelemetry spans
+(ref: python/ray/util/tracing/tracing_helper.py — the reference wraps
+task/actor calls in OTel spans when ``_tracing_startup_hook`` is set,
+and proxy-mocks otel when it isn't installed, :147-176).
+
+Design difference, on purpose: the reference instruments the submission
+path with a live OTel SDK in every process.  Here workers already
+buffer task lifecycle events (submitted/started/finished, with parent
+linkage via contextvar) into the GCS aggregator for the timeline — so
+spans are DERIVED from that single event stream instead of running a
+second tracing pipeline.  One instrumentation, three consumers
+(timeline, state API, tracing), and the OTel SDK stays optional:
+
+* :func:`task_spans` — span objects (trace/span/parent ids, timings)
+* :func:`export_otlp_json` — OTLP/JSON file any collector can ingest
+* :func:`replay_to_otel` — emit through a real installed
+  ``opentelemetry`` TracerProvider when the package is available
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from ant_ray_tpu.util.timeline import fetch_task_events
+
+_NS = 1_000_000_000
+
+
+@dataclass
+class Span:
+    """One task execution, OTel-shaped."""
+
+    trace_id: str            # 32 hex — the root task of the call tree
+    span_id: str             # 16 hex — derived from the task id
+    parent_span_id: str      # "" for roots
+    name: str
+    start_ns: int
+    end_ns: int
+    ok: bool = True
+    attributes: dict = field(default_factory=dict)
+
+
+def _span_id(task_id: str) -> str:
+    # Hash, don't truncate: task ids share a long job-id prefix, so a
+    # prefix-slice would collide every span in a job.
+    import hashlib  # noqa: PLC0415
+
+    return hashlib.blake2b((task_id or "").encode(),
+                           digest_size=8).hexdigest()
+
+
+def _trace_id(task_id: str) -> str:
+    import hashlib  # noqa: PLC0415
+
+    return hashlib.blake2b((task_id or "").encode(),
+                           digest_size=16).hexdigest()
+
+
+def task_spans(events: list[dict] | None = None) -> list[Span]:
+    """Fold the event stream into one span per task execution.
+
+    ``trace_id`` groups a call tree: each task inherits its root
+    ancestor's id, so a driver-submitted task and everything it spawned
+    share one trace (the W3C trace-context notion of the reference's
+    propagated spans)."""
+    if events is None:
+        events = fetch_task_events()
+    by_task: dict[str, dict] = {}
+    for e in sorted(events, key=lambda e: e["ts"]):
+        rec = by_task.setdefault(e["task_id"], {"events": {}})
+        rec["events"].setdefault(e["event"], e)
+
+    def root_of(task_id: str, hops: int = 0) -> str:
+        rec = by_task.get(task_id)
+        if rec is None or hops > 256:
+            return task_id
+        for e in rec["events"].values():
+            parent = e.get("parent_task_id")
+            if parent:
+                return root_of(parent, hops + 1)
+        return task_id
+
+    spans = []
+    for task_id, rec in by_task.items():
+        ev = rec["events"]
+        started = ev.get("started")
+        ended = ev.get("finished") or ev.get("failed")
+        submitted = ev.get("submitted")
+        if started is None:
+            continue  # never ran (still queued, or events truncated)
+        end_ts = (ended or started)["ts"]
+        any_e = started
+        parent = None
+        for e in ev.values():
+            parent = parent or e.get("parent_task_id")
+        attributes = {
+            "art.task_id": task_id,
+            "art.node_id": any_e.get("node_id", ""),
+            "art.pid": any_e.get("pid", 0),
+        }
+        if any_e.get("actor_id"):
+            attributes["art.actor_id"] = any_e["actor_id"]
+        if submitted is not None:
+            attributes["art.queue_time_s"] = round(
+                started["ts"] - submitted["ts"], 6)
+        spans.append(Span(
+            trace_id=_trace_id(root_of(task_id)),
+            span_id=_span_id(task_id),
+            parent_span_id=_span_id(parent) if parent else "",
+            name=any_e.get("name", task_id),
+            start_ns=int(started["ts"] * _NS),
+            end_ns=int(end_ts * _NS),
+            ok="failed" not in ev,
+            attributes=attributes,
+        ))
+    spans.sort(key=lambda s: s.start_ns)
+    return spans
+
+
+def _otlp_attr(key: str, value) -> dict:
+    if isinstance(value, bool):
+        v = {"boolValue": value}
+    elif isinstance(value, int):
+        v = {"intValue": str(value)}
+    elif isinstance(value, float):
+        v = {"doubleValue": value}
+    else:
+        v = {"stringValue": str(value)}
+    return {"key": key, "value": v}
+
+
+def export_otlp_json(filename: str | None = None,
+                     spans: list[Span] | None = None):
+    """OTLP/JSON ``resourceSpans`` payload (the shape OTLP/HTTP
+    collectors and Jaeger's OTLP ingest accept); returns the dict, and
+    writes it when ``filename`` is given."""
+    if spans is None:
+        spans = task_spans()
+    payload = {"resourceSpans": [{
+        "resource": {"attributes": [
+            _otlp_attr("service.name", "ant_ray_tpu")]},
+        "scopeSpans": [{
+            "scope": {"name": "ant_ray_tpu.tasks"},
+            "spans": [{
+                "traceId": s.trace_id,
+                "spanId": s.span_id,
+                **({"parentSpanId": s.parent_span_id}
+                   if s.parent_span_id else {}),
+                "name": s.name,
+                "kind": 1,  # SPAN_KIND_INTERNAL
+                "startTimeUnixNano": str(s.start_ns),
+                "endTimeUnixNano": str(s.end_ns),
+                "attributes": [_otlp_attr(k, v)
+                               for k, v in s.attributes.items()],
+                "status": {"code": 1 if s.ok else 2},
+            } for s in spans],
+        }],
+    }]}
+    if filename:
+        with open(filename, "w") as f:
+            json.dump(payload, f)
+        return filename
+    return payload
+
+
+def replay_to_otel(spans: list[Span] | None = None, tracer=None) -> int:
+    """Emit spans through an installed ``opentelemetry`` SDK (optional
+    dependency, like the reference's mock-when-absent behavior).
+    Returns the number of spans emitted."""
+    try:
+        from opentelemetry import trace as otel_trace  # noqa: PLC0415
+    except ImportError as e:
+        raise RuntimeError(
+            "opentelemetry is not installed; use export_otlp_json() "
+            "for a dependency-free OTLP payload") from e
+    if spans is None:
+        spans = task_spans()
+    tracer = tracer or otel_trace.get_tracer("ant_ray_tpu.tasks")
+    for s in spans:
+        span = tracer.start_span(s.name, start_time=s.start_ns,
+                                 attributes=dict(s.attributes))
+        if not s.ok:
+            span.set_status(otel_trace.StatusCode.ERROR)
+        span.end(end_time=s.end_ns)
+    return len(spans)

@@ -209,3 +209,84 @@ def test_channel_compiled_beats_interpreted(cluster):
           f"channel-compiled {1e3 * channeled / n:.2f} ms/step")
     art.kill(s1)
     art.kill(s2)
+
+
+def test_collective_allreduce_dag_nodes(cluster):
+    """allreduce bound as DAG nodes: per-actor tensors reduce across the
+    group when the graph executes (ref: experimental/collective/
+    operations.py:130-190, dag/collective_node.py)."""
+    import numpy as np
+
+    from ant_ray_tpu.dag import collective as dag_col
+    from ant_ray_tpu.util import collective as col
+
+    @art.remote
+    class Shard(col.CollectiveActorMixin):
+        def __init__(self, value):
+            self.value = float(value)
+
+        def tensor(self):
+            import numpy as _np
+            return _np.full(4, self.value, _np.float32)
+
+    shards = [Shard.remote(v) for v in (1.0, 2.0)]
+    col.create_collective_group(shards, world_size=2, ranks=[0, 1],
+                                backend="gloo", group_name="dag_g")
+
+    inputs = [s.tensor.bind() for s in shards]
+    outputs = dag_col.allreduce.bind(inputs, group_name="dag_g")
+    assert len(outputs) == 2
+
+    # Executing ONE output runs the whole group (all-or-nothing).
+    result = art.get(outputs[0].execute(), timeout=60)
+    assert np.asarray(result).tolist() == [3.0] * 4
+
+    # Fresh bind → allgather as well.
+    inputs = [s.tensor.bind() for s in shards]
+    gathered = dag_col.allgather.bind(inputs, group_name="dag_g")
+    out = art.get(gathered[1].execute(), timeout=60)
+    assert np.asarray(out).reshape(-1).tolist() == [1.0] * 4 + [2.0] * 4
+
+
+def test_collective_bind_rejects_same_actor(cluster):
+    from ant_ray_tpu.dag import collective as dag_col
+
+    @art.remote
+    class A:
+        def t(self):
+            return 1
+
+    a = A.remote()
+    with pytest.raises(ValueError, match="distinct actors"):
+        dag_col.allreduce.bind([a.t.bind(), a.t.bind()])
+    with pytest.raises(ValueError, match="actor-method nodes"):
+        dag_col.allreduce.bind([InputNode()])
+
+
+def test_collective_dag_reexecution_sees_fresh_state(cluster):
+    """Re-executing a bound collective re-runs the op against current
+    actor state (the ref cache is per-execution, not per-bind)."""
+    import numpy as np
+
+    from ant_ray_tpu.dag import collective as dag_col
+    from ant_ray_tpu.util import collective as col
+
+    @art.remote
+    class Counter(col.CollectiveActorMixin):
+        def __init__(self):
+            self.n = 0.0
+
+        def tensor(self):
+            import numpy as _np
+            self.n += 1.0
+            return _np.full(2, self.n, _np.float32)
+
+    actors = [Counter.remote() for _ in range(2)]
+    col.create_collective_group(actors, world_size=2, ranks=[0, 1],
+                                backend="gloo", group_name="reexec_g")
+    outputs = dag_col.allreduce.bind(
+        [a.tensor.bind() for a in actors], group_name="reexec_g")
+    first = np.asarray(art.get(outputs[0].execute(), timeout=60))
+    second = np.asarray(art.get(outputs[0].execute(), timeout=60))
+    assert first.tolist() == [2.0, 2.0]    # 1+1
+    assert second.tolist() == [4.0, 4.0]   # 2+2, not stale run-1 refs

@@ -1,0 +1,130 @@
+"""Runtime environments: py_modules shipping and pip venvs
+(ref: python/ray/_private/runtime_env/py_modules.py, pip.py and their
+tests — code/package isolation per task/actor without touching the
+node's base environment)."""
+
+import os
+import textwrap
+import zipfile
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu._private import runtime_env as renv
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    art.init(num_cpus=2)
+    yield None
+    art.shutdown()
+
+
+def _write_module(tmp_path, name, body):
+    mod = tmp_path / name
+    mod.mkdir()
+    (mod / "__init__.py").write_text(textwrap.dedent(body))
+    return str(mod)
+
+
+def test_validate_rejects_unknown_and_bad_shapes():
+    with pytest.raises(ValueError, match="unsupported"):
+        renv.validate({"working_dir": ".", "container": {}})
+    with pytest.raises(ValueError, match="py_modules"):
+        renv.validate({"py_modules": "not-a-list"})
+    with pytest.raises(ValueError, match="pip"):
+        renv.validate({"pip": [1, 2]})
+    renv.validate({"pip": {"packages": ["einops"]}})  # dict form ok
+
+
+def test_py_modules_package_and_resolve(tmp_path):
+    path = _write_module(tmp_path, "shiplib", "VALUE = 41\n")
+    blobs = {}
+    wire = renv.package({"py_modules": [path]},
+                        lambda k, v: blobs.__setitem__(k, v))
+    (key,) = wire["py_modules_keys"]
+    assert key in blobs
+    session = str(tmp_path / "session")
+    renv.extract(key, blobs[key], session)
+    overlay, cwd = renv.resolve(wire, session)
+    assert cwd is None  # py_modules never change the cwd
+    root = overlay["PYTHONPATH"].split(":")[0]
+    assert os.path.exists(os.path.join(root, "shiplib", "__init__.py"))
+
+
+def test_py_modules_importable_in_workers(cluster, tmp_path):
+    path = _write_module(
+        tmp_path, "shipped_mod",
+        """
+        def shipped_value():
+            return 1234
+        """)
+
+    @art.remote(runtime_env={"py_modules": [path]})
+    def use_it():
+        import shipped_mod
+        return shipped_mod.shipped_value()
+
+    assert art.get(use_it.remote()) == 1234
+
+    # Without the env the module must NOT leak into other workers.
+    @art.remote
+    def cannot_see_it():
+        try:
+            import shipped_mod  # noqa: F401
+            return "visible"
+        except ImportError:
+            return "isolated"
+
+    assert art.get(cannot_see_it.remote()) == "isolated"
+
+
+def _make_wheel(tmp_path) -> str:
+    """Hand-craft a minimal pure-python wheel (a wheel is just a zip),
+    so the pip path is exercised with zero network."""
+    name, version = "artwheel", "0.1"
+    whl = tmp_path / f"{name}-{version}-py3-none-any.whl"
+    info = f"{name}-{version}.dist-info"
+    with zipfile.ZipFile(whl, "w") as zf:
+        zf.writestr(f"{name}/__init__.py", "MAGIC = 777\n")
+        zf.writestr(f"{info}/METADATA",
+                    f"Metadata-Version: 2.1\nName: {name}\n"
+                    f"Version: {version}\n")
+        zf.writestr(f"{info}/WHEEL",
+                    "Wheel-Version: 1.0\nGenerator: test\nRoot-Is-"
+                    "Purelib: true\nTag: py3-none-any\n")
+        zf.writestr(f"{info}/RECORD", "")
+    return str(whl)
+
+
+@pytest.mark.slow
+def test_pip_venv_workers_run_on_venv_interpreter(cluster, tmp_path):
+    wheel = _make_wheel(tmp_path)
+
+    @art.remote(runtime_env={"pip": [wheel]})
+    def use_wheel():
+        import sys
+        import artwheel
+        return artwheel.MAGIC, sys.prefix
+
+    magic, prefix = art.get(use_wheel.remote(), timeout=180)
+    assert magic == 777
+    assert "venvs" in prefix  # really ran on the venv interpreter
+
+    @art.remote
+    def base_env():
+        try:
+            import artwheel  # noqa: F401
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    assert art.get(base_env.remote()) == "isolated"
+
+
+def test_pip_venv_is_content_addressed(tmp_path):
+    session = str(tmp_path)
+    a = renv.venv_dir(["pkg==1.0"], session)
+    b = renv.venv_dir(["pkg==1.0"], session)
+    c = renv.venv_dir(["pkg==2.0"], session)
+    assert a == b and a != c

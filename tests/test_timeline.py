@@ -89,3 +89,40 @@ def test_chrome_trace_export(cluster, tmp_path):
     assert slices and slices[0]["dur"] >= 50_000 * 0.5  # ≥ ~25ms in us
     assert any(t["ph"] == "s" for t in trace)  # submit flow arrows
     assert any(t["ph"] == "f" for t in trace)
+
+
+def test_otel_spans_derived_from_events(cluster):
+    """Tracing layer: spans with trace/parent linkage + OTLP export
+    (ref: util/tracing/tracing_helper.py capability)."""
+    from ant_ray_tpu.util import tracing
+
+    @art.remote
+    def child(x):
+        return x + 1
+
+    @art.remote
+    def parent():
+        return art.get(child.remote(1))
+
+    assert art.get(parent.remote()) == 2
+    time.sleep(1.5)  # event buffers flush on age
+
+    spans = tracing.task_spans()
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s.name.split(".")[-1], s)
+    assert any("child" in s.name for s in spans), [s.name for s in spans]
+    child_span = next(s for s in spans if "child" in s.name)
+    parent_span = next(s for s in spans if "parent" in s.name
+                       and s.span_id == child_span.parent_span_id)
+    # same trace, parent/child linked, child nested within parent time
+    assert child_span.trace_id == parent_span.trace_id
+    assert child_span.start_ns >= parent_span.start_ns
+    assert child_span.end_ns >= child_span.start_ns
+    assert "art.queue_time_s" in child_span.attributes
+
+    payload = tracing.export_otlp_json()
+    wire = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    assert len(wire) == len(spans)
+    assert all(len(w["traceId"]) == 32 and len(w["spanId"]) == 16
+               for w in wire)
