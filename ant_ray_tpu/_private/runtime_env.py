@@ -309,9 +309,15 @@ def _build_venv(pip: list, target: str) -> str:
     import uuid as _uuid  # noqa: PLC0415
 
     tmp = target + f".tmp.{os.getpid()}.{_uuid.uuid4().hex[:8]}"
-    subprocess.run(
+    proc = subprocess.run(
         [sys.executable, "-m", "venv", "--system-site-packages", tmp],
-        check=True, capture_output=True)
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        import shutil  # noqa: PLC0415
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise RuntimeError(
+            f"venv creation failed:\n{proc.stderr[-2000:]}")
     # --system-site-packages chains to the BASE interpreter's
     # site-packages; when this process itself runs in a venv (the
     # common deployment), the parent's packages (jax, cloudpickle, …)

@@ -83,11 +83,13 @@ class _GroupBind:
         from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
 
         refs = []
+        world = len(self.handles)
         for handle, node in zip(self.handles, self.inputs):
             tensor_ref = resolved[id(node)]
             refs.append(global_worker.submit_actor_task(
                 handle, "__art_collective__",
-                (self.verb, self.group_name, self.op.name, tensor_ref),
+                (self.verb, self.group_name, self.op.name, tensor_ref,
+                 world),
                 {}, TaskOptions()))
         resolved[id(self)] = refs
         return refs
@@ -115,11 +117,21 @@ allgather = _CollectiveVerb("allgather")
 reducescatter = _CollectiveVerb("reducescatter")
 
 
-def execute_op(verb: str, group_name: str, op_name: str, tensor) -> Any:
+def execute_op(verb: str, group_name: str, op_name: str, tensor,
+               bind_world: int | None = None) -> Any:
     """Worker-side execution hook (dispatched by the task executor for
     ``__art_collective__`` method calls)."""
     from ant_ray_tpu.util import collective as col  # noqa: PLC0415
 
+    if bind_world is not None:
+        actual = col.get_collective_group_size(group_name)
+        if actual != bind_world:
+            # Loud error beats the guaranteed rendezvous deadlock a
+            # partial bind would otherwise hang in.
+            raise ValueError(
+                f"collective bound over {bind_world} actor(s) but group "
+                f"{group_name!r} has world size {actual} — bind must "
+                "cover every rank of the group")
     op = ReduceOp[op_name]
     if verb == "allreduce":
         return col.allreduce(tensor, group_name, op)

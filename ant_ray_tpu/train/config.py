@@ -18,6 +18,11 @@ class ScalingConfig:
     """
 
     num_workers: int = 1
+    # Elastic scaling (ref: scaling_policy/): 0 = fixed group size;
+    # >0 = the group may launch/relaunch with as few as min_workers
+    # ranks when the cluster can't place num_workers, growing back on
+    # later restarts.  Incompatible with a whole-slice topology.
+    min_workers: int = 0
     use_tpu: bool = False
     topology: str = ""                  # e.g. "4x8" (whole-slice reservation)
     accelerator_type: str = "TPU-V5E"   # generation for slice math

@@ -132,25 +132,40 @@ class TrainController:
     def run(self, self_handle):
         import ant_ray_tpu as art  # noqa: PLC0415
 
+        from ant_ray_tpu.train.scaling_policy import policy_for  # noqa: PLC0415
+
+        policy = policy_for(self._scaling)
         failure_config: FailureConfig = self._run_config.failure_config
         attempts = failure_config.max_failures + 1
         last_error: Exception | None = None
         for attempt in range(attempts):
+            world = policy.workers_for_attempt(
+                self._scaling, art.available_resources(),
+                art.cluster_resources(), attempt=attempt)
             try:
-                self._run_worker_group(art, self_handle)
+                self._run_worker_group(art, self_handle, world)
                 return self._result(error=None)
-            except art.exceptions.ArtError as e:
+            # RuntimeError covers gang-reservation failures (an
+            # infeasible PG after a node died is an attempt, not a
+            # crash of the controller itself).
+            except (art.exceptions.ArtError, RuntimeError) as e:
                 last_error = e
-                logger.warning("worker group failed (attempt %d/%d): %s",
-                               attempt + 1, attempts, e)
-                time.sleep(0.5)
+                logger.warning(
+                    "worker group (world=%d) failed (attempt %d/%d): %s",
+                    world, attempt + 1, attempts, e)
+                # Give failure detection a beat: the next attempt's
+                # capacity read must see the dead node as dead, or an
+                # elastic resize would re-request the old world size.
+                time.sleep(2.0 if getattr(self._scaling, "min_workers", 0)
+                           else 0.5)
         return self._result(error=last_error)
 
-    def _run_worker_group(self, art, self_handle):
+    def _run_worker_group(self, art, self_handle, world: int | None = None):
         from ant_ray_tpu.api import remote  # noqa: PLC0415
 
         scaling = self._scaling
-        pg, slice_pg = self._reserve_gang(scaling)
+        world = world if world is not None else scaling.num_workers
+        pg, slice_pg = self._reserve_gang(scaling, world)
         self._worker_pg = pg          # set BEFORE anything can fail, so
         self._worker_slice = slice_pg  # the finally always releases it
         workers = []
@@ -167,15 +182,15 @@ class TrainController:
                     # layout).
                     placement_group_bundle_index=(
                         rank if pg is not None else -1),
-                ).remote(rank, scaling.num_workers,
+                ).remote(rank, world,
                          self._storage_path,
                          self._run_config.name or "run",
                          scaling.use_tpu)
-                for rank in range(scaling.num_workers)
+                for rank in range(world)
             ]
             # Rendezvous: rank 0's host coordinates (multi-host slices).
             coordinator = None
-            if scaling.use_tpu and scaling.num_workers > 1:
+            if scaling.use_tpu and world > 1:
                 coordinator = art.get(
                     workers[0].propose_coordinator.remote())
             art.get([w.setup_distributed.remote(coordinator)
@@ -186,7 +201,15 @@ class TrainController:
                              self_handle, latest)
                 for w in workers
             ]
-            art.get(run_refs)
+            # Fail FAST on the first rank failure (ref: worker_group
+            # poll_status aborts the group on any error) — a plain
+            # gather would sit behind the healthy ranks' remaining work
+            # before surfacing a death, delaying recovery by minutes.
+            pending = list(run_refs)
+            while pending:
+                done, pending = art.wait(pending, num_returns=1,
+                                         timeout=None)
+                art.get(done[0])
         finally:
             for w in workers:
                 try:
@@ -195,13 +218,14 @@ class TrainController:
                     pass
             self._release_gang()
 
-    def _reserve_gang(self, scaling):
+    def _reserve_gang(self, scaling, world: int | None = None):
         """Gang-reserve the worker group's resources before spawning any
         rank (ref: WorkerGroup placement-group creation,
         worker_group.py:269).  TPU + topology ⇒ reserve a whole slice
         (slice_placement_group); otherwise a plain PG with the scaling
         config's strategy.  Single local worker ⇒ no PG (keeps the
         laptop path free of reservation latency)."""
+        world = world if world is not None else scaling.num_workers
         if scaling.use_tpu and scaling.topology:
             from ant_ray_tpu.util.tpu import slice_placement_group  # noqa: PLC0415
 
@@ -224,16 +248,20 @@ class TrainController:
                 raise RuntimeError(
                     f"could not reserve TPU slice {scaling.topology}")
             return slice_pg.placement_group, slice_pg
-        if scaling.num_workers <= 1:
+        if world <= 1:
             return None, None
         from ant_ray_tpu.util.placement_group import placement_group  # noqa: PLC0415
 
         pg = placement_group(
             [scaling.worker_resources()
-             for _ in range(scaling.num_workers)],
+             for _ in range(world)],
             strategy=scaling.placement_strategy,
             name=f"train-{self._run_config.name or 'run'}")
-        if not pg.ready(timeout=120):
+        # Elastic groups fail reservations fast — a shrunken cluster
+        # should trigger a resize within seconds, not after a two-minute
+        # stall on an unplaceable gang.
+        ready_timeout = 20 if getattr(scaling, "min_workers", 0) else 120
+        if not pg.ready(timeout=ready_timeout):
             from ant_ray_tpu.util.placement_group import (  # noqa: PLC0415
                 remove_placement_group,
             )

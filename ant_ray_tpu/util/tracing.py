@@ -167,7 +167,12 @@ def export_otlp_json(filename: str | None = None,
 def replay_to_otel(spans: list[Span] | None = None, tracer=None) -> int:
     """Emit spans through an installed ``opentelemetry`` SDK (optional
     dependency, like the reference's mock-when-absent behavior).
-    Returns the number of spans emitted."""
+
+    The SDK generates its own trace/span ids, so linkage is preserved
+    STRUCTURALLY: parents are emitted first and children start inside
+    ``set_span_in_context(parent)`` — the backend sees the same tree
+    ``task_spans`` computed, under SDK-assigned ids.  Returns the
+    number of spans emitted."""
     try:
         from opentelemetry import trace as otel_trace  # noqa: PLC0415
     except ImportError as e:
@@ -177,10 +182,27 @@ def replay_to_otel(spans: list[Span] | None = None, tracer=None) -> int:
     if spans is None:
         spans = task_spans()
     tracer = tracer or otel_trace.get_tracer("ant_ray_tpu.tasks")
-    for s in spans:
-        span = tracer.start_span(s.name, start_time=s.start_ns,
+    by_id = {s.span_id: s for s in spans}
+    emitted: dict[str, object] = {}
+
+    def emit(s: Span):
+        if s.span_id in emitted:
+            return emitted[s.span_id]
+        context = None
+        parent = by_id.get(s.parent_span_id)
+        if parent is not None:
+            context = otel_trace.set_span_in_context(emit(parent))
+        span = tracer.start_span(s.name, context=context,
+                                 start_time=s.start_ns,
                                  attributes=dict(s.attributes))
         if not s.ok:
             span.set_status(otel_trace.StatusCode.ERROR)
-        span.end(end_time=s.end_ns)
+        emitted[s.span_id] = span
+        return span
+
+    for s in spans:
+        emit(s)
+    # End children before parents (reverse start order ≈ LIFO nesting).
+    for s in sorted(spans, key=lambda s: s.start_ns, reverse=True):
+        emitted[s.span_id].end(end_time=s.end_ns)
     return len(spans)

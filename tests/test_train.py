@@ -1,6 +1,7 @@
 """Train layer tests (ref test model: python/ray/train/v2/tests)."""
 
 import os
+import time
 
 import numpy as np
 import pytest
@@ -162,3 +163,28 @@ def test_train_tiny_llama_e2e(cluster, tmp_path_factory):
     assert result.error is None
     assert result.metrics["step"] == 2
     assert np.isfinite(result.metrics["loss"])
+
+
+def test_elastic_policy_sizes_group_to_capacity():
+    """Unit: elastic policy fits the world to total capacity within
+    [min, num_workers] (ref: scaling_policy/)."""
+    from ant_ray_tpu.train.scaling_policy import (
+        ElasticScalingPolicy,
+        FixedScalingPolicy,
+        policy_for,
+    )
+
+    scaling = ScalingConfig(num_workers=4, min_workers=2)
+    policy = policy_for(scaling)
+    assert isinstance(policy, ElasticScalingPolicy)
+    # Plenty of capacity -> full size; squeezed -> clamped to fit;
+    # starved -> never below min (the launch will then wait/fail).
+    assert policy.workers_for_attempt(scaling, {}, {"CPU": 16.0}) == 4
+    assert policy.workers_for_attempt(scaling, {}, {"CPU": 3.0}) == 3
+    assert policy.workers_for_attempt(scaling, {}, {"CPU": 1.0}) == 2
+
+    assert isinstance(policy_for(ScalingConfig(num_workers=4)),
+                      FixedScalingPolicy)
+    with pytest.raises(ValueError, match="slice"):
+        policy_for(ScalingConfig(num_workers=4, min_workers=2,
+                                 use_tpu=True, topology="2x4"))
