@@ -116,6 +116,11 @@ class Config:
     memory_usage_threshold: float = 0.95
     # Where to read meminfo (tests point this at a fake file).
     meminfo_path: str = "/proc/meminfo"
+    # ---- filesystem monitor (ref: src/ray/common/file_system_monitor.h:
+    # above the capacity threshold a node stops taking new work so
+    # spill/log writes can't wedge the whole node).  0 interval disables.
+    fs_monitor_interval_s: float = 5.0
+    local_fs_capacity_threshold: float = 0.95
 
     # ---- accelerators ----
     # Override detected TPU chip count (testing).

@@ -878,9 +878,11 @@ class ClusterRuntime(CoreRuntime):
                     if time.monotonic() < infeasible_deadline:
                         await asyncio.sleep(1.0)
                         continue
+                reason = reply.get("reason") or (
+                    f"requests resources {spec.resources} that no node "
+                    "can ever satisfy")
                 raise exceptions.ArtError(
-                    f"task {spec.function_name} requests resources "
-                    f"{spec.resources} that no node can ever satisfy")
+                    f"task {spec.function_name} is infeasible: {reason}")
             else:
                 raise exceptions.ArtError(f"bad lease reply {reply}")
         raise exceptions.ArtError(

@@ -368,6 +368,7 @@ class GcsServer:
         if info is None:
             return {"unknown_node": True}  # node must re-register
         info.available_resources = payload["available_resources"]
+        info.disk_full = payload.get("disk_full", False)
         self._last_heartbeat[node_id] = time.monotonic()
         return {}
 
@@ -707,6 +708,8 @@ class GcsServer:
         for info in self._nodes.values():
             if not info.alive:
                 continue
+            if getattr(info, "disk_full", False):
+                continue  # out-of-disk nodes take no new work
             if allowed is not None and info.node_id not in allowed:
                 continue
             if not self._labels_match(info, label_selector):

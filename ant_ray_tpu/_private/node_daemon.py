@@ -106,6 +106,7 @@ class NodeManager:
         # job_id -> (allowed_here, expires_at): virtual-cluster fencing
         self._vc_cache: dict = {}
         self.address = ""
+        self._disk_full = False
 
     # ------------------------------------------------------------ lifecycle
 
@@ -142,6 +143,9 @@ class NodeManager:
             self._heartbeat_loop(), self._io.loop))
         self._tasks.append(asyncio.run_coroutine_threadsafe(
             self._monitor_workers_loop(), self._io.loop))
+        if global_config().fs_monitor_interval_s > 0:
+            self._tasks.append(asyncio.run_coroutine_threadsafe(
+                self._fs_monitor_loop(), self._io.loop))
         if global_config().memory_monitor_interval_s > 0:
             self._tasks.append(asyncio.run_coroutine_threadsafe(
                 self._memory_monitor_loop(), self._io.loop))
@@ -223,6 +227,7 @@ class NodeManager:
                 reply = await gcs.call_async("Heartbeat", {
                     "node_id": self.node_id,
                     "available_resources": dict(self._available),
+                    "disk_full": self._disk_full,
                 }, timeout=10)
                 if reply.get("unknown_node"):
                     await self._register()
@@ -412,6 +417,34 @@ class NodeManager:
         return max(candidates,
                    key=lambda h: (h.state == LEASED,
                                   self._worker_rss_kb(h)))
+
+    # ---------------------------------------------- filesystem monitor
+    # (ref: src/ray/common/file_system_monitor.h — a node whose local
+    #  disk crosses the capacity threshold stops accepting new leases,
+    #  redirecting work to nodes that can still spill/log)
+
+    def _read_disk_used_fraction(self) -> float | None:
+        import shutil  # noqa: PLC0415
+
+        try:
+            usage = shutil.disk_usage(self._session_dir or "/tmp")
+            return usage.used / usage.total if usage.total else None
+        except OSError:
+            return None
+
+    async def _fs_monitor_loop(self):
+        cfg = global_config()
+        while not self._stopping:
+            used = self._read_disk_used_fraction()
+            full = (used is not None
+                    and used >= cfg.local_fs_capacity_threshold)
+            if full and not self._disk_full:
+                logger.warning(
+                    "local disk %.1f%% full (>= %.1f%%): node stops "
+                    "accepting new leases until space frees",
+                    100 * used, 100 * cfg.local_fs_capacity_threshold)
+            self._disk_full = full
+            await asyncio.sleep(cfg.fs_monitor_interval_s)
 
     async def _memory_monitor_loop(self):
         cfg = global_config()
@@ -627,6 +660,20 @@ class NodeManager:
                                            timeout=0.2)
                 except asyncio.TimeoutError:
                     pass
+
+        if self._disk_full:
+            # Out-of-disk node: redirect rather than accept work that
+            # would need spill/log space this node doesn't have
+            # (ref: file_system_monitor.h "Out of disk" rejections).
+            node = await gcs.call_async(
+                "SelectNode", {"resources": demand, "job_id": job_id,
+                               "exclude": self.node_id,
+                               "label_selector": selector}, timeout=10)
+            if node is not None and node.node_id != self.node_id:
+                return {"spill": node.address}
+            return {"infeasible": True,
+                    "reason": "node out of disk and no alternative "
+                              "node can satisfy the request"}
 
         if not self._feasible(demand):
             node = await gcs.call_async(

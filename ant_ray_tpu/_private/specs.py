@@ -82,6 +82,9 @@ class NodeInfo:
     object_store_dir: str = ""
     alive: bool = True
     labels: dict[str, str] = field(default_factory=dict)
+    # Filesystem-monitor state: a disk-full node keeps its membership
+    # but is skipped by scheduling (ref: file_system_monitor.h).
+    disk_full: bool = False
 
 
 # Actor lifecycle states (ref: gcs_actor_manager state machine)

@@ -9,6 +9,8 @@ from ant_ray_tpu.serve.api import (
     DeploymentHandle,
     batch,
     deployment,
+    get_multiplexed_model_id,
+    multiplexed,
     run,
     shutdown,
 )
@@ -21,6 +23,8 @@ __all__ = [
     "DeploymentHandle",
     "batch",
     "deployment",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "run",
     "shutdown",
 ]

@@ -14,6 +14,9 @@ autoscaling** (ref: serve/_private/autoscaling_state.py), and
 
 from __future__ import annotations
 
+import collections
+import contextvars
+import functools
 import itertools
 import random
 import threading
@@ -191,6 +194,68 @@ def batch(_fn=None, *, max_batch_size: int = 8,
     return wrap
 
 
+# ------------------------------------------------------------ multiplexing
+
+_multiplexed_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """Model id of the in-flight request, inside a replica method
+    (ref: serve.get_multiplexed_model_id)."""
+    return _multiplexed_model_id.get()
+
+
+def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
+    """Decorate a replica's model-loader method: per-replica LRU of
+    loaded models, keyed by model id (ref: serve/_private/multiplex.py +
+    @serve.multiplexed).  Callers steer requests with
+    ``handle.options(multiplexed_model_id="m")``; the handle keeps
+    model→replica affinity so one model isn't re-loaded on every
+    replica (design note: affinity is handle-local here, where the
+    reference shares replica model sets via controller long-poll — same
+    steady state for any given caller, no extra control-plane chatter).
+    """
+
+    def wrap(fn):
+        cache_attr = f"__serve_mux_cache_{fn.__name__}"
+        lock_attr = f"__serve_mux_lock_{fn.__name__}"
+
+        @functools.wraps(fn)
+        def wrapper(self_obj, model_id=None):
+            if model_id is None:
+                model_id = get_multiplexed_model_id()
+            lock = getattr(self_obj, lock_attr, None)
+            if lock is None:
+                lock = threading.Lock()
+                setattr(self_obj, lock_attr, lock)
+            # One lock over lookup AND load: replicas run requests on a
+            # thread pool, and two concurrent misses for one model must
+            # not both run the loader (double model load = OOM with
+            # real weights) or race the OrderedDict.
+            with lock:
+                cache = getattr(self_obj, cache_attr, None)
+                if cache is None:
+                    cache = collections.OrderedDict()
+                    setattr(self_obj, cache_attr, cache)
+                if model_id in cache:
+                    cache.move_to_end(model_id)
+                    return cache[model_id]
+                model = fn(self_obj, model_id)
+                cache[model_id] = model
+                while len(cache) > max_num_models_per_replica:
+                    cache.popitem(last=False)  # LRU eviction
+                return model
+
+        wrapper.__serve_multiplexed__ = True
+        return wrapper
+
+    if _fn is not None:
+        return wrap(_fn)
+    return wrap
+
+
+
 class DeploymentHandle:
     """Client handle routing calls across a deployment's replicas with
     power-of-two-choices over reported queue depths
@@ -203,12 +268,18 @@ class DeploymentHandle:
 
     def __init__(self, deployment_name: str, replicas: list,
                  method_name: str = "__call__", stream: bool = False,
-                 controller=None):
+                 controller=None, multiplexed_model_id: str = "",
+                 _mux_affinity: dict | None = None):
         self._name = deployment_name
         self._replicas = list(replicas)
         self._method = method_name
         self._stream = stream
         self._controller = controller
+        self._mux_model_id = multiplexed_model_id
+        # model id -> replica index; SHARED with handles derived via
+        # options() so affinity survives per-request option changes
+        self._mux_affinity = ({} if _mux_affinity is None
+                              else _mux_affinity)
         self._rr = itertools.count()
         self._ongoing: list = [0] * len(self._replicas)
         self._local_extra: dict[int, int] = {}
@@ -216,15 +287,21 @@ class DeploymentHandle:
         self._lock = threading.Lock()
 
     def options(self, method_name: str | None = None,
-                stream: bool | None = None) -> "DeploymentHandle":
+                stream: bool | None = None,
+                multiplexed_model_id: str | None = None
+                ) -> "DeploymentHandle":
         """``stream=True``: remote() returns an ObjectRefGenerator whose
         refs arrive as the replica's generator produces them
-        (ref: handle.options(stream=True))."""
+        (ref: handle.options(stream=True)).  ``multiplexed_model_id``
+        routes to the replica that already serves that model."""
         return DeploymentHandle(
             self._name, self._replicas,
             method_name if method_name is not None else self._method,
             self._stream if stream is None else stream,
-            self._controller)
+            self._controller,
+            (self._mux_model_id if multiplexed_model_id is None
+             else multiplexed_model_id),
+            self._mux_affinity)
 
     def _maybe_refresh(self):
         if self._controller is None:
@@ -270,18 +347,37 @@ class DeploymentHandle:
 
     def remote(self, *args, **kwargs):
         self._maybe_refresh()
-        index = self._pick()
+        model_id = self._mux_model_id
+        if model_id:
+            # Affinity is by replica IDENTITY: handles refresh their
+            # replica lists independently, so a stored index could point
+            # at a different replica after a resize.
+            with self._lock:
+                target = self._mux_affinity.get(model_id)
+                index = None
+                if target is not None:
+                    for k, r in enumerate(self._replicas):
+                        if r.actor_id == target.actor_id:
+                            index = k
+                            break
+            if index is None:
+                index = self._pick()
+                with self._lock:
+                    self._mux_affinity[model_id] = self._replicas[index]
+        else:
+            index = self._pick()
         with self._lock:
             replica = self._replicas[index]
         if self._stream:
             return replica.handle_request_streaming.remote(
-                self._method, args, kwargs)
-        return replica.handle_request.remote(self._method, args, kwargs)
+                self._method, args, kwargs, model_id)
+        return replica.handle_request.remote(self._method, args, kwargs,
+                                             model_id)
 
     def __reduce__(self):
         return (DeploymentHandle,
                 (self._name, self._replicas, self._method, self._stream,
-                 self._controller))
+                 self._controller, self._mux_model_id))
 
 
 # ---------------------------------------------------------------- actors
@@ -298,21 +394,28 @@ class Replica:
         self._ongoing = 0
         self._ongoing_lock = threading.Lock()
 
-    def _invoke(self, method_name: str, args, kwargs):
-        if method_name == "__call__":
-            return self._instance(*args, **kwargs)
-        return getattr(self._instance, method_name)(*args, **kwargs)
+    def _invoke(self, method_name: str, args, kwargs, model_id: str = ""):
+        token = _multiplexed_model_id.set(model_id) if model_id else None
+        try:
+            if method_name == "__call__":
+                return self._instance(*args, **kwargs)
+            return getattr(self._instance, method_name)(*args, **kwargs)
+        finally:
+            if token is not None:
+                _multiplexed_model_id.reset(token)
 
-    def handle_request(self, method_name: str, args, kwargs):
+    def handle_request(self, method_name: str, args, kwargs,
+                       model_id: str = ""):
         with self._ongoing_lock:
             self._ongoing += 1
         try:
-            return self._invoke(method_name, args, kwargs)
+            return self._invoke(method_name, args, kwargs, model_id)
         finally:
             with self._ongoing_lock:
                 self._ongoing -= 1
 
-    def handle_request_streaming(self, method_name: str, args, kwargs):
+    def handle_request_streaming(self, method_name: str, args, kwargs,
+                                 model_id: str = ""):
         """Streaming dispatch: the target method must return a generator;
         its items flow back as a streaming actor call.  The ongoing
         count covers the WHOLE stream — a replica mid-generation must
@@ -320,9 +423,12 @@ class Replica:
         victim."""
         with self._ongoing_lock:
             self._ongoing += 1
+        token = _multiplexed_model_id.set(model_id) if model_id else None
         try:
             yield from self._invoke(method_name, args, kwargs)
         finally:
+            if token is not None:
+                _multiplexed_model_id.reset(token)
             with self._ongoing_lock:
                 self._ongoing -= 1
 

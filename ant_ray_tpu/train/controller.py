@@ -150,6 +150,13 @@ class TrainController:
             # crash of the controller itself).
             except (art.exceptions.ArtError, RuntimeError) as e:
                 last_error = e
+                if (hasattr(policy, "note_unplaceable")
+                        and isinstance(e, RuntimeError)
+                        and ("reserve" in str(e)
+                             or "infeasible" in str(e))):
+                    # Aggregate capacity over-estimated placeability
+                    # (fragmentation): converge downward.
+                    policy.note_unplaceable(world)
                 logger.warning(
                     "worker group (world=%d) failed (attempt %d/%d): %s",
                     world, attempt + 1, attempts, e)

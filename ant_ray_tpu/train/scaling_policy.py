@@ -47,6 +47,11 @@ class ElasticScalingPolicy(ScalingPolicy):
         if min_workers < 1:
             raise ValueError("min_workers must be >= 1")
         self.min_workers = min_workers
+        # Upper bound learned from reservation failures: aggregate
+        # capacity can over-estimate what is PLACEABLE (per-node
+        # fragmentation), so an unplaceable gang steps the next request
+        # down instead of burning every attempt at the same size.
+        self._cap: int | None = None
 
     def _placeable(self, scaling, resources: dict) -> int:
         demand = scaling.worker_resources()
@@ -70,12 +75,23 @@ class ElasticScalingPolicy(ScalingPolicy):
         if attempt > 0:
             avail_fit = self._placeable(scaling, available)
             fit = min(fit, max(self.min_workers, avail_fit))
+        if self._cap is not None:
+            fit = min(fit, self._cap)
         world = max(self.min_workers, min(scaling.num_workers, fit))
         if world < scaling.num_workers:
             logger.warning(
                 "elastic: cluster fits %d/%d workers — launching a "
                 "reduced group", world, scaling.num_workers)
         return world
+
+    def note_unplaceable(self, world: int) -> None:
+        """A gang of ``world`` bundles timed out: step down next time."""
+        self._cap = max(self.min_workers, world - 1)
+
+    def note_group_started(self) -> None:
+        """A group launched: forget the learned cap (capacity may have
+        returned; the next restart probes upward again)."""
+        self._cap = None
 
 
 def policy_for(scaling) -> ScalingPolicy:

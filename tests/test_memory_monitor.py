@@ -54,3 +54,32 @@ def test_oom_kill_retries_task(tmp_path, shutdown_only):
 
     assert art.get(ref, timeout=120) == "done"  # retry succeeded
     assert marker.read_text().count("x") >= 2   # it really died once
+
+
+def test_disk_full_node_rejects_new_leases():
+    """FS monitor: a node over the disk-capacity threshold stops taking
+    leases (ref: src/ray/common/file_system_monitor.h)."""
+    import pytest
+
+    from ant_ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={
+        "num_cpus": 2,
+        "_system_config": {"local_fs_capacity_threshold": 0.0,
+                           "fs_monitor_interval_s": 0.1,
+                           "lease_retry_deadline_s": 5.0}})
+    cluster.connect()
+    try:
+        import time as _t
+
+        _t.sleep(0.5)  # let the monitor take its first reading
+
+        @art.remote
+        def f():
+            return 1
+
+        with pytest.raises(Exception, match="out of disk|scheduled"):
+            art.get(f.remote(), timeout=30)
+    finally:
+        art.shutdown()
+        cluster.shutdown()

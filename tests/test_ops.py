@@ -148,8 +148,9 @@ def test_runtime_env_on_actor(dashboard_cluster):
 def test_runtime_env_validation():
     from ant_ray_tpu._private.runtime_env import validate
 
+    validate({"pip": ["requests"]})  # supported since round 2
     with pytest.raises(ValueError, match="unsupported"):
-        validate({"pip": ["requests"]})
+        validate({"conda": {"dependencies": []}})
     with pytest.raises(ValueError, match="str->str"):
         validate({"env_vars": {"A": 1}})
 

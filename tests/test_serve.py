@@ -209,3 +209,46 @@ def serve_replica_count(name):
     controller = art.get_actor(_serve.CONTROLLER_NAME, namespace="_serve")
     info = art.get(controller.list_deployments.remote())
     return info[name]["num_replicas"]
+
+
+def test_model_multiplexing(cluster):
+    """Multiplexed models: per-replica LRU loading + model->replica
+    affinity routing (ref: serve/_private/multiplex.py,
+    @serve.multiplexed, handle.options(multiplexed_model_id=...))."""
+    from ant_ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class MuxModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=1)
+        def get_model(self, model_id):
+            self.loads.append(model_id)
+            return f"model-{model_id}"
+
+        def __call__(self, x):
+            import os
+            model_id = serve.get_multiplexed_model_id()
+            model = self.get_model()
+            return {"model": model, "pid": os.getpid(),
+                    "loads": len(self.loads), "x": x}
+
+    handle = serve.run(MuxModel.bind())
+
+    # Same model id -> same replica every time (affinity).
+    a_pids = {art.get(handle.options(multiplexed_model_id="a")
+                      .remote(i))["pid"] for i in range(4)}
+    assert len(a_pids) == 1
+
+    out_b = art.get(handle.options(multiplexed_model_id="b").remote(0))
+    assert out_b["model"] == "model-b"
+
+    # LRU width 1: re-requesting "a" after "b" on the SAME replica
+    # would reload; with affinity, "a" stays on its own replica and its
+    # second batch of calls does not grow the load count.
+    out_a = art.get(handle.options(multiplexed_model_id="a").remote(9))
+    assert out_a["model"] == "model-a"
+    assert out_a["pid"] in a_pids
+    assert out_a["loads"] == 1  # loaded once, cached since
+    serve.shutdown()

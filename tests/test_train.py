@@ -188,3 +188,20 @@ def test_elastic_policy_sizes_group_to_capacity():
     with pytest.raises(ValueError, match="slice"):
         policy_for(ScalingConfig(num_workers=4, min_workers=2,
                                  use_tpu=True, topology="2x4"))
+
+
+def test_elastic_policy_converges_on_unplaceable_gangs():
+    """Fragmented capacity: a failed reservation steps the next request
+    down; a successful launch resets the learned cap."""
+    from ant_ray_tpu.train.scaling_policy import ElasticScalingPolicy
+
+    scaling = ScalingConfig(num_workers=4, min_workers=2,
+                            resources_per_worker={"CPU": 2.0})
+    policy = ElasticScalingPolicy(2)
+    # total 6 CPUs -> aggregate fit 3, but two 3-CPU nodes place only 2
+    total = {"CPU": 6.0}
+    assert policy.workers_for_attempt(scaling, {}, total) == 3
+    policy.note_unplaceable(3)
+    assert policy.workers_for_attempt(scaling, {}, total, attempt=1) == 2
+    policy.note_group_started()
+    assert policy.workers_for_attempt(scaling, {}, total) == 3
