@@ -1,0 +1,131 @@
+"""Control/object-plane microbenchmarks
+(ref: python/ray/_private/ray_perf.py:122-317 + release/microbenchmark/
+run_microbenchmark.py — the reference's per-release throughput suite:
+tasks/s, actor calls/s, put/get, wait over many refs).
+
+Run:  python benchmarks/microbench.py [--quick]
+Prints one JSON line per workload:
+    {"metric": ..., "value": N, "unit": ...}
+
+These are CONTROL-PLANE numbers (scheduler, RPC, object store) — the
+accelerator-plane number (train-step MFU) lives in bench.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("ART_JAX_PLATFORM", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def timeit(fn, n: int, warmup: int = 1) -> float:
+    """Ops/s of fn(batch_size=n) after warmup."""
+    for _ in range(warmup):
+        fn(max(1, n // 10))
+    t0 = time.perf_counter()
+    fn(n)
+    return n / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="10x smaller workloads")
+    args = parser.parse_args()
+    scale = 0.1 if args.quick else 1.0
+
+    import ant_ray_tpu as art
+
+    art.init(num_cpus=4)
+    results = []
+
+    def emit(metric: str, value: float, unit: str):
+        line = {"metric": metric, "value": round(value, 1), "unit": unit}
+        results.append(line)
+        print(json.dumps(line), flush=True)
+
+    # ---- single small task round trips (ray_perf: "tasks sync")
+    @art.remote
+    def nop():
+        return None
+
+    def sync_tasks(n):
+        for _ in range(n):
+            art.get(nop.remote())
+
+    emit("task_sync_roundtrips_per_s", timeit(sync_tasks, int(200 * scale)),
+         "tasks/s")
+
+    # ---- batched task submission (ray_perf: "tasks async")
+    def async_tasks(n):
+        art.get([nop.remote() for _ in range(n)])
+
+    emit("task_async_throughput_per_s",
+         timeit(async_tasks, int(2000 * scale)), "tasks/s")
+
+    # ---- 1:1 actor call round trips (ray_perf: "1:1 actor calls sync")
+    @art.remote
+    class Echo:
+        def ping(self, x=None):
+            return x
+
+    actor = Echo.remote()
+    art.get(actor.ping.remote())
+
+    def actor_sync(n):
+        for _ in range(n):
+            art.get(actor.ping.remote())
+
+    emit("actor_call_sync_per_s", timeit(actor_sync, int(200 * scale)),
+         "calls/s")
+
+    # ---- pipelined actor calls (ray_perf: "1:1 actor calls async")
+    def actor_async(n):
+        art.get([actor.ping.remote() for _ in range(n)])
+
+    emit("actor_call_async_per_s", timeit(actor_async, int(2000 * scale)),
+         "calls/s")
+
+    # ---- small put/get (ray_perf: "single client put/get")
+    def put_get(n):
+        for _ in range(n):
+            art.get(art.put(b"x" * 100))
+
+    emit("small_put_get_per_s", timeit(put_get, int(500 * scale)), "ops/s")
+
+    # ---- large object bandwidth (ray_perf: "put gigabytes")
+    blob = np.random.default_rng(0).bytes(64 << 20)  # 64 MiB
+
+    def put_gb(n):
+        for _ in range(n):
+            art.get(art.put(blob))
+
+    n_big = max(2, int(8 * scale))
+    for _ in range(1):
+        put_gb(1)
+    t0 = time.perf_counter()
+    put_gb(n_big)
+    gbps = (len(blob) * n_big / (1 << 30)) / (time.perf_counter() - t0)
+    emit("put_get_bandwidth_gb_s", gbps, "GiB/s")
+
+    # ---- wait over many refs (ray_perf: "wait 1k refs")
+    refs = [nop.remote() for _ in range(int(1000 * scale))]
+    art.get(refs)
+    t0 = time.perf_counter()
+    ready, _ = art.wait(refs, num_returns=len(refs), timeout=60)
+    emit("wait_1k_ready_refs_s", time.perf_counter() - t0, "s")
+    assert len(ready) == len(refs)
+
+    art.shutdown()
+    print(json.dumps({"metric": "microbench_summary",
+                      "workloads": len(results)}))
+
+
+if __name__ == "__main__":
+    main()
