@@ -81,6 +81,18 @@ class Config:
     # a busy cluster before giving up (the redirect chain itself is
     # unbounded, matching the reference submitter).
     lease_retry_deadline_s: float = 120.0
+    # Lease reuse (ref: NormalTaskSubmitter scheduling-key entries,
+    # normal_task_submitter.cc:185 — leased workers are reused for
+    # queued tasks of the same scheduling key instead of paying a
+    # lease/return RPC pair per task):
+    # how long a drained worker lease lingers waiting for the next task
+    # of its key before being returned to the node.
+    task_lease_linger_s: float = 0.05
+    # In-flight PushTask pipeline depth per leased worker (hides the RPC
+    # round trip behind execution of the previous task).
+    task_push_pipeline_depth: int = 4
+    # Max concurrent LeaseWorker requests parked per scheduling key.
+    max_pending_lease_requests: int = 8
 
     # ---- fault tolerance ----
     task_max_retries_default: int = 3

@@ -80,6 +80,24 @@ class _StreamState:
 
 
 @dataclass
+class _SchedKeyState:
+    """Per-scheduling-key task queue + leased-worker pool (ref:
+    NormalTaskSubmitter's scheduling_key_entries_,
+    task_submission/normal_task_submitter.h:295 — tasks with the same
+    (resources, runtime_env, placement, labels) share worker leases
+    instead of paying a lease/return RPC pair each)."""
+
+    resources: dict
+    runtime_env: Any
+    label_selector: dict | None
+    pg: tuple | None                  # (pg_id, bundle_index) if any
+    queue: deque = field(default_factory=deque)  # (spec, pinned, attempt)
+    workers: int = 0                  # granted leases currently draining
+    acquiring: int = 0                # LeaseWorker requests in flight
+    wakeup: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+@dataclass
 class _ActorSubmitState:
     """Per-actor ordered submission queue
     (ref: ActorTaskSubmitter, task_submission/actor_task_submitter.h:68)."""
@@ -90,6 +108,42 @@ class _ActorSubmitState:
     queue: deque = field(default_factory=deque)
     sender_running: bool = False
     dead_reason: str | None = None
+
+
+# Precomputed wire form of "no arguments" — the most common actor-call
+# shape; skips a serializer pass per call.
+_EMPTY_ARGS_PAYLOAD = serialization.serialize(((), {})).to_payload()
+
+
+class _BlockedCtx:
+    """Blocked-in-get() marker for the node daemon (module-level: this is
+    entered on every get(), so it must not define classes or closures)."""
+
+    __slots__ = ("_runtime",)
+
+    def __init__(self, runtime):
+        self._runtime = runtime
+
+    def __enter__(self):
+        runtime = self._runtime
+        if runtime.role == "worker" and runtime.worker_id is not None:
+            with runtime._blocked_lock:
+                runtime._blocked_depth += 1
+                if runtime._blocked_depth == 1:
+                    runtime._send_oneway(
+                        runtime.node_address, "WorkerBlocked",
+                        {"worker_id": runtime.worker_id})
+        return self
+
+    def __exit__(self, *exc):
+        runtime = self._runtime
+        if runtime.role == "worker" and runtime.worker_id is not None:
+            with runtime._blocked_lock:
+                runtime._blocked_depth -= 1
+                if runtime._blocked_depth == 0:
+                    runtime._send_oneway(
+                        runtime.node_address, "WorkerUnblocked",
+                        {"worker_id": runtime.worker_id})
 
 
 class ClusterRuntime(CoreRuntime):
@@ -152,7 +206,13 @@ class ClusterRuntime(CoreRuntime):
         # actor can no longer restart (killed or permanently dead)
         self._actor_ctor_pins: dict[ActorID, list] = {}
         self._borrowed_from: dict[ObjectID, str] = {} # owner addr of my borrows
-        self._ref_lock = threading.Lock()
+        # Reentrant: dropping the last Python reference to an ObjectRef
+        # *inside* a locked region (e.g. releasing a _contained_pins list,
+        # or a cyclic-GC pass triggered by any allocation while the lock
+        # is held) fires ObjectRef.__del__ → _refcount_event on the same
+        # thread; a plain Lock self-deadlocks there.  The nested calls
+        # only do per-key dict ops, which compose safely.
+        self._ref_lock = threading.RLock()
         set_refcount_hook(self._refcount_event)
 
         # ---- function/class export
@@ -165,6 +225,7 @@ class ClusterRuntime(CoreRuntime):
         self._lineage: dict[ObjectID, TaskSpec] = {}
         self._reconstructions: dict[TaskID, asyncio.Future] = {}
 
+        self._sched_states: dict[tuple, _SchedKeyState] = {}
         self._actor_states: dict[ActorID, _ActorSubmitState] = {}
         self._actor_meta_cache: dict[ActorID, dict] = {}
         self._pg_bundle_cache: dict = {}  # pg_id -> [node addresses]
@@ -620,29 +681,7 @@ class ClusterRuntime(CoreRuntime):
     def _blocked(self):
         """Tell the node daemon this worker is blocked so its cpu can be
         re-used (deadlock avoidance for nested tasks)."""
-        runtime = self
-
-        class _Ctx:
-            def __enter__(self):
-                if runtime.role == "worker" and runtime.worker_id is not None:
-                    with runtime._blocked_lock:
-                        runtime._blocked_depth += 1
-                        if runtime._blocked_depth == 1:
-                            runtime._send_oneway(
-                                runtime.node_address, "WorkerBlocked",
-                                {"worker_id": runtime.worker_id})
-                return self
-
-            def __exit__(self, *exc):
-                if runtime.role == "worker" and runtime.worker_id is not None:
-                    with runtime._blocked_lock:
-                        runtime._blocked_depth -= 1
-                        if runtime._blocked_depth == 0:
-                            runtime._send_oneway(
-                                runtime.node_address, "WorkerUnblocked",
-                                {"worker_id": runtime.worker_id})
-
-        return _Ctx()
+        return _BlockedCtx(self)
 
     # ------------------------------------------------------------ tasks
 
@@ -697,8 +736,8 @@ class ClusterRuntime(CoreRuntime):
 
             task_events.record(task_id.hex(), spec.function_name,
                                "submitted")
-        asyncio.run_coroutine_threadsafe(
-            self._run_normal_task(spec, pinned), self._io.loop)
+        self._io.loop.call_soon_threadsafe(
+            self._enqueue_task, spec, pinned, 0)
         if streaming:
             from ant_ray_tpu.object_ref import ObjectRefGenerator  # noqa: PLC0415
 
@@ -710,6 +749,8 @@ class ClusterRuntime(CoreRuntime):
         control-plane RPC frame stays small (ref behavior:
         max_direct_call_object_size).  Returns (wire payload, refs pinned
         for the task's lifetime — unpinned by the caller on completion)."""
+        if not args and not kwargs:
+            return _EMPTY_ARGS_PAYLOAD, []
         ser = serialization.serialize((args, kwargs))
         payload = ser.to_payload()
         if len(payload) <= global_config().max_inline_object_size:
@@ -740,40 +781,232 @@ class ClusterRuntime(CoreRuntime):
             self._renv_cache[cache_key] = wire
         return wire
 
-    async def _run_normal_task(self, spec: TaskSpec, pinned_args):
+    # ----------------------------------------- scheduling-key submission
+    # (ref: NormalTaskSubmitter, task_submission/normal_task_submitter.cc:185
+    #  — worker leases are keyed by the task's scheduling class and reused
+    #  across queued tasks, with pipelined pushes hiding the RPC round
+    #  trip; without this every task pays lease+push+return round trips.)
+
+    def _sched_key(self, spec: TaskSpec) -> tuple:
+        from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
+
+        return (
+            tuple(sorted(spec.resources.items())),
+            renv.env_key(spec.runtime_env),
+            tuple(sorted((spec.label_selector or {}).items())),
+            (spec.placement_group_id, spec.placement_group_bundle_index)
+            if spec.placement_group_id is not None else None,
+        )
+
+    def _enqueue_task(self, spec: TaskSpec, pinned, attempt: int):
+        """Queue a task under its scheduling key (io-loop only)."""
+        key = self._sched_key(spec)
+        state = self._sched_states.get(key)
+        if state is None:
+            state = _SchedKeyState(
+                resources=spec.resources,
+                runtime_env=spec.runtime_env,
+                label_selector=spec.label_selector,
+                pg=((spec.placement_group_id,
+                     spec.placement_group_bundle_index)
+                    if spec.placement_group_id is not None else None))
+            self._sched_states[key] = state
+        state.queue.append((spec, pinned, attempt))
+        state.wakeup.set()
+        self._maybe_acquire(key, state)
+
+    def _maybe_acquire(self, key: tuple, state: _SchedKeyState):
+        cap = global_config().max_pending_lease_requests
+        while (state.acquiring < cap
+               and state.workers + state.acquiring < len(state.queue)):
+            state.acquiring += 1
+            asyncio.ensure_future(self._acquire_worker(key, state))
+
+    async def _acquire_worker(self, key: tuple, state: _SchedKeyState):
         try:
-            attempts = spec.max_retries + 1
-            last_error: Exception | None = None
-            for attempt in range(attempts):
-                try:
-                    reply = await self._lease_and_push(spec)
-                    self._store_returns(spec, reply["returns"])
-                    return
-                except (RpcConnectionError, exceptions.WorkerCrashedError) as e:
-                    last_error = e
-                    logger.warning("task %s attempt %d/%d failed: %s",
-                                   spec.function_name, attempt + 1,
-                                   attempts, e)
-                    # Brief backoff so daemons reap dead workers before
-                    # the retry leases again (ref: retry delays in
-                    # NormalTaskSubmitter) — skipped after the final
-                    # attempt (nothing left to wait for).
-                    if attempt + 1 < attempts:
-                        await asyncio.sleep(
-                            min(0.05 * (attempt + 1), 0.5))
-            err = exceptions.WorkerCrashedError(
-                f"task {spec.function_name} failed after {attempts} "
-                f"attempts: {last_error}")
-            self._store_error(spec, err)
-        except exceptions.ArtError as e:
-            self._store_error(spec, e)
-        except Exception as e:  # noqa: BLE001 — never lose a task silently
-            logger.exception("internal error running task %s",
-                             spec.function_name)
-            self._store_error(spec, exceptions.ArtError(repr(e)))
+            node, worker_addr, worker_id = await self._lease_for_state(state)
+        except Exception as e:  # noqa: BLE001 — infeasible / saturated
+            state.acquiring -= 1
+            # Only a key with no serving capacity at all fails its queue:
+            # with live workers the queue still drains through them.
+            if state.workers == 0 and state.acquiring == 0:
+                while state.queue:
+                    spec, pinned, _attempt = state.queue.popleft()
+                    # Per-task error naming: the shared scheduling-key
+                    # failure must still say which remote call it sank.
+                    self._store_error(spec, exceptions.ArtError(
+                        f"task {spec.function_name}: {e}"))
+                    self._unpin(pinned)
+            return
+        state.acquiring -= 1
+        state.workers += 1
+        try:
+            await self._worker_drain(state, worker_addr)
         finally:
-            if pinned_args:
-                self._unpin(pinned_args)
+            state.workers -= 1
+            try:
+                await node.call_async(
+                    "ReturnWorker", {"worker_id": worker_id}, timeout=10)
+            except Exception:  # noqa: BLE001
+                pass
+            if state.queue:
+                self._maybe_acquire(key, state)
+            elif (state.workers == 0 and state.acquiring == 0
+                  and self._sched_states.get(key) is state):
+                del self._sched_states[key]
+
+    async def _lease_for_state(self, state: _SchedKeyState):
+        """Acquire one worker lease for a scheduling key, following
+        spillback redirects; returns (node_client, worker_addr,
+        worker_id).  Raises on terminal infeasibility/saturation."""
+        lease_payload = {"resources": state.resources,
+                         "runtime_env": state.runtime_env,
+                         "job_id": self.job_id,
+                         "label_selector": state.label_selector}
+        if state.pg is not None:
+            node = await self._resolve_bundle_node(*state.pg)
+            lease_payload["pg"] = state.pg
+        else:
+            node = self._node
+        infeasible_deadline: float | None = None
+        deadline = time.monotonic() + global_config().lease_retry_deadline_s
+        hops = 0
+        conn_failures = 0
+        while time.monotonic() < deadline:
+            hops += 1
+            if hops > 4:
+                await asyncio.sleep(min(0.05 * (hops - 4), 0.5))
+            try:
+                reply = await node.call_async(
+                    "LeaseWorker", lease_payload, timeout=-1)
+            except RpcConnectionError:
+                # Transient daemon unavailability (restart, chaos, net
+                # blip) must not be terminal for the whole queue — back
+                # off and retry within the deadline, falling back to the
+                # home node if a spillback target died.
+                conn_failures += 1
+                self._clients.invalidate(node.address)
+                node = (self._node if state.pg is None
+                        else await self._resolve_bundle_node(*state.pg))
+                await asyncio.sleep(min(0.1 * conn_failures, 2.0))
+                continue
+            if "granted" in reply:
+                return node, reply["granted"], reply["worker_id"]
+            if "spill" in reply:
+                node = self._clients.get(reply["spill"])
+            elif "infeasible" in reply:
+                # With a live autoscaler the recorded demand may
+                # provision a node — wait and retry instead of failing
+                # (ref: infeasible tasks queue until the autoscaler
+                # satisfies them).  Without one, fail fast.
+                if await self._autoscaling_enabled():
+                    if infeasible_deadline is None:
+                        infeasible_deadline = time.monotonic() + \
+                            global_config().infeasible_wait_s
+                        deadline = max(deadline, infeasible_deadline + 1)
+                    if time.monotonic() < infeasible_deadline:
+                        await asyncio.sleep(1.0)
+                        continue
+                reason = reply.get("reason") or (
+                    f"requests resources {state.resources} that no node "
+                    "can ever satisfy")
+                raise exceptions.ArtError(f"task is infeasible: {reason}")
+            else:
+                raise exceptions.ArtError(f"bad lease reply {reply}")
+        raise exceptions.ArtError(
+            f"tasks requesting {state.resources} could not be scheduled "
+            f"within {global_config().lease_retry_deadline_s:.0f}s "
+            f"({hops} spillback hops) — cluster saturated or demand "
+            "unsatisfiable")
+
+    async def _worker_drain(self, state: _SchedKeyState, worker_addr: str):
+        """Feed queued tasks of one scheduling key to one leased worker,
+        keeping up to pipeline_depth pushes in flight; the lease lingers
+        briefly on an empty queue so sync call→get loops reuse it."""
+        cfg = global_config()
+        client = self._clients.get(worker_addr)
+        depth = max(1, cfg.task_push_pipeline_depth)
+        linger = cfg.task_lease_linger_s
+        inflight: deque = deque()
+        dead: Exception | None = None
+        while True:
+            # Pipeline beyond one in-flight task only for queue surplus
+            # that pending lease acquisitions could not absorb anyway —
+            # greedily batching into one worker would serialize tasks
+            # that parallel workers should run.
+            while (dead is None and state.queue and len(inflight) < depth
+                   and (not inflight
+                        or len(state.queue) > state.acquiring)):
+                spec, pinned, attempt = state.queue.popleft()
+                try:
+                    fut = await client.send_request("PushTask", spec,
+                                                    defer=True)
+                except (RpcConnectionError, OSError) as e:
+                    dead = e
+                    state.queue.appendleft((spec, pinned, attempt))
+                    # Frames deferred earlier this burst were never
+                    # shipped — fail their futures (reaped below as
+                    # retries) rather than leaving them to replay.
+                    client.discard_deferred()
+                    break
+                inflight.append((spec, pinned, attempt, fut))
+            if dead is None and inflight:
+                try:
+                    await client.flush_deferred()
+                except (RpcConnectionError, OSError) as e:
+                    dead = e
+            if inflight:
+                spec, pinned, attempt, fut = inflight.popleft()
+                try:
+                    reply = await fut
+                    self._store_returns(spec, reply["returns"])
+                    self._unpin(pinned)
+                except (RpcConnectionError, asyncio.CancelledError,
+                        exceptions.WorkerCrashedError) as e:
+                    dead = (e if isinstance(e, Exception)
+                            else exceptions.WorkerCrashedError(repr(e)))
+                    self._retry_or_fail(spec, pinned, attempt, dead)
+                except exceptions.ArtError as e:
+                    self._store_error(spec, e)
+                    self._unpin(pinned)
+                except Exception as e:  # noqa: BLE001 — never lose a task
+                    logger.exception("internal error running task %s",
+                                     spec.function_name)
+                    self._store_error(spec, exceptions.ArtError(repr(e)))
+                    self._unpin(pinned)
+                continue
+            if dead is not None:
+                return
+            if state.queue:
+                continue
+            # Empty queue, nothing in flight: linger for the next task.
+            state.wakeup.clear()
+            if not state.queue:  # re-check after clear (enqueue races set)
+                try:
+                    await asyncio.wait_for(state.wakeup.wait(), linger)
+                except asyncio.TimeoutError:
+                    return
+            if not state.queue:
+                return
+
+    def _retry_or_fail(self, spec: TaskSpec, pinned, attempt: int,
+                       err: Exception):
+        """A pushed task's worker died: retry on a fresh lease (bounded
+        by max_retries) or surface the error."""
+        if attempt < spec.max_retries:
+            logger.warning("task %s attempt %d/%d failed: %s",
+                           spec.function_name, attempt + 1,
+                           spec.max_retries + 1, err)
+            # Brief backoff so daemons reap dead workers before the
+            # retry leases again (ref: NormalTaskSubmitter retry delays).
+            self._io.loop.call_later(
+                min(0.05 * (attempt + 1), 0.5),
+                self._enqueue_task, spec, pinned, attempt + 1)
+        else:
+            self._store_error(spec, exceptions.WorkerCrashedError(
+                f"task {spec.function_name} failed after "
+                f"{spec.max_retries + 1} attempts: {err}"))
+            self._unpin(pinned)
 
     async def _resolve_bundle_node(self, pg_id, bundle_index: int):
         """Wait for the placement group, return the bundle's node client.
@@ -1352,21 +1585,26 @@ class ClusterRuntime(CoreRuntime):
                     await self._on_actor_connection_loss(
                         state, spec, pinned, attempt)
                     continue
-                asyncio.ensure_future(
-                    self._actor_reply(state, spec, pinned, attempt, fut))
+                # Done-callback, not a coroutine per call: at 10k calls/s
+                # a task object per reply is measurable loop overhead.
+                fut.add_done_callback(
+                    lambda f, s=state, sp=spec, p=pinned, a=attempt:
+                    self._on_actor_reply(s, sp, p, a, f))
         finally:
             state.sender_running = False
             if state.queue:  # raced with a new enqueue
                 state.sender_running = True
                 asyncio.ensure_future(self._actor_sender(state))
 
-    async def _actor_reply(self, state, spec, pinned, attempt, fut):
+    def _on_actor_reply(self, state, spec, pinned, attempt,
+                        fut: asyncio.Future):
         try:
-            reply = await fut
+            reply = fut.result()
             self._store_returns(spec, reply["returns"])
             self._unpin(pinned)
         except (RpcConnectionError, asyncio.CancelledError):
-            await self._on_actor_connection_loss(state, spec, pinned, attempt)
+            asyncio.ensure_future(self._on_actor_connection_loss(
+                state, spec, pinned, attempt))
         except Exception as e:  # noqa: BLE001
             self._store_error(spec, exceptions.ArtError(repr(e)))
             self._unpin(pinned)

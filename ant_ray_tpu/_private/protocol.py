@@ -30,6 +30,11 @@ _REQ, _REP, _ERR, _ONEWAY = 0, 1, 2, 3
 
 _HEADER = 8  # u64 big-endian frame length
 
+# Transport write-buffer level above which senders await drain (flow
+# control); below it, frames are written inline with no await.  Shared by
+# client sends and server replies.
+_DRAIN_THRESHOLD = 1 << 20
+
 
 class RpcError(Exception):
     pass
@@ -199,9 +204,13 @@ class RpcServer:
             except Exception:
                 frame = _encode_frame((_ERR, msg_id, method, RpcError(repr(e))))
         try:
-            async with write_lock:
-                writer.write(frame)
-                await writer.drain()
+            # Fast path mirrors RpcClient._write_frame: plain write when
+            # the transport buffer is shallow, locked drain only under
+            # back-pressure (concurrent drains are unsafe when paused).
+            writer.write(frame)
+            if writer.transport.get_write_buffer_size() > _DRAIN_THRESHOLD:
+                async with write_lock:
+                    await writer.drain()
         except (ConnectionResetError, BrokenPipeError):
             pass
 
@@ -235,6 +244,11 @@ class RpcClient:
         # unsafe once the transport pauses (see server-side note).  Lock
         # acquisition is FIFO, so sequential senders keep their send order.
         self._write_lock: asyncio.Lock | None = None
+        # (frame, reply-future) pairs deferred by send_request(defer=True),
+        # written in one syscall by flush_deferred() (pipelined task
+        # pushes); discard_deferred() fails the futures of frames that
+        # were never shipped so callers can retry instead of hanging.
+        self._outbox: list[tuple[bytes, asyncio.Future]] = []
         self._chaos = _ChaosInjector(global_config().testing_rpc_failure)
         self._closed = False
 
@@ -277,18 +291,26 @@ class RpcClient:
             pass
         finally:
             self._writer = None
+            # Deferred frames must not survive into a reconnected writer
+            # (replaying a stale PushTask double-executes the task).
+            self.discard_deferred()
             err = RpcConnectionError(f"connection to {self.address} lost")
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(err)
             self._pending.clear()
 
-    async def send_request(self, method: str, payload: Any = None) -> asyncio.Future:
+    async def send_request(self, method: str, payload: Any = None,
+                           defer: bool = False) -> asyncio.Future:
         """Write the request frame now; return the future for the reply.
 
         Callers needing strict send ordering (e.g. per-actor task queues)
         await this sequentially and await the reply futures separately, so
         ordering and pipelining compose.
+
+        ``defer=True`` queues the frame in the client outbox instead of
+        writing; a later :meth:`flush_deferred` ships every queued frame
+        in one transport write (one syscall for a pipeline burst).
         """
         if self._chaos.should_fail(method):
             raise RpcConnectionError(f"[chaos] injected failure for {method}")
@@ -301,14 +323,53 @@ class RpcClient:
         fut.add_done_callback(
             lambda _f, mid=msg_id: self._pending.pop(mid, None))
         frame = _encode_frame((_REQ, msg_id, method, payload))
-        async with self._write_lock:
-            writer = self._writer
-            if writer is None:
-                raise RpcConnectionError(
-                    f"connection to {self.address} lost")
-            writer.write(frame)
-            await writer.drain()
+        if defer:
+            self._outbox.append((frame, fut))
+            return fut
+        await self._write_frame(frame)
         return fut
+
+    async def _write_frame(self, frame: bytes):
+        """Write with flow control: the common case (transport buffer
+        under the threshold) is a plain non-awaiting write; only a
+        backed-up transport pays the drain await (and its lock)."""
+        writer = self._writer
+        if writer is None:
+            raise RpcConnectionError(f"connection to {self.address} lost")
+        writer.write(frame)
+        if writer.transport.get_write_buffer_size() > _DRAIN_THRESHOLD:
+            async with self._write_lock:
+                writer = self._writer
+                if writer is None:
+                    raise RpcConnectionError(
+                        f"connection to {self.address} lost")
+                await writer.drain()
+
+    async def flush_deferred(self):
+        """Ship all defer-queued frames in a single transport write."""
+        if not self._outbox:
+            return
+        entries, self._outbox = self._outbox, []
+        try:
+            await self._write_frame(entries[0][0] if len(entries) == 1
+                                    else b"".join(f for f, _ in entries))
+        except BaseException:
+            self._fail_entries(entries)
+            raise
+
+    def discard_deferred(self):
+        """Drop never-shipped deferred frames, failing their futures —
+        replaying them on a later (re)connection would double-execute
+        tasks that the caller already rerouted elsewhere."""
+        entries, self._outbox = self._outbox, []
+        self._fail_entries(entries)
+
+    def _fail_entries(self, entries):
+        err = RpcConnectionError(
+            f"request to {self.address} was never sent")
+        for _frame, fut in entries:
+            if not fut.done():
+                fut.set_exception(err)
 
     async def call_async(
         self, method: str, payload: Any = None, timeout: float | None = None
@@ -325,14 +386,7 @@ class RpcClient:
 
     async def oneway_async(self, method: str, payload: Any = None) -> None:
         await self._ensure_connected()
-        frame = _encode_frame((_ONEWAY, -1, method, payload))
-        async with self._write_lock:
-            writer = self._writer
-            if writer is None:
-                raise RpcConnectionError(
-                    f"connection to {self.address} lost")
-            writer.write(frame)
-            await writer.drain()
+        await self._write_frame(_encode_frame((_ONEWAY, -1, method, payload)))
 
     def call(self, method: str, payload: Any = None,
              timeout: float | None = None, retries: int = 0) -> Any:

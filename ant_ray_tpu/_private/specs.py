@@ -47,6 +47,20 @@ class TaskSpec:
     # src/ray/common/scheduling/label_selector.h)
     label_selector: dict | None = None
 
+    def __reduce__(self):
+        # Positional-tuple pickling: the default dataclass path pickles
+        # a 17-key dict whose field-name strings are re-encoded in every
+        # RPC frame (each frame is a fresh dumps with an empty memo) —
+        # measurable at 10k specs/s on the actor-call hot path.
+        return (TaskSpec, (
+            self.task_id, self.function_id, self.function_name,
+            self.args_payload, self.num_returns, self.owner_address,
+            self.resources, self.max_retries, self.retry_exceptions,
+            self.actor_id, self.method_name, self.sequence_no,
+            self.concurrency_group, self.placement_group_id,
+            self.placement_group_bundle_index, self.runtime_env,
+            self.label_selector))
+
 
 @dataclass
 class ActorSpec:

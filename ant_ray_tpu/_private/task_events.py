@@ -29,6 +29,10 @@ _FLUSH_AGE_S = 1.0
 
 current_task = contextvars.ContextVar("art_current_task", default=None)
 
+# Per-process constants, hoisted off the record() hot path.
+_PID = os.getpid()
+_NODE_ID = os.environ.get("ART_NODE_ID", "")
+
 
 class TaskEventBuffer:
     def __init__(self):
@@ -43,8 +47,8 @@ class TaskEventBuffer:
                parent_task_id: str | None = None) -> None:
         entry = {
             "task_id": task_id, "name": name, "event": event,
-            "ts": time.time(), "pid": os.getpid(),
-            "node_id": os.environ.get("ART_NODE_ID", ""),
+            "ts": time.time(), "pid": _PID,
+            "node_id": _NODE_ID,
             "worker": getattr(runtime, "address", ""),
             "actor_id": actor_id,
             "parent_task_id": parent_task_id or current_task.get(),

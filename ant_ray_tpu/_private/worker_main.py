@@ -45,7 +45,9 @@ class TaskExecutor:
 
     def __init__(self, runtime: ClusterRuntime):
         self.runtime = runtime
-        self.queue: "queue.Queue[tuple]" = queue.Queue()
+        # SimpleQueue: C-implemented, ~5x cheaper per put/get than
+        # queue.Queue — this hop is on every task execution.
+        self.queue: "queue.SimpleQueue[tuple]" = queue.SimpleQueue()
         self.actor_instance = None
         self.actor_spec: ActorSpec | None = None
         self._async_loop: asyncio.AbstractEventLoop | None = None
