@@ -48,6 +48,10 @@ class Config:
     # Arena read pins auto-expire after this long if the reader never
     # sends ReadDone (crashed client), so the slot becomes evictable.
     read_pin_ttl_s: float = 120.0
+    # Zero-copy get() pins (arrays deserialized as views into the arena)
+    # live until the consumer GCs the value; this longer expiry only
+    # bounds the damage of a reader that died without ReadDone.
+    zero_copy_pin_ttl_s: float = 3600.0
     # EnsureLocal fails fast after this many seconds with an empty
     # holder list, handing control to lineage reconstruction.
     pull_no_holders_grace_s: float = 2.0
@@ -90,7 +94,7 @@ class Config:
     task_lease_linger_s: float = 0.05
     # In-flight PushTask pipeline depth per leased worker (hides the RPC
     # round trip behind execution of the previous task).
-    task_push_pipeline_depth: int = 4
+    task_push_pipeline_depth: int = 8
     # Max concurrent LeaseWorker requests parked per scheduling key.
     max_pending_lease_requests: int = 8
 

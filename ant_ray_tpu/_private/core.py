@@ -115,6 +115,20 @@ class _ActorSubmitState:
 _EMPTY_ARGS_PAYLOAD = serialization.serialize(((), {})).to_payload()
 
 
+class _ArenaPin:
+    """Owner of one daemon-side arena read pin.  Values deserialized
+    zero-copy from the pinned window hold this object (via
+    serialization._PinnedSlice bases); when the last of them is GC'd the
+    finalizer ships ReadDone, letting the store evict the slot."""
+
+    __slots__ = ("_finalizer", "__weakref__")
+
+    def __init__(self, release):
+        import weakref  # noqa: PLC0415
+
+        self._finalizer = weakref.finalize(self, release)
+
+
 class _BlockedCtx:
     """Blocked-in-get() marker for the node daemon (module-level: this is
     entered on every get(), so it must not define classes or closures)."""
@@ -227,6 +241,11 @@ class ClusterRuntime(CoreRuntime):
 
         self._sched_states: dict[tuple, _SchedKeyState] = {}
         self._actor_states: dict[ActorID, _ActorSubmitState] = {}
+        # Cross-thread submission inbox: app threads append, one
+        # call_soon_threadsafe wakeup drains the burst — a wakeup per
+        # call is an eventfd syscall each, visible at 10k calls/s.
+        self._submit_inbox: deque = deque()
+        self._inbox_scheduled = False  # GIL-atomic flag
         self._actor_meta_cache: dict[ActorID, dict] = {}
         self._pg_bundle_cache: dict = {}  # pg_id -> [node addresses]
         self._renv_cache: dict = {}       # runtime_env -> wire form
@@ -489,35 +508,38 @@ class ClusterRuntime(CoreRuntime):
     def put_serialized(self, ser: serialization.SerializedObject,
                        object_id: ObjectID | None = None) -> ObjectRef:
         oid = object_id or self._next_put_id()
-        payload = ser.to_payload()
         if ser.contained_refs:
             with self._ref_lock:  # nested refs live while the object does
                 self._pin_locked(ser.contained_refs)
                 self._contained_pins.setdefault(oid, []).extend(
                     ser.contained_refs)
-        if len(payload) <= global_config().max_inline_object_size:
-            self.memory.put(oid, "inline", payload)
+        nbytes = ser.payload_nbytes()
+        if nbytes <= global_config().max_inline_object_size:
+            self.memory.put(oid, "inline", ser.to_payload())
         else:
-            self._write_plasma(oid, payload)
-            self.memory.put(oid, "plasma", len(payload))
+            self._write_plasma(oid, ser)
+            self.memory.put(oid, "plasma", nbytes)
         return ObjectRef(oid, owner_address=self.address)
 
     def put(self, value: Any) -> ObjectRef:
         return self.put_serialized(serialization.serialize(value))
 
-    def _write_plasma(self, oid: ObjectID, payload: bytes):
-        """Zero-copy produce: grant a write window in the node's arena,
-        write, seal (plasma create→seal; falls back to a tmp file when
-        the native arena is unavailable)."""
+    def _write_plasma(self, oid: ObjectID,
+                      ser: serialization.SerializedObject):
+        """Zero-copy produce: grant a write window in the node's arena
+        and serialize straight into shared memory — the value's buffers
+        are copied exactly once end-to-end (plasma create→seal; falls
+        back to a tmp file when the native arena is unavailable)."""
+        size = ser.payload_nbytes()
         deadline = time.monotonic() + 60
         while True:
             grant = self._node.call("CreateBuffer",
-                                    {"object_id": oid, "size": len(payload)},
+                                    {"object_id": oid, "size": size},
                                     timeout=60)
             if grant.get("offset") is not None:
                 view = self._arena_client.view(grant["path"], grant["offset"],
-                                               len(payload))
-                view[:] = payload
+                                               size)
+                ser.write_into(view)
                 self._node.call("SealBuffer", {"object_id": oid}, timeout=60)
                 return
             if grant.get("exists"):
@@ -534,7 +556,7 @@ class ClusterRuntime(CoreRuntime):
         tmp = os.path.join(self.store_dir,
                            f"{oid.hex()}.tmp.{uuid.uuid4().hex[:8]}")
         with open(tmp, "wb") as f:
-            f.write(payload)
+            f.write(ser.to_payload())
         self._node.call("SealObject", {"object_id": oid, "tmp_path": tmp},
                         timeout=60)
 
@@ -556,16 +578,39 @@ class ClusterRuntime(CoreRuntime):
             return "unknown"
         return "ready" if entry[0] != "pending" else "pending"
 
-    def _deserialize_payload(self, payload) -> Any:
-        ser = serialization.SerializedObject.from_payload(payload)
+    def _deserialize_payload(self, payload, pin_owner=None) -> Any:
+        ser = serialization.SerializedObject.from_payload(
+            payload, pin_owner=pin_owner)
         return serialization.deserialize(ser)
 
+    def _make_pin_release(self, oid: ObjectID):
+        """ReadDone sender for a zero-copy get pin; safe from GC/finalizer
+        context on any thread (hops to the io loop)."""
+        node = self._node
+        loop = self._io.loop
+
+        def _release():
+            try:
+                loop.call_soon_threadsafe(
+                    asyncio.ensure_future,
+                    node.oneway_async("ReadDone", {"object_id": oid}))
+            except Exception:  # noqa: BLE001 — interpreter shutdown
+                pass
+
+        return _release
+
     async def _fetch_plasma(self, oid: ObjectID,
-                            timeout: float | None) -> memoryview:
+                            timeout: float | None) -> tuple:
+        """Make the object's payload readable locally.  Returns
+        (buffer, pin_owner): arena hits are ZERO-COPY views into shared
+        memory, pinned at the daemon until the deserialized value is
+        GC'd (ref: plasma-backed read-only arrays — ray.get of a numpy
+        array returns a view over shm, not a copy)."""
         reply = await self._node.call_async(
             "EnsureLocal",
             {"object_id": oid, "timeout": timeout if timeout else 60.0,
-             "fail_fast_after": global_config().pull_no_holders_grace_s},
+             "fail_fast_after": global_config().pull_no_holders_grace_s,
+             "pin_ttl": global_config().zero_copy_pin_ttl_s},
             timeout=-1)
         if reply.get("no_holders"):
             raise _AllCopiesLost(oid)
@@ -573,20 +618,17 @@ class ClusterRuntime(CoreRuntime):
             raise exceptions.GetTimeoutError(
                 f"object {oid.hex()[:12]} not available in time")
         if reply.get("offset") is not None:
-            # The daemon pinned the entry for us; copy out and release.
-            # One copy is deliberate: arena slots are recycled after
-            # eviction, so zero-copy views could not outlive the pin —
-            # deserialization then builds arrays over the owned bytes
-            # without further copies.
-            try:
-                view = self._arena_client.view(
-                    reply["path"], reply["offset"], reply["size"])
-                return memoryview(bytes(view))
-            finally:
-                if reply.get("pinned"):
-                    await self._node.oneway_async(
-                        "ReadDone", {"object_id": oid})
-        return open_object(reply["path"])
+            view = self._arena_client.view(
+                reply["path"], reply["offset"], reply["size"])
+            if reply.get("pinned"):
+                return memoryview(view), _ArenaPin(
+                    self._make_pin_release(oid))
+            # Unpinned arena window (shouldn't happen): copy out for
+            # safety — the slot could be recycled under us.
+            return memoryview(bytes(view)), None
+        # File-per-object fallback: the mmap stays valid after unlink
+        # (POSIX), so plain zero-copy views are already safe.
+        return open_object(reply["path"]), None
 
     async def _get_one(self, ref: ObjectRef, timeout: float | None):
         """Resolve one ref to (kind, data): kind ∈ value|error.
@@ -621,7 +663,8 @@ class ClusterRuntime(CoreRuntime):
                         "this object")
             if kind == "plasma":
                 try:
-                    view = await self._fetch_plasma(oid, remaining)
+                    view, pin_owner = await self._fetch_plasma(
+                        oid, remaining)
                 except _AllCopiesLost:
                     if not await self._maybe_reconstruct(ref, remaining):
                         raise exceptions.ObjectLostError(
@@ -633,7 +676,8 @@ class ClusterRuntime(CoreRuntime):
                             f"get() timed out on {oid.hex()[:12]} during "
                             "reconstruction") from None
                     continue  # re-resolve: replay may have stored an error
-                return ("value", self._deserialize_payload(view))
+                return ("value",
+                        self._deserialize_payload(view, pin_owner))
             if kind == "inline":
                 return ("value", self._deserialize_payload(value))
             if kind == "error":
@@ -736,8 +780,7 @@ class ClusterRuntime(CoreRuntime):
 
             task_events.record(task_id.hex(), spec.function_name,
                                "submitted")
-        self._io.loop.call_soon_threadsafe(
-            self._enqueue_task, spec, pinned, 0)
+        self._post_submit(self._enqueue_task, spec, pinned, 0)
         if streaming:
             from ant_ray_tpu.object_ref import ObjectRefGenerator  # noqa: PLC0415
 
@@ -752,11 +795,10 @@ class ClusterRuntime(CoreRuntime):
         if not args and not kwargs:
             return _EMPTY_ARGS_PAYLOAD, []
         ser = serialization.serialize((args, kwargs))
-        payload = ser.to_payload()
-        if len(payload) <= global_config().max_inline_object_size:
+        if ser.payload_nbytes() <= global_config().max_inline_object_size:
             if ser.contained_refs:
                 self._pin(ser.contained_refs)
-            return payload, list(ser.contained_refs)
+            return ser.to_payload(), list(ser.contained_refs)
         # put_serialized() pins the contained refs for the plasma object's
         # lifetime; the task pins only the promoted object itself.
         args_ref = self.put_serialized(ser)
@@ -780,6 +822,26 @@ class ClusterRuntime(CoreRuntime):
                               "overwrite": False}, retries=3))
             self._renv_cache[cache_key] = wire
         return wire
+
+    def _post_submit(self, fn, *args) -> None:
+        """Run fn(*args) on the io loop, coalescing wakeups across a
+        burst of submissions from app threads.  The flag is cleared
+        before draining, so an append racing the drain at worst costs a
+        redundant (harmless) wakeup, never a lost one."""
+        self._submit_inbox.append((fn, args))
+        if not self._inbox_scheduled:
+            self._inbox_scheduled = True
+            self._io.loop.call_soon_threadsafe(self._drain_submit_inbox)
+
+    def _drain_submit_inbox(self) -> None:
+        self._inbox_scheduled = False
+        inbox = self._submit_inbox
+        while inbox:
+            fn, args = inbox.popleft()
+            try:
+                fn(*args)
+            except Exception:  # noqa: BLE001 — never kill the drainer
+                logger.exception("submission handling failed")
 
     # ----------------------------------------- scheduling-key submission
     # (ref: NormalTaskSubmitter, task_submission/normal_task_submitter.cc:185
@@ -1534,28 +1596,43 @@ class ClusterRuntime(CoreRuntime):
             task_events.record(task_id.hex(), spec.function_name,
                                "submitted", actor_id=actor_id.hex())
 
-        def _enqueue():
-            state = self._actor_states.get(actor_id)
-            if state is None:
-                state = _ActorSubmitState(actor_id=actor_id)
-                self._actor_states[actor_id] = state
-            spec.sequence_no = state.next_seq
-            state.next_seq += 1
-            state.queue.append((spec, pinned, 0))
-            if not state.sender_running:
-                state.sender_running = True
-                asyncio.ensure_future(self._actor_sender(state))
-
-        self._io.loop.call_soon_threadsafe(_enqueue)
+        self._post_submit(self._enqueue_actor_task, actor_id, spec, pinned)
         if streaming:
             from ant_ray_tpu.object_ref import ObjectRefGenerator  # noqa: PLC0415
 
             return ObjectRefGenerator(task_id, self)
         return return_refs[0] if num_returns == 1 else return_refs
 
+    def _enqueue_actor_task(self, actor_id, spec, pinned) -> None:
+        """Queue an actor call in submission order (io-loop only)."""
+        state = self._actor_states.get(actor_id)
+        if state is None:
+            state = _ActorSubmitState(actor_id=actor_id)
+            self._actor_states[actor_id] = state
+        spec.sequence_no = state.next_seq
+        state.next_seq += 1
+        state.queue.append((spec, pinned, 0))
+        if not state.sender_running:
+            state.sender_running = True
+            asyncio.ensure_future(self._actor_sender(state))
+
+    @staticmethod
+    async def _safe_flush(client):
+        """Flush deferred frames; connection errors surface through the
+        failed futures' done-callbacks (retry path), not here."""
+        if client is None:
+            return
+        try:
+            await client.flush_deferred()
+        except (RpcConnectionError, OSError):
+            pass
+
     async def _actor_sender(self, state: _ActorSubmitState):
-        """Drains the per-actor queue in order; pipelined pushes with
-        in-order sends (ref: SequentialActorSubmitQueue)."""
+        """Drains the per-actor queue in order; pipelined deferred sends
+        coalesce each burst into one transport write, flushed whenever
+        the queue empties, the target changes, or the sender suspends
+        (ref: SequentialActorSubmitQueue)."""
+        client = None
         try:
             while state.queue:
                 spec, pinned, attempt = state.queue.popleft()
@@ -1565,6 +1642,8 @@ class ClusterRuntime(CoreRuntime):
                     self._unpin(pinned)
                     continue
                 if not state.address:
+                    # About to suspend on the GCS — ship what we have.
+                    await self._safe_flush(client)
                     info = await self._gcs.call_async("WaitActorAlive", {
                         "actor_id": state.actor_id, "timeout": 120.0,
                     }, timeout=-1)
@@ -1578,9 +1657,13 @@ class ClusterRuntime(CoreRuntime):
                         self._unpin(pinned)
                         continue
                     state.address = info["address"]
-                client = self._clients.get(state.address)
+                next_client = self._clients.get(state.address)
+                if next_client is not client:
+                    await self._safe_flush(client)  # old target first
+                    client = next_client
                 try:
-                    fut = await client.send_request("PushTask", spec)
+                    fut = await client.send_request("PushTask", spec,
+                                                    defer=True)
                 except RpcConnectionError:
                     await self._on_actor_connection_loss(
                         state, spec, pinned, attempt)
@@ -1590,7 +1673,10 @@ class ClusterRuntime(CoreRuntime):
                 fut.add_done_callback(
                     lambda f, s=state, sp=spec, p=pinned, a=attempt:
                     self._on_actor_reply(s, sp, p, a, f))
+                if not state.queue:
+                    await self._safe_flush(client)
         finally:
+            await self._safe_flush(client)
             state.sender_running = False
             if state.queue:  # raced with a new enqueue
                 state.sender_running = True

@@ -1045,7 +1045,17 @@ class GcsServer:
 
     async def _schedule_placement_group(self, record):
         bundles = record["bundles"]
-        for _attempt in range(120):
+        deadline = time.monotonic() + 30.0
+        while True:
+            if time.monotonic() > deadline:
+                # With a live autoscaler, provisioning (a GKE node pool
+                # resize can take minutes) extends the wait — the gang
+                # demand recorded below keeps driving it.
+                if self._has_live_autoscaler():
+                    deadline = time.monotonic() + \
+                        global_config().infeasible_wait_s
+                else:
+                    break
             if record["state"] == "REMOVED":
                 return
             plan = self._plan_bundles(
@@ -1100,12 +1110,17 @@ class GcsServer:
                     return  # removal handler already dropped the store row
                 self._save_pg(record)  # keep the store in sync w/ rollback
             else:
+                # Unplaceable: surface the whole gang to the autoscaler
+                # (a slice PG on an empty cluster is THE scale-up
+                # trigger; without this the 120 retries starve silently).
+                self._record_gang_demand(record)
                 # Distinguish "busy now" from "never possible".
                 totals = {n.node_id: dict(n.total_resources)
                           for n in self._nodes.values() if n.alive}
                 feasible_nodes = len(totals)
                 if record["strategy"] == "STRICT_SPREAD" and \
-                        len(bundles) > feasible_nodes:
+                        len(bundles) > feasible_nodes and \
+                        not self._has_live_autoscaler():
                     record["state"] = "FAILED"
                     record["reason"] = (
                         f"STRICT_SPREAD needs {len(bundles)} nodes, "
@@ -1221,6 +1236,38 @@ class GcsServer:
             entry["count"] += 1
             entry["last_seen"] = now
 
+    def _record_gang_demand(self, record) -> None:
+        """An unplaceable placement group is a GANG demand: the
+        autoscaler must provision a node set satisfying every bundle
+        atomically (a whole TPU slice for slice PGs), not one bundle's
+        worth of capacity (ref: gang resource requests in
+        src/ray/gcs/gcs_autoscaler_state_manager.h — the cluster
+        resource state reports pending gangs to the autoscaler)."""
+        selectors = record.get("bundle_selectors") or \
+            [{} for _ in record["bundles"]]
+        key = "gang:" + json.dumps(
+            [[sorted(b.items()) for b in record["bundles"]],
+             [sorted((s or {}).items()) for s in selectors],
+             record["strategy"], record.get("same_label")])
+        now = time.monotonic()
+        entry = self._demands.get(key)
+        if entry is None:
+            if len(self._demands) >= 256:
+                self._prune_demands(now)
+            if len(self._demands) >= 512:
+                oldest = min(self._demands,
+                             key=lambda k: self._demands[k]["last_seen"])
+                del self._demands[oldest]
+            self._demands[key] = {
+                "bundles": [dict(b) for b in record["bundles"]],
+                "bundle_selectors": [dict(s or {}) for s in selectors],
+                "strategy": record["strategy"],
+                "same_label": record.get("same_label"),
+                "count": 1, "first_seen": now, "last_seen": now}
+        else:
+            entry["count"] += 1
+            entry["last_seen"] = now
+
     def _prune_demands(self, now: float) -> None:
         for key in [k for k, e in self._demands.items()
                     if now - e["last_seen"] > self._DEMAND_TTL_S]:
@@ -1229,12 +1276,21 @@ class GcsServer:
     async def _resource_demands(self, _payload):
         now = time.monotonic()
         self._prune_demands(now)
-        return [{"resources": e["resources"],
-                 "label_selector": e["label_selector"],
-                 "count": e["count"],
-                 "age_s": now - e["first_seen"],
-                 "idle_s": now - e["last_seen"]}
-                for e in self._demands.values()]
+        out = []
+        for e in self._demands.values():
+            common = {"count": e["count"],
+                      "age_s": now - e["first_seen"],
+                      "idle_s": now - e["last_seen"]}
+            if "bundles" in e:
+                out.append({"bundles": e["bundles"],
+                            "bundle_selectors": e["bundle_selectors"],
+                            "strategy": e["strategy"],
+                            "same_label": e["same_label"], **common})
+            else:
+                out.append({"resources": e["resources"],
+                            "label_selector": e["label_selector"],
+                            **common})
+        return out
 
     async def _autoscaler_heartbeat(self, _payload):
         self._autoscaler_seen = time.monotonic()

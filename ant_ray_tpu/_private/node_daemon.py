@@ -916,17 +916,22 @@ class NodeManager:
             "object_id": object_id, "node_id": self.node_id}, timeout=10)
         return True
 
-    def _locate_pinned(self, object_id: ObjectID) -> dict | None:
+    def _locate_pinned(self, object_id: ObjectID,
+                       ttl: float | None = None) -> dict | None:
         """Locate for a reader, pinning arena entries until the client's
         ReadDone — eviction reuses arena slots, so an unpinned window
         could be recycled mid-copy.  Each pin carries a lease so a
         reader that dies before ReadDone can't wedge the slot forever
-        (the heartbeat loop reaps expired leases)."""
+        (the heartbeat loop reaps expired leases).  Zero-copy readers
+        pass a longer ``ttl`` since they hold the window for the
+        lifetime of the deserialized value, not just a memcpy."""
         located = self.store.locate(object_id)
         if located is not None and located["offset"] is not None:
+            cfg = global_config()
+            lease = min(max(ttl or 0.0, cfg.read_pin_ttl_s), 7200.0)
             self.store.pin(object_id)
             self._pin_leases.setdefault(object_id, []).append(
-                time.monotonic() + global_config().read_pin_ttl_s)
+                time.monotonic() + lease)
             located["pinned"] = True
         return located
 
@@ -968,8 +973,9 @@ class NodeManager:
         # lineage reconstruction instead of burning the full timeout
         # (ref: ObjectRecoveryManager, object_recovery_manager.h:98).
         fail_fast_after = payload.get("fail_fast_after")
+        pin_ttl = payload.get("pin_ttl")
         no_holders_since: float | None = None
-        located = self._locate_pinned(object_id)
+        located = self._locate_pinned(object_id, pin_ttl)
         if located is not None:
             return located
         gcs = self._clients.get(self._gcs_address)
@@ -977,7 +983,7 @@ class NodeManager:
         while time.monotonic() < deadline:
             # A colocated producer (or a concurrent EnsureLocal) may have
             # sealed the object since the last iteration.
-            located = self._locate_pinned(object_id)
+            located = self._locate_pinned(object_id, pin_ttl)
             if located is not None:
                 return located
             holders: list[NodeInfo] = await gcs.call_async(
@@ -989,7 +995,7 @@ class NodeManager:
                     if no_holders_since is None:
                         no_holders_since = now
                     elif now - no_holders_since >= fail_fast_after:
-                        located = self._locate_pinned(object_id)
+                        located = self._locate_pinned(object_id, pin_ttl)
                         return located if located is not None else {
                             "no_holders": True}
             else:
@@ -998,7 +1004,7 @@ class NodeManager:
                 try:
                     remote = self._clients.get(holder.address)
                     await self._pull_from(remote, object_id, chunk)
-                    located = self._locate_pinned(object_id)
+                    located = self._locate_pinned(object_id, pin_ttl)
                     if located is not None:
                         await gcs.call_async("ObjectLocationAdd", {
                             "object_id": object_id,

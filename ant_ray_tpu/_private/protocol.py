@@ -145,6 +145,11 @@ class RpcServer:
         self._host = host
         self._port = port
         self._routes: dict[str, Callable[[Any], Awaitable[Any]]] = {}
+        # Sync handlers returning a value or a Future: dispatched without
+        # creating a coroutine/Task per request — the hot-path shape for
+        # task execution (handler enqueues to an executor and returns its
+        # reply future).
+        self._fast_routes: dict[str, Callable[[Any], Any]] = {}
         self._server: asyncio.AbstractServer | None = None
         self._io = IoThread.get()
         self.address: str = ""
@@ -154,6 +159,10 @@ class RpcServer:
 
     def routes(self, handlers: dict[str, Callable]):
         self._routes.update(handlers)
+
+    def fast_route(self, method: str, handler: Callable[[Any], Any]):
+        """Register a SYNC handler (may return an asyncio.Future)."""
+        self._fast_routes[method] = handler
 
     def start(self) -> str:
         self._io.run_coro(self._start())
@@ -178,12 +187,70 @@ class RpcServer:
                     kind, msg_id, method, payload = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
+                fast = self._fast_routes.get(method)
+                if fast is not None:
+                    self._dispatch_fast(writer, write_lock, kind, msg_id,
+                                        method, payload, fast)
+                    continue
                 _spawn(
                     self._dispatch(
                         writer, write_lock, kind, msg_id, method, payload)
                 )
         finally:
             writer.close()
+
+    def _dispatch_fast(self, writer, write_lock, kind, msg_id, method,
+                       payload, handler):
+        """Task-free dispatch for sync handlers: the reply is written by
+        a future callback (or inline for immediate values)."""
+        try:
+            result = handler(payload)
+        except Exception as e:  # noqa: BLE001 — forwarded to caller
+            if kind != _ONEWAY:
+                self._write_reply(writer, write_lock,
+                                  (_ERR, msg_id, method, e))
+            else:
+                logger.exception("oneway fast handler %s failed", method)
+            return
+        if isinstance(result, asyncio.Future):
+            if kind == _ONEWAY:
+                return
+            result.add_done_callback(
+                lambda f: self._write_reply_of(writer, write_lock,
+                                               msg_id, method, f))
+            return
+        if kind != _ONEWAY:
+            self._write_reply(writer, write_lock,
+                              (_REP, msg_id, method, result))
+
+    def _write_reply_of(self, writer, write_lock, msg_id, method,
+                        fut: asyncio.Future):
+        try:
+            msg = (_REP, msg_id, method, fut.result())
+        except Exception as e:  # noqa: BLE001 — forwarded to caller
+            msg = (_ERR, msg_id, method, e)
+        self._write_reply(writer, write_lock, msg)
+
+    def _write_reply(self, writer, write_lock, msg):
+        try:
+            frame = _encode_frame(msg)
+        except Exception:  # noqa: BLE001 — unpicklable error payload
+            frame = _encode_frame((_ERR, msg[1], msg[2],
+                                   RpcError(repr(msg[3]))))
+        try:
+            writer.write(frame)
+            if writer.transport.get_write_buffer_size() > _DRAIN_THRESHOLD:
+                _spawn(self._drain_locked(writer, write_lock))
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+
+    @staticmethod
+    async def _drain_locked(writer, write_lock):
+        try:
+            async with write_lock:
+                await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
 
     async def _dispatch(self, writer, write_lock, kind, msg_id, method,
                         payload):
@@ -253,6 +320,12 @@ class RpcClient:
         self._closed = False
 
     async def _ensure_connected(self):
+        # Lock-free fast path: on an established connection this runs on
+        # every request, and even an uncontended Lock acquire is
+        # measurable at 10k calls/s.
+        writer = self._writer
+        if writer is not None and not writer.is_closing():
+            return
         if self._conn_lock is None:
             self._conn_lock = asyncio.Lock()
         if self._write_lock is None:

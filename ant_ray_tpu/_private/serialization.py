@@ -48,36 +48,95 @@ class SerializedObject:
     inband: bytes          # pickle-5 stream (buffers externalized)
     buffers: list[bytes | memoryview]
     contained_refs: list   # ObjectRefs found inside the value
+    _header: bytes | None = None
 
     def total_bytes(self) -> int:
         return len(self.inband) + sum(len(b) for b in self.buffers)
 
+    def _header_bytes(self) -> bytes:
+        if self._header is None:
+            self._header = pickle.dumps(
+                (len(self.inband),
+                 [memoryview(b).nbytes for b in self.buffers]),
+                protocol=5)
+        return self._header
+
+    def payload_nbytes(self) -> int:
+        """Exact wire size, without materializing the payload — lets the
+        put path reserve an arena window and write straight into shared
+        memory (one copy end-to-end instead of concat + copy)."""
+        return (4 + len(self._header_bytes()) + len(self.inband)
+                + sum(memoryview(b).nbytes for b in self.buffers))
+
     def to_payload(self) -> bytes:
         """Flatten to one contiguous byte string (header + inband + buffers)."""
-        header = pickle.dumps(
-            (len(self.inband), [len(b) for b in self.buffers]), protocol=5
-        )
         out = io.BytesIO()
-        out.write(len(header).to_bytes(4, "big"))
-        out.write(header)
-        out.write(self.inband)
-        for b in self.buffers:
-            out.write(b)
+        self._write_parts(out.write)
         return out.getvalue()
 
+    def write_into(self, view: memoryview) -> None:
+        """Write the payload directly into a writable buffer (an arena
+        write grant) — the zero-intermediate-copy produce path."""
+        pos = 0
+
+        def sink(part):
+            nonlocal pos
+            n = memoryview(part).nbytes
+            view[pos:pos + n] = part
+            pos += n
+
+        self._write_parts(sink)
+
+    def _write_parts(self, write) -> None:
+        header = self._header_bytes()
+        write(len(header).to_bytes(4, "big"))
+        write(header)
+        write(self.inband)
+        for b in self.buffers:
+            write(b)
+
     @classmethod
-    def from_payload(cls, payload: bytes | memoryview) -> "SerializedObject":
+    def from_payload(cls, payload: bytes | memoryview,
+                     pin_owner=None) -> "SerializedObject":
+        """Parse the wire form zero-copy: inband and buffers are
+        memoryview slices of ``payload``.  When ``pin_owner`` is given
+        (a zero-copy get from a pinned arena slot), each buffer slice is
+        wrapped so deserialized arrays keep the pin alive for as long as
+        they reference the shared memory (see _PinnedSlice)."""
         payload = memoryview(payload)
         hlen = int.from_bytes(payload[:4], "big")
         inband_len, buf_lens = pickle.loads(payload[4:4 + hlen])
         off = 4 + hlen
-        inband = bytes(payload[off:off + inband_len])
+        inband = payload[off:off + inband_len]
         off += inband_len
         buffers = []
         for blen in buf_lens:
-            buffers.append(payload[off:off + blen])
+            mv = payload[off:off + blen]
+            buffers.append(mv if pin_owner is None
+                           else _PinnedSlice(mv, pin_owner))
             off += blen
         return cls(inband=inband, buffers=buffers, contained_refs=[])
+
+
+class _PinnedSlice:
+    """Buffer-protocol wrapper (PEP 688) tying a shared-memory window to
+    its arena read pin: a numpy array deserialized zero-copy keeps this
+    object as its base, which keeps the pin owner alive, which defers the
+    daemon-side ReadDone until the array is garbage collected — so the
+    store can never recycle the slot under live readers.  Read-only, like
+    the reference's plasma-backed arrays."""
+
+    __slots__ = ("_mv", "_owner")
+
+    def __init__(self, mv: memoryview, owner):
+        self._mv = mv.toreadonly()
+        self._owner = owner
+
+    def __buffer__(self, flags):
+        return self._mv
+
+    def __len__(self):
+        return self._mv.nbytes
 
 
 _thread_local = threading.local()

@@ -37,12 +37,18 @@ def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true",
                         help="10x smaller workloads")
+    parser.add_argument("--json-out", default="",
+                        help="also write results to this JSON file "
+                             "(committed as BENCH_control.json)")
     args = parser.parse_args()
     scale = 0.1 if args.quick else 1.0
 
     import ant_ray_tpu as art
 
-    art.init(num_cpus=4)
+    # Autodetected sizing, like the reference's ray_perf (ray.init()
+    # detects cores; provisioning more workers than cores only adds
+    # scheduler pressure on small rigs).
+    art.init()
     results = []
 
     def emit(metric: str, value: float, unit: str):
@@ -99,12 +105,16 @@ def main() -> None:
 
     emit("small_put_get_per_s", timeit(put_get, int(500 * scale)), "ops/s")
 
-    # ---- large object bandwidth (ray_perf: "put gigabytes")
-    blob = np.random.default_rng(0).bytes(64 << 20)  # 64 MiB
+    # ---- large object bandwidth (ray_perf: "put gigabytes" — numpy
+    # payloads, matching python/ray/_private/ray_perf.py's array puts;
+    # get() of the array is a zero-copy view into the node arena)
+    blob = np.random.default_rng(0).integers(
+        0, 127, size=64 << 20, dtype=np.int8)  # 64 MiB
 
     def put_gb(n):
         for _ in range(n):
-            art.get(art.put(blob))
+            got = art.get(art.put(blob))
+            assert got.nbytes == blob.nbytes
 
     n_big = max(2, int(8 * scale))
     for _ in range(1):
@@ -125,6 +135,13 @@ def main() -> None:
     art.shutdown()
     print(json.dumps({"metric": "microbench_summary",
                       "workloads": len(results)}))
+    if args.json_out:
+        import platform
+
+        with open(args.json_out, "w") as f:
+            json.dump({"results": results,
+                       "cpu_count": os.cpu_count(),
+                       "platform": platform.platform()}, f, indent=1)
 
 
 if __name__ == "__main__":
