@@ -49,9 +49,10 @@ class Config:
     # sends ReadDone (crashed client), so the slot becomes evictable.
     read_pin_ttl_s: float = 120.0
     # Zero-copy get() pins (arrays deserialized as views into the arena)
-    # live until the consumer GCs the value; this longer expiry only
-    # bounds the damage of a reader that died without ReadDone.
-    zero_copy_pin_ttl_s: float = 3600.0
+    # live until the consumer GCs the value; clients renew the lease at
+    # TTL/3 (RenewPin heartbeat) while the value is referenced, so this
+    # only bounds how long a *crashed* reader can wedge a slot.
+    zero_copy_pin_ttl_s: float = 120.0
     # EnsureLocal fails fast after this many seconds with an empty
     # holder list, handing control to lineage reconstruction.
     pull_no_holders_grace_s: float = 2.0

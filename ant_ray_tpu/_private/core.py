@@ -19,6 +19,7 @@ import os
 import threading
 import time
 import uuid
+import weakref
 from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Sequence
@@ -118,14 +119,17 @@ _EMPTY_ARGS_PAYLOAD = serialization.serialize(((), {})).to_payload()
 class _ArenaPin:
     """Owner of one daemon-side arena read pin.  Values deserialized
     zero-copy from the pinned window hold this object (via
-    serialization._PinnedSlice bases); when the last of them is GC'd the
-    finalizer ships ReadDone, letting the store evict the slot."""
+    serialization pinned-buffer bases); when the last of them is GC'd
+    the finalizer ships ReadDone, letting the store evict the slot.
+    While alive it sits in the runtime's live-pin set, whose renewal
+    loop heartbeats RenewPin so a long-held value (e.g. model weights
+    for a whole run) never outlives its daemon-side lease."""
 
-    __slots__ = ("_finalizer", "__weakref__")
+    __slots__ = ("_finalizer", "oid", "token", "__weakref__")
 
-    def __init__(self, release):
-        import weakref  # noqa: PLC0415
-
+    def __init__(self, release, oid, token):
+        self.oid = oid
+        self.token = token
         self._finalizer = weakref.finalize(self, release)
 
 
@@ -250,6 +254,10 @@ class ClusterRuntime(CoreRuntime):
         self._pg_bundle_cache: dict = {}  # pg_id -> [node addresses]
         self._renv_cache: dict = {}       # runtime_env -> wire form
         self._arena_client = ArenaClient()
+        # Live zero-copy pins (weak: pins die when their values are
+        # GC'd); the renewal loop heartbeats their daemon leases.
+        self._live_pins = weakref.WeakSet()
+        self._pin_renewer_started = False
         self._blocked_depth = 0
         self._blocked_lock = threading.Lock()
         self._shutdown = False
@@ -583,7 +591,7 @@ class ClusterRuntime(CoreRuntime):
             payload, pin_owner=pin_owner)
         return serialization.deserialize(ser)
 
-    def _make_pin_release(self, oid: ObjectID):
+    def _make_pin_release(self, oid: ObjectID, token):
         """ReadDone sender for a zero-copy get pin; safe from GC/finalizer
         context on any thread (hops to the io loop)."""
         node = self._node
@@ -593,11 +601,45 @@ class ClusterRuntime(CoreRuntime):
             try:
                 loop.call_soon_threadsafe(
                     asyncio.ensure_future,
-                    node.oneway_async("ReadDone", {"object_id": oid}))
+                    node.oneway_async("ReadDone", {"object_id": oid,
+                                                   "pin_token": token}))
             except Exception:  # noqa: BLE001 — interpreter shutdown
                 pass
 
         return _release
+
+    async def _pin_renew_loop(self):
+        """Heartbeat renewing the daemon-side leases of all live
+        zero-copy pins in one batched RPC.  The lease TTL only bounds
+        how long a *crashed* reader can wedge an arena slot; live
+        readers renew at TTL/3 so a deserialized array held for hours
+        stays backed."""
+        while not self._shutdown:
+            ttl = global_config().zero_copy_pin_ttl_s
+            await asyncio.sleep(max(0.05, ttl / 3.0))
+            pins = [(p.oid, p.token) for p in list(self._live_pins)]
+            if not pins:
+                continue
+            try:
+                reply = await self._node.call_async(
+                    "RenewPins", {"pins": pins, "ttl": ttl}, timeout=30)
+            except Exception:  # noqa: BLE001 — daemon restarting
+                continue
+            live = {(p.oid, p.token) for p in list(self._live_pins)}
+            for oid, token in reply.get("gone", ()):
+                if (oid, token) not in live:
+                    continue  # value was GC'd mid-heartbeat: benign race
+                # The daemon reaped a pin we still hold a value for —
+                # its bytes may be recycled under the live view.  This
+                # only happens when this process stalls for >TTL (GIL
+                # hog, SIGSTOP, swap); make it loud, it's a correctness
+                # hazard the user must know about.
+                logger.error(
+                    "zero-copy pin on %s (token %s) expired at the node "
+                    "daemon while the deserialized value is still live; "
+                    "its memory may be recycled — copy values you hold "
+                    "across long stalls, or raise "
+                    "ART_ZERO_COPY_PIN_TTL_S", oid.hex()[:12], token)
 
     async def _fetch_plasma(self, oid: ObjectID,
                             timeout: float | None) -> tuple:
@@ -621,8 +663,14 @@ class ClusterRuntime(CoreRuntime):
             view = self._arena_client.view(
                 reply["path"], reply["offset"], reply["size"])
             if reply.get("pinned"):
-                return memoryview(view), _ArenaPin(
-                    self._make_pin_release(oid))
+                token = reply.get("pin_token")
+                pin = _ArenaPin(self._make_pin_release(oid, token),
+                                oid, token)
+                self._live_pins.add(pin)
+                if not self._pin_renewer_started:
+                    self._pin_renewer_started = True
+                    asyncio.ensure_future(self._pin_renew_loop())
+                return memoryview(view), pin
             # Unpinned arena window (shouldn't happen): copy out for
             # safety — the slot could be recycled under us.
             return memoryview(bytes(view)), None

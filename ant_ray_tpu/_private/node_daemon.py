@@ -98,9 +98,13 @@ class NodeManager:
             cfg.max_workers_per_node or max(1, int(resources.get("CPU", 1))))
         self._tasks: list = []
         self._stopping = False
-        # object_id -> sorted lease-expiry times, one per outstanding
+        # object_id -> {pin_token: lease_expiry}, one per outstanding
         # arena read pin (see _locate_pinned / _reap_expired_pins).
-        self._pin_leases: dict[ObjectID, list[float]] = {}
+        # Tokens let ReadDone/RenewPin address a specific reader's pin,
+        # so a short-TTL reader finishing can't consume a long-lived
+        # zero-copy reader's lease.
+        self._pin_leases: dict[ObjectID, dict[int, float]] = {}
+        self._next_pin_token = 1
         # terminated-but-unreaped workers (retired for env mismatch)
         self._retired_procs: list[subprocess.Popen] = []
         # job_id -> (allowed_here, expires_at): virtual-cluster fencing
@@ -128,6 +132,7 @@ class NodeManager:
             "LocateObject": self._locate_object,
             "EnsureLocal": self._ensure_local,
             "ReadDone": self._read_done,
+            "RenewPins": self._renew_pins,
             "ReadChunk": self._read_chunk,
             "DeleteObject": self._delete_object,
             "ContainsObject": self._contains_object,
@@ -916,6 +921,15 @@ class NodeManager:
             "object_id": object_id, "node_id": self.node_id}, timeout=10)
         return True
 
+    # Hard cap on any single pin lease: a misconfigured client can't
+    # wedge an arena slot forever — live readers renew well inside this,
+    # so only crashed readers ever hit it.
+    _MAX_PIN_LEASE_S = 3600.0
+
+    def _pin_lease_s(self, ttl: float | None) -> float:
+        return min(max(ttl or 0.0, global_config().read_pin_ttl_s),
+                   self._MAX_PIN_LEASE_S)
+
     def _locate_pinned(self, object_id: ObjectID,
                        ttl: float | None = None) -> dict | None:
         """Locate for a reader, pinning arena entries until the client's
@@ -924,36 +938,64 @@ class NodeManager:
         reader that dies before ReadDone can't wedge the slot forever
         (the heartbeat loop reaps expired leases).  Zero-copy readers
         pass a longer ``ttl`` since they hold the window for the
-        lifetime of the deserialized value, not just a memcpy."""
+        lifetime of the deserialized value, not just a memcpy, and
+        renew it via RenewPins heartbeats."""
         located = self.store.locate(object_id)
         if located is not None and located["offset"] is not None:
-            cfg = global_config()
-            lease = min(max(ttl or 0.0, cfg.read_pin_ttl_s), 7200.0)
-            self.store.pin(object_id)
-            self._pin_leases.setdefault(object_id, []).append(
-                time.monotonic() + lease)
+            token = self._next_pin_token
+            self._next_pin_token += 1
+            self.store.pin(object_id, token)
+            self._pin_leases.setdefault(object_id, {})[token] = (
+                time.monotonic() + self._pin_lease_s(ttl))
             located["pinned"] = True
+            located["pin_token"] = token
         return located
 
     async def _read_done(self, payload):
         object_id = payload["object_id"]
         leases = self._pin_leases.get(object_id)
-        if leases:
-            leases.pop(0)
+        if not leases:
+            return True
+        token = payload.get("pin_token")
+        if token is None:
+            # Legacy caller without a token: drop the earliest-expiring
+            # lease (best effort).
+            token = min(leases, key=leases.get)
+        if leases.pop(token, None) is not None:
             if not leases:
                 self._pin_leases.pop(object_id, None)
-            self.store.unpin(object_id)
+            self.store.unpin(object_id, token)
         return True
+
+    async def _renew_pins(self, payload):
+        """Batch-extend live readers' pin leases (one client heartbeat
+        renews every pin that client still holds).  Renewal instead of
+        an unbounded TTL keeps the reap loop able to reclaim pins of
+        crashed readers within ~one TTL.  Replies with the (oid, token)
+        pairs that no longer exist so the client can scream — a gone
+        pin under a live value means its bytes may be recycled."""
+        ttl = self._pin_lease_s(payload.get("ttl"))
+        expiry = time.monotonic() + ttl
+        gone = []
+        for oid, token in payload["pins"]:
+            leases = self._pin_leases.get(oid)
+            if leases is None or token not in leases:
+                gone.append((oid, token))
+            else:
+                leases[token] = expiry
+        return {"gone": gone}
 
     def _reap_expired_pins(self):
         now = time.monotonic()
         for object_id in list(self._pin_leases):
             leases = self._pin_leases[object_id]
-            while leases and leases[0] < now:
-                leases.pop(0)
-                self.store.unpin(object_id)
-                logger.warning("read pin on %s expired without ReadDone",
-                               object_id.hex()[:8])
+            for token, expiry in list(leases.items()):
+                if expiry < now:
+                    del leases[token]
+                    self.store.unpin(object_id, token)
+                    logger.warning(
+                        "read pin on %s expired without ReadDone",
+                        object_id.hex()[:8])
             if not leases:
                 self._pin_leases.pop(object_id, None)
 

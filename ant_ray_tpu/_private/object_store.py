@@ -36,10 +36,18 @@ logger = logging.getLogger(__name__)
 class ObjectEntry:
     object_id: ObjectID
     size: int
-    pin_count: int = 0
+    # Read pins, addressed by caller-unique tokens (the daemon's pin-lease
+    # tokens): token-addressing lets an unpin land on exactly the entry
+    # generation it pinned, even after the id was deleted and re-created
+    # (lineage reconstruction re-stores under the same id).
+    pin_tokens: set = field(default_factory=set)
     sealed: bool = False
     offset: int | None = None       # arena payload offset (native mode)
     created_at: float = field(default_factory=time.monotonic)
+
+    @property
+    def pin_count(self) -> int:
+        return len(self.pin_tokens)
 
 
 ARENA_FILENAME = "arena.buf"
@@ -77,6 +85,11 @@ class ObjectStore:
         if spill_dir:
             os.makedirs(spill_dir, exist_ok=True)
         self._entries: "OrderedDict[ObjectID, ObjectEntry]" = OrderedDict()
+        # Deleted-while-pinned payloads: invisible to lookups, bytes kept
+        # allocated until the last read pin drops (see _delete_locked).
+        # A list, not a dict: the same object id can be doomed more than
+        # once (delete → re-create → delete again, each under pins).
+        self._doomed: list[ObjectEntry] = []
         self._lock = threading.RLock()
         self._arena = None
         if use_arena:
@@ -341,9 +354,25 @@ class ObjectStore:
         entry = self._entries.pop(object_id, None)
         if entry is None:
             return
-        self._used -= entry.size
         if notify and self._on_delete is not None and entry.sealed:
             self._on_delete(object_id)
+        if entry.pin_tokens and entry.offset is not None:
+            # Live readers hold views into this arena payload (zero-copy
+            # gets, in-flight transfer reads).  Tombstone: the entry is
+            # gone for lookups (the location record above is retracted)
+            # but the range stays allocated until the last unpin —
+            # freeing now would let a new put recycle it under a live
+            # read-only numpy view (ref: plasma defers deletion of
+            # objects with nonzero client map counts).  File-backed
+            # entries need no tombstone: POSIX keeps mmaps valid after
+            # unlink, and unlinking immediately avoids clobbering the
+            # file of a later re-create under the same id.
+            self._doomed.append(entry)
+            return
+        self._free_payload_locked(entry)
+
+    def _free_payload_locked(self, entry: ObjectEntry) -> None:
+        self._used -= entry.size
         if entry.offset is not None:
             try:
                 self._arena.free(entry.offset)
@@ -351,7 +380,7 @@ class ObjectStore:
                 pass
             return
         try:
-            os.unlink(self.path_of(object_id))
+            os.unlink(self.path_of(entry.object_id))
         except FileNotFoundError:
             pass
 
@@ -373,18 +402,37 @@ class ObjectStore:
             if object_id in self._entries:
                 self._entries.move_to_end(object_id)
 
-    def pin(self, object_id: ObjectID) -> None:
+    def pin(self, object_id: ObjectID, token) -> None:
+        """Pin the current entry for ``object_id`` under a caller-unique
+        ``token`` (the daemon's pin-lease token)."""
         with self._lock:
             entry = self._entries.get(object_id)
             if entry is None:
                 raise ObjectLostError(object_id, "pin on missing object")
-            entry.pin_count += 1
+            entry.pin_tokens.add(token)
 
-    def unpin(self, object_id: ObjectID) -> None:
+    def unpin(self, object_id: ObjectID, token) -> None:
+        """Drop the pin ``token``.  Token-addressed so an unpin after the
+        id was deleted and re-created lands on the doomed generation the
+        reader actually pinned — never on the new entry."""
         with self._lock:
             entry = self._entries.get(object_id)
-            if entry is not None and entry.pin_count > 0:
-                entry.pin_count -= 1
+            if entry is not None and token in entry.pin_tokens:
+                entry.pin_tokens.discard(token)
+                return
+            for i, doomed in enumerate(self._doomed):
+                if token in doomed.pin_tokens:
+                    doomed.pin_tokens.discard(token)
+                    if not doomed.pin_tokens:
+                        del self._doomed[i]
+                        self._free_payload_locked(doomed)
+                    return
+
+    def is_doomed(self, object_id: ObjectID) -> bool:
+        """True while a deleted-but-still-pinned payload lingers
+        (test/introspection hook)."""
+        with self._lock:
+            return any(d.object_id == object_id for d in self._doomed)
 
     def delete(self, object_id: ObjectID, notify: bool = True) -> None:
         """notify=False suppresses the on_delete hook — used for GCS-

@@ -113,18 +113,34 @@ class SerializedObject:
         for blen in buf_lens:
             mv = payload[off:off + blen]
             buffers.append(mv if pin_owner is None
-                           else _PinnedSlice(mv, pin_owner))
+                           else _pin_buffer(mv, pin_owner))
             off += blen
         return cls(inband=inband, buffers=buffers, contained_refs=[])
 
 
+def _pin_buffer(mv: memoryview, owner):
+    """A read-only buffer over ``mv`` whose consumers keep ``owner``
+    (the client-side arena pin) alive: a numpy array deserialized
+    zero-copy keeps it as its base, deferring the daemon-side ReadDone
+    until the array is garbage collected — so the store can never
+    recycle the slot under live readers (ref: plasma-backed read-only
+    arrays).  Prefers the C-level art_native.PinnedBuffer (works on
+    every CPython); falls back to the PEP 688 ``__buffer__`` wrapper on
+    3.12+, and to a safe copy-out where neither is available (CPython
+    < 3.12 can't export the buffer protocol from pure Python)."""
+    from ant_ray_tpu._private.native import load_native  # noqa: PLC0415
+
+    native = load_native()
+    if native is not None:
+        return native.PinnedBuffer(mv.toreadonly(), owner)
+    if sys.version_info >= (3, 12):
+        return _PinnedSlice(mv, owner)
+    return bytes(mv)
+
+
 class _PinnedSlice:
-    """Buffer-protocol wrapper (PEP 688) tying a shared-memory window to
-    its arena read pin: a numpy array deserialized zero-copy keeps this
-    object as its base, which keeps the pin owner alive, which defers the
-    daemon-side ReadDone until the array is garbage collected — so the
-    store can never recycle the slot under live readers.  Read-only, like
-    the reference's plasma-backed arrays."""
+    """Pure-Python fallback for _pin_buffer (PEP 688 ``__buffer__``,
+    honored by CPython 3.12+ only — see _pin_buffer for the dispatch)."""
 
     __slots__ = ("_mv", "_owner")
 

@@ -619,6 +619,94 @@ PyTypeObject ArenaType = {
     PyVarObject_HEAD_INIT(nullptr, 0)
 };
 
+// ---------------------------------------------------------------------------
+// PinnedBuffer: read-only buffer-protocol exporter tying a shared-memory
+// window to an arbitrary owner object.  A numpy array deserialized
+// zero-copy over one of these keeps it as its base, which keeps the
+// owner (the client-side arena pin) alive until the array is GC'd — so
+// the store can never recycle the slot under a live reader.  C-level
+// because pure-Python buffer exporting (PEP 688 __buffer__) only exists
+// on CPython >= 3.12 and this must work everywhere the package claims.
+
+struct PinnedBuffer {
+  PyObject_HEAD
+  Py_buffer view;    // retained view of the source buffer
+  PyObject* owner;   // kept alive while any consumer references us
+  int has_view;
+};
+
+int pinned_tp_init(PyObject* self_obj, PyObject* args, PyObject* kwargs) {
+  PinnedBuffer* self = reinterpret_cast<PinnedBuffer*>(self_obj);
+  PyObject* source;
+  PyObject* owner;
+  static const char* kwlist[] = {"source", "owner", nullptr};
+  if (!PyArg_ParseTupleAndKeywords(args, kwargs, "OO",
+                                   const_cast<char**>(kwlist), &source,
+                                   &owner)) {
+    return -1;
+  }
+  if (self->has_view) {
+    // No re-init: consumers may hold exported buffers over the current
+    // view; releasing it under them would dangle their data pointers.
+    PyErr_SetString(PyExc_ValueError,
+                    "PinnedBuffer cannot be re-initialized");
+    return -1;
+  }
+  if (PyObject_GetBuffer(source, &self->view, PyBUF_SIMPLE) < 0) return -1;
+  self->has_view = 1;
+  Py_INCREF(owner);
+  self->owner = owner;
+  return 0;
+}
+
+int pinned_getbuffer(PyObject* self_obj, Py_buffer* out, int flags) {
+  PinnedBuffer* self = reinterpret_cast<PinnedBuffer*>(self_obj);
+  if (!self->has_view) {
+    PyErr_SetString(PyExc_BufferError, "PinnedBuffer not initialized");
+    return -1;
+  }
+  if ((flags & PyBUF_WRITABLE) == PyBUF_WRITABLE) {
+    PyErr_SetString(PyExc_BufferError, "PinnedBuffer is read-only");
+    return -1;
+  }
+  return PyBuffer_FillInfo(out, self_obj, self->view.buf, self->view.len,
+                           /*readonly=*/1, flags);
+}
+
+Py_ssize_t pinned_length(PyObject* self_obj) {
+  PinnedBuffer* self = reinterpret_cast<PinnedBuffer*>(self_obj);
+  return self->has_view ? self->view.len : 0;
+}
+
+void pinned_dealloc(PyObject* self_obj) {
+  PinnedBuffer* self = reinterpret_cast<PinnedBuffer*>(self_obj);
+  if (self->has_view) PyBuffer_Release(&self->view);
+  Py_XDECREF(self->owner);
+  Py_TYPE(self_obj)->tp_free(self_obj);
+}
+
+PyObject* pinned_get_owner(PyObject* self_obj, void*) {
+  PinnedBuffer* self = reinterpret_cast<PinnedBuffer*>(self_obj);
+  PyObject* owner = self->owner ? self->owner : Py_None;
+  Py_INCREF(owner);
+  return owner;
+}
+
+PyBufferProcs pinned_as_buffer = {pinned_getbuffer, nullptr};
+
+PySequenceMethods pinned_as_sequence = {
+    pinned_length,  // sq_length — len() == byte length, like memoryview
+};
+
+PyGetSetDef pinned_getset[] = {
+    {"owner", reinterpret_cast<getter>(pinned_get_owner), nullptr, nullptr,
+     nullptr},
+    {nullptr, nullptr, nullptr, nullptr, nullptr}};
+
+PyTypeObject PinnedBufferType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
 PyModuleDef art_native_module = {
     PyModuleDef_HEAD_INIT, "art_native",
     "native shared-memory arena for the object store", -1,
@@ -645,6 +733,16 @@ PyMODINIT_FUNC PyInit_art_native(void) {
   ChannelType.tp_methods = channel_methods;
   ChannelType.tp_getset = channel_getset;
   if (PyType_Ready(&ChannelType) < 0) return nullptr;
+  PinnedBufferType.tp_name = "art_native.PinnedBuffer";
+  PinnedBufferType.tp_basicsize = sizeof(PinnedBuffer);
+  PinnedBufferType.tp_flags = Py_TPFLAGS_DEFAULT;
+  PinnedBufferType.tp_new = PyType_GenericNew;
+  PinnedBufferType.tp_init = pinned_tp_init;
+  PinnedBufferType.tp_dealloc = pinned_dealloc;
+  PinnedBufferType.tp_as_buffer = &pinned_as_buffer;
+  PinnedBufferType.tp_as_sequence = &pinned_as_sequence;
+  PinnedBufferType.tp_getset = pinned_getset;
+  if (PyType_Ready(&PinnedBufferType) < 0) return nullptr;
   PyObject* m = PyModule_Create(&art_native_module);
   if (m == nullptr) return nullptr;
   Py_INCREF(&ArenaType);
@@ -653,5 +751,8 @@ PyMODINIT_FUNC PyInit_art_native(void) {
   Py_INCREF(&ChannelType);
   PyModule_AddObject(m, "Channel",
                      reinterpret_cast<PyObject*>(&ChannelType));
+  Py_INCREF(&PinnedBufferType);
+  PyModule_AddObject(m, "PinnedBuffer",
+                     reinterpret_cast<PyObject*>(&PinnedBufferType));
   return m;
 }

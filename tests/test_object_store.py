@@ -93,20 +93,20 @@ def test_store_eviction_lru(store):
 def test_store_pinned_objects_not_evicted(store):
     pinned = ObjectID.from_random()
     store.create(pinned, os.urandom(256 * 1024))
-    store.pin(pinned)
+    store.pin(pinned, token=1)
     for _ in range(6):
         store.create(ObjectID.from_random(), os.urandom(200 * 1024))
     assert store.contains(pinned)
-    store.unpin(pinned)
+    store.unpin(pinned, token=1)
 
 
 def test_store_full_when_all_pinned(store):
     oid = ObjectID.from_random()
     store.create(oid, os.urandom(900 * 1024))
-    store.pin(oid)
+    store.pin(oid, token=1)
     with pytest.raises(ObjectStoreFullError):
         store.create(ObjectID.from_random(), os.urandom(900 * 1024))
-    store.unpin(oid)
+    store.unpin(oid, token=1)
 
 
 def test_read_chunk(store):

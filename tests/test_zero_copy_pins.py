@@ -1,0 +1,225 @@
+"""Zero-copy get() pin-lifetime tests.
+
+Round-3 shipped arena-backed zero-copy reads; these tests pin down the
+hazards that came with them (ref test model: plasma object-pinning and
+reference-count tests, e.g. reference_counter_test.cc):
+
+* owner-driven delete under a live reader must tombstone, not free
+  (the arena range stays allocated until the last unpin);
+* pin leases are renewable, so a deserialized array held far longer
+  than the lease TTL keeps its backing bytes;
+* ReadDone is token-matched — a short-TTL reader finishing must not
+  consume a long-lived zero-copy reader's lease.
+"""
+
+import asyncio
+import gc
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu._private import config as config_mod
+from ant_ray_tpu._private.ids import ObjectID
+from ant_ray_tpu._private.object_store import ArenaClient, ObjectStore
+
+
+# --------------------------------------------------------------- store level
+
+
+def test_delete_under_pin_tombstones(tmp_path):
+    store = ObjectStore(str(tmp_path / "s"), capacity_bytes=1 << 20)
+    oid = ObjectID.from_random()
+    payload = os.urandom(4096)
+    store.create(oid, payload)
+    info = store.locate(oid)
+    store.pin(oid, token=1)
+    used_before = store.used
+    store.delete(oid)
+    # Gone for lookups, but the bytes stay allocated while pinned.
+    assert not store.contains(oid)
+    assert store.locate(oid) is None
+    assert store.is_doomed(oid)
+    assert store.used == used_before
+    if store.uses_arena:
+        view = ArenaClient().view(info["path"], info["offset"], 4096)
+        # Heavy churn must not recycle the doomed range.
+        for _ in range(32):
+            store.create(ObjectID.from_random(), os.urandom(4096))
+        assert bytes(view) == payload
+    used_before_unpin = store.used
+    store.unpin(oid, token=1)
+    assert not store.is_doomed(oid)
+    assert store.used == used_before_unpin - 4096
+    store.destroy()
+
+
+def test_unpin_after_recreate_hits_doomed_generation(tmp_path):
+    """Regression: a reader's unpin arriving after its object was
+    deleted AND re-created under the same id must release the doomed
+    generation it pinned — not the new entry's pin."""
+    store = ObjectStore(str(tmp_path / "s"), capacity_bytes=1 << 20)
+    oid = ObjectID.from_random()
+    store.create(oid, b"old" * 100)
+    store.pin(oid, token=1)          # reader A pins generation 1
+    store.delete(oid)                # tombstoned (A still reading)
+    store.create(oid, b"new" * 100)  # reconstruction re-stores the id
+    store.pin(oid, token=2)          # reader B pins generation 2
+    assert store.is_doomed(oid)
+    store.unpin(oid, token=1)        # A finishes
+    # Doomed generation freed; B's pin on the live entry is untouched.
+    assert not store.is_doomed(oid)
+    assert store._entries[oid].pin_tokens == {2}
+    store.unpin(oid, token=2)
+    assert store._entries[oid].pin_tokens == set()
+    store.destroy()
+
+
+def test_delete_unpinned_frees_immediately(tmp_path):
+    store = ObjectStore(str(tmp_path / "s"), capacity_bytes=1 << 20)
+    oid = ObjectID.from_random()
+    store.create(oid, b"x" * 1024)
+    used_before = store.used
+    store.delete(oid)
+    assert store.used < used_before
+    assert not store.is_doomed(oid)
+    store.destroy()
+
+
+# -------------------------------------------------------------- daemon level
+
+
+@pytest.fixture
+def pin_config(monkeypatch):
+    """Tiny pin TTLs (env-overridable config, rebuilt around the test)."""
+    monkeypatch.setenv("ART_READ_PIN_TTL_S", "0.3")
+    monkeypatch.setenv("ART_ZERO_COPY_PIN_TTL_S", "0.3")
+    config_mod._global_config = None
+    yield None
+    config_mod._global_config = None
+
+
+def _mini_daemon(tmp_path):
+    """A NodeManager shell with just the pin-lease machinery wired up."""
+    from ant_ray_tpu._private.node_daemon import NodeManager
+
+    d = object.__new__(NodeManager)
+    d._pin_leases = {}
+    d._next_pin_token = 1
+    d.store = ObjectStore(str(tmp_path / "s"), capacity_bytes=1 << 20)
+    return d
+
+
+def test_read_done_is_token_matched(tmp_path):
+    d = _mini_daemon(tmp_path)
+    if not d.store.uses_arena:
+        pytest.skip("arena-only pin machinery")
+    oid = ObjectID.from_random()
+    d.store.create(oid, b"y" * 512)
+    long_loc = d._locate_pinned(oid, ttl=500.0)
+    short_loc = d._locate_pinned(oid, ttl=None)   # default short lease
+    assert long_loc["pin_token"] != short_loc["pin_token"]
+    # The short reader finishing must release ITS lease, not the
+    # earliest-queued one.
+    asyncio.run(d._read_done(
+        {"object_id": oid, "pin_token": short_loc["pin_token"]}))
+    assert set(d._pin_leases[oid]) == {long_loc["pin_token"]}
+    d._reap_expired_pins()
+    assert oid in d._pin_leases          # long lease survives
+    asyncio.run(d._read_done(
+        {"object_id": oid, "pin_token": long_loc["pin_token"]}))
+    assert oid not in d._pin_leases
+    d.store.destroy()
+
+
+def test_pin_lease_expiry_and_renewal(tmp_path, pin_config):
+    d = _mini_daemon(tmp_path)
+    if not d.store.uses_arena:
+        pytest.skip("arena-only pin machinery")
+    oid = ObjectID.from_random()
+    d.store.create(oid, b"z" * 512)
+
+    # Expiry: an unrenewed pin is reaped after its TTL.
+    loc = d._locate_pinned(oid, ttl=0.2)
+    time.sleep(0.45)
+    d._reap_expired_pins()
+    assert oid not in d._pin_leases
+    reply = asyncio.run(d._renew_pins(
+        {"pins": [(oid, loc["pin_token"])], "ttl": 0.3}))
+    assert reply == {"gone": [(oid, loc["pin_token"])]}
+
+    # Renewal: heartbeats keep the lease alive past the original TTL.
+    loc = d._locate_pinned(oid, ttl=0.3)
+    for _ in range(3):
+        time.sleep(0.2)
+        reply = asyncio.run(d._renew_pins(
+            {"pins": [(oid, loc["pin_token"])], "ttl": 0.3}))
+        assert reply == {"gone": []}
+        d._reap_expired_pins()
+        assert oid in d._pin_leases
+    d.store.destroy()
+
+
+def test_pin_lease_is_capped(tmp_path):
+    """A bogus client TTL can't wedge a slot past the daemon-side cap."""
+    from ant_ray_tpu._private.node_daemon import NodeManager
+
+    d = _mini_daemon(tmp_path)
+    if not d.store.uses_arena:
+        pytest.skip("arena-only pin machinery")
+    oid = ObjectID.from_random()
+    d.store.create(oid, b"w" * 64)
+    loc = d._locate_pinned(oid, ttl=1e12)
+    expiry = d._pin_leases[oid][loc["pin_token"]]
+    assert expiry - time.monotonic() <= NodeManager._MAX_PIN_LEASE_S + 1
+    d.store.destroy()
+
+
+# ------------------------------------------------------------- cluster level
+
+
+@pytest.fixture
+def pin_cluster(monkeypatch):
+    """Cluster whose zero-copy pin leases expire fast (1.2 s) — with
+    client renewal at TTL/3 the held values must still stay intact."""
+    monkeypatch.setenv("ART_ZERO_COPY_PIN_TTL_S", "1.2")
+    monkeypatch.setenv("ART_READ_PIN_TTL_S", "1.0")
+    config_mod._global_config = None
+    art.init(num_cpus=2)
+    yield None
+    art.shutdown()
+    config_mod._global_config = None
+
+
+def _churn(n=12, size=1 << 20):
+    """Force arena allocation traffic so any wrongly-freed range gets
+    recycled (and the corruption becomes observable)."""
+    refs = [art.put(np.frombuffer(os.urandom(size), dtype=np.uint8))
+            for _ in range(n)]
+    for r in refs:
+        art.get(r)
+
+
+def test_zero_copy_value_survives_ttl_expiry(pin_cluster):
+    arr = art.get(art.put(np.arange(300_000, dtype=np.int64)))
+    expected = arr.copy()
+    # Hold well past the 1.2 s lease; the renewal heartbeat must keep
+    # the backing slot pinned through eviction pressure.
+    deadline = time.monotonic() + 3.0
+    while time.monotonic() < deadline:
+        _churn(n=4)
+        time.sleep(0.3)
+    assert np.array_equal(arr, expected)
+
+
+def test_zero_copy_value_survives_owner_delete(pin_cluster):
+    ref = art.put(np.arange(262_144, dtype=np.int64))
+    arr = art.get(ref)
+    expected = arr.copy()
+    del ref                       # owner frees the object cluster-wide
+    gc.collect()
+    time.sleep(0.6)               # let the free reach the daemon
+    _churn()
+    assert np.array_equal(arr, expected)
