@@ -1095,6 +1095,7 @@ class GcsServer:
                         record["bundle_nodes"][index] = node
                     if committed and record["state"] != "REMOVED":
                         record["state"] = "CREATED"
+                        self._drop_gang_demand(record)
                         self._save_pg(record)
                         return
                 for index, node in prepared:  # roll back (2-phase abort)
@@ -1150,6 +1151,7 @@ class GcsServer:
         if record is None:
             return False
         record["state"] = "REMOVED"
+        self._drop_gang_demand(record)
         # Persist the terminal state FIRST: a head crash mid-removal must
         # not resurrect a CREATED/PENDING record whose bundles the nodes
         # have already returned.
@@ -1242,13 +1244,15 @@ class GcsServer:
         atomically (a whole TPU slice for slice PGs), not one bundle's
         worth of capacity (ref: gang resource requests in
         src/ray/gcs/gcs_autoscaler_state_manager.h — the cluster
-        resource state reports pending gangs to the autoscaler)."""
+        resource state reports pending gangs to the autoscaler).
+
+        Keyed per PG — two pending identical-shape PGs are two gangs
+        needing two node sets, so they must not merge into one demand
+        entry.  The entry is dropped the moment the PG commits or is
+        removed (_drop_gang_demand)."""
         selectors = record.get("bundle_selectors") or \
             [{} for _ in record["bundles"]]
-        key = "gang:" + json.dumps(
-            [[sorted(b.items()) for b in record["bundles"]],
-             [sorted((s or {}).items()) for s in selectors],
-             record["strategy"], record.get("same_label")])
+        key = "gang:" + record["pg_id"].hex()
         now = time.monotonic()
         entry = self._demands.get(key)
         if entry is None:
@@ -1259,6 +1263,7 @@ class GcsServer:
                              key=lambda k: self._demands[k]["last_seen"])
                 del self._demands[oldest]
             self._demands[key] = {
+                "pg_id": record["pg_id"].hex(),
                 "bundles": [dict(b) for b in record["bundles"]],
                 "bundle_selectors": [dict(s or {}) for s in selectors],
                 "strategy": record["strategy"],
@@ -1267,6 +1272,9 @@ class GcsServer:
         else:
             entry["count"] += 1
             entry["last_seen"] = now
+
+    def _drop_gang_demand(self, record) -> None:
+        self._demands.pop("gang:" + record["pg_id"].hex(), None)
 
     def _prune_demands(self, now: float) -> None:
         for key in [k for k, e in self._demands.items()
@@ -1282,7 +1290,8 @@ class GcsServer:
                       "age_s": now - e["first_seen"],
                       "idle_s": now - e["last_seen"]}
             if "bundles" in e:
-                out.append({"bundles": e["bundles"],
+                out.append({"pg_id": e.get("pg_id"),
+                            "bundles": e["bundles"],
                             "bundle_selectors": e["bundle_selectors"],
                             "strategy": e["strategy"],
                             "same_label": e["same_label"], **common})

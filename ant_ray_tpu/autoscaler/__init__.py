@@ -7,6 +7,7 @@ from ant_ray_tpu.autoscaler.node_provider import (
     LocalSubprocessProvider,
     NodeProvider,
     NodeTypeConfig,
+    tpu_slice_node_type,
 )
 
 __all__ = [
@@ -16,4 +17,5 @@ __all__ = [
     "LocalSubprocessProvider",
     "NodeProvider",
     "NodeTypeConfig",
+    "tpu_slice_node_type",
 ]

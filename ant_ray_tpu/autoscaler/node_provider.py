@@ -24,13 +24,85 @@ from dataclasses import dataclass, field
 
 @dataclass(frozen=True)
 class NodeTypeConfig:
-    """One launchable node shape (ref: available_node_types entries)."""
+    """One launchable node shape (ref: available_node_types entries).
+
+    ``hosts_per_launch > 1`` declares a **gang unit**: one
+    ``create_node`` call provisions that many hosts joining together —
+    how GKE TPU node pools scale (a slice is atomic; resizing the pool
+    by one adds every host of one slice).  The per-launch label fields
+    describe the labels those hosts advertise once registered, so the
+    autoscaler can tell that launching one unit satisfies a whole gang
+    demand (slice placement group) even though no live node carries the
+    labels yet:
+
+    * ``launch_shared_label`` — key whose value is shared by all hosts
+      of one launch and unique per launch (``tpu-pod-name``);
+    * ``launch_indexed_label`` — key enumerating hosts within a launch
+      as "0".."N-1" (``tpu-worker-id``);
+    * ``head_resources`` — extra resources on host index 0 only (the
+      ``TPU-<pod_type>-head`` claim resource).
+    """
 
     name: str
     resources: dict
     labels: dict = field(default_factory=dict)
     min_workers: int = 0
     max_workers: int = 8
+    hosts_per_launch: int = 1
+    launch_shared_label: str | None = None
+    launch_indexed_label: str | None = None
+    head_resources: dict = field(default_factory=dict)
+
+    def launch_host_views(self) -> list[dict]:
+        """Predicted (labels, resources) of each host one launch yields —
+        what the autoscaler matches gang demands against."""
+        hosts = []
+        for i in range(self.hosts_per_launch):
+            labels = {**self.labels, "art/node-type": self.name,
+                      "art/autoscaled": "1"}
+            if self.launch_shared_label is not None:
+                labels[self.launch_shared_label] = "<pending-launch>"
+            if self.launch_indexed_label is not None:
+                labels[self.launch_indexed_label] = str(i)
+            resources = dict(self.resources)
+            if i == 0:
+                for key, value in self.head_resources.items():
+                    resources[key] = resources.get(key, 0.0) + value
+            hosts.append({"id": f"{self.name}/{i}", "labels": labels,
+                          "resources": resources})
+        return hosts
+
+
+def tpu_slice_node_type(topology: str,
+                        accelerator_type: str = "TPU-V5E",
+                        name: str = "",
+                        cpus_per_host: float = 8.0,
+                        min_workers: int = 0,
+                        max_workers: int = 4) -> NodeTypeConfig:
+    """NodeTypeConfig for a whole-TPU-slice gang unit, mirroring what
+    util/tpu.py's slice_placement_group demands and what registered
+    slice hosts advertise (accelerators/tpu.py node_labels)."""
+    from ant_ray_tpu._private.accelerators import tpu as tpu_accel  # noqa: PLC0415
+
+    generation = tpu_accel.normalize_generation(accelerator_type)
+    num_hosts = tpu_accel.hosts_in_slice(topology, generation)
+    chips = tpu_accel.chips_per_host(topology, generation)
+    pod_type = tpu_accel.infer_pod_type(topology, generation)
+    return NodeTypeConfig(
+        name=name or f"tpu-{pod_type}-slice",
+        resources={"CPU": cpus_per_host, "TPU": float(chips)},
+        labels={"tpu-generation": generation,
+                "tpu-topology": topology,
+                "tpu-pod-type": pod_type},
+        min_workers=min_workers,
+        max_workers=max_workers,
+        hosts_per_launch=num_hosts,
+        # Always advertised, even single-host: slice_placement_group
+        # pins every bundle's selector to tpu-worker-id regardless of
+        # slice size, so the lone host must carry "tpu-worker-id": "0".
+        launch_shared_label="tpu-pod-name",
+        launch_indexed_label="tpu-worker-id",
+        head_resources={f"TPU-{pod_type}-head": 1.0})
 
 
 class NodeProvider:
@@ -56,6 +128,12 @@ class NodeProvider:
         autoscaler logs this once per node)."""
         return None
 
+    def node_addresses(self, provider_id: str) -> list[str] | None:
+        """All daemon addresses of a launch (gang units yield several
+        hosts); idle scale-down requires every one to be idle."""
+        address = self.node_address(provider_id)
+        return None if address is None else [address]
+
 
 class LocalSubprocessProvider(NodeProvider):
     """Real node daemons as local subprocesses (the cluster_utils
@@ -69,18 +147,44 @@ class LocalSubprocessProvider(NodeProvider):
         self._counter = 0
 
     def create_node(self, node_type: NodeTypeConfig) -> str:
+        """One launch = one gang unit: ``hosts_per_launch`` daemons, each
+        carrying the per-launch labels a real slice host would advertise
+        (shared slice id, per-host worker index) — the local simulator
+        of a GKE TPU node-pool resize."""
         from ant_ray_tpu._private.services import start_node  # noqa: PLC0415
 
-        labels = {**node_type.labels,
-                  "art/node-type": node_type.name,
-                  "art/autoscaled": "1"}
-        proc, address = start_node(
-            self._gcs_address, dict(node_type.resources),
-            self._session_dir, labels=labels)
         with self._lock:
             self._counter += 1
-            pid = f"local-{node_type.name}-{self._counter}"
-            self._nodes[pid] = {"proc": proc, "address": address,
+            launch_no = self._counter
+        pid = f"local-{node_type.name}-{launch_no}"
+        procs = []
+        addresses = []
+        try:
+            for i in range(node_type.hosts_per_launch):
+                labels = {**node_type.labels,
+                          "art/node-type": node_type.name,
+                          "art/autoscaled": "1"}
+                if node_type.launch_shared_label is not None:
+                    labels[node_type.launch_shared_label] = pid
+                if node_type.launch_indexed_label is not None:
+                    labels[node_type.launch_indexed_label] = str(i)
+                resources = dict(node_type.resources)
+                if i == 0:
+                    for key, value in node_type.head_resources.items():
+                        resources[key] = resources.get(key, 0.0) + value
+                proc, address = start_node(
+                    self._gcs_address, resources,
+                    self._session_dir, labels=labels)
+                procs.append(proc)
+                addresses.append(address)
+        except Exception:
+            # Partial gang unit: tear down the hosts already started so
+            # they don't linger as orphan capacity nobody tracks.
+            for proc in procs:
+                proc.terminate()
+            raise
+        with self._lock:
+            self._nodes[pid] = {"procs": procs, "addresses": addresses,
                                 "type": node_type.name}
         return pid
 
@@ -89,17 +193,18 @@ class LocalSubprocessProvider(NodeProvider):
             record = self._nodes.pop(provider_id, None)
         if record is None:
             return
-        proc = record["proc"]
-        proc.terminate()
-        try:
-            proc.wait(timeout=10)
-        except Exception:  # noqa: BLE001 — escalate
-            proc.kill()
+        for proc in record["procs"]:
+            proc.terminate()
+        for proc in record["procs"]:
+            try:
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 — escalate
+                proc.kill()
 
     def non_terminated_nodes(self) -> dict[str, str]:
         with self._lock:
             dead = [pid for pid, r in self._nodes.items()
-                    if r["proc"].poll() is not None]
+                    if all(p.poll() is not None for p in r["procs"])]
             for pid in dead:
                 del self._nodes[pid]
             return {pid: r["type"] for pid, r in self._nodes.items()}
@@ -107,7 +212,12 @@ class LocalSubprocessProvider(NodeProvider):
     def node_address(self, provider_id: str) -> str | None:
         with self._lock:
             record = self._nodes.get(provider_id)
-            return record["address"] if record else None
+            return record["addresses"][0] if record else None
+
+    def node_addresses(self, provider_id: str) -> list[str] | None:
+        with self._lock:
+            record = self._nodes.get(provider_id)
+            return list(record["addresses"]) if record else None
 
 
 class GkeTpuNodePoolProvider(NodeProvider):

@@ -147,3 +147,163 @@ def test_gke_provider_pool_arithmetic():
     assert client.sizes["pool-v5e"] == 0
     with pytest.raises(ValueError):
         GkeTpuNodePoolProvider(None, {})
+
+
+# ------------------------------------------------------------- gang demands
+# (ref: gang resource requests — python/ray/autoscaler/v2/scheduler.py,
+#  src/ray/gcs/gcs_autoscaler_state_manager.h)
+
+from ant_ray_tpu.autoscaler import tpu_slice_node_type  # noqa: E402
+from ant_ray_tpu.autoscaler.autoscaler import plan_gang  # noqa: E402
+from ant_ray_tpu.util.tpu import slice_placement_group  # noqa: E402
+
+
+def _views(*hosts):
+    return [{"id": f"h{i}", "labels": labels, "resources": res}
+            for i, (labels, res) in enumerate(hosts)]
+
+
+def test_plan_gang_strict_spread_needs_distinct_hosts():
+    bundles = [{"CPU": 1.0}, {"CPU": 1.0}]
+    one = _views(({}, {"CPU": 4.0}))
+    two = _views(({}, {"CPU": 4.0}), ({}, {"CPU": 4.0}))
+    assert plan_gang(one, bundles, None, "STRICT_SPREAD", None) is None
+    assert plan_gang(two, bundles, None, "STRICT_SPREAD", None) is not None
+    # PACK is happy with one host.
+    assert plan_gang(one, bundles, None, "STRICT_PACK", None) is not None
+
+
+def test_plan_gang_same_label_groups():
+    bundles = [{"TPU": 4.0}, {"TPU": 4.0}]
+    # Two hosts with TPUs, but on DIFFERENT slices: no same-label plan.
+    split = _views(({"pod": "a"}, {"TPU": 4.0}),
+                   ({"pod": "b"}, {"TPU": 4.0}))
+    joined = _views(({"pod": "a"}, {"TPU": 4.0}),
+                    ({"pod": "a"}, {"TPU": 4.0}))
+    assert plan_gang(split, bundles, None, "STRICT_SPREAD", "pod") is None
+    assert plan_gang(joined, bundles, None, "STRICT_SPREAD",
+                     "pod") is not None
+
+
+def test_plan_gang_selectors_pin_bundles():
+    bundles = [{"TPU": 4.0}, {"TPU": 4.0}]
+    selectors = [{"tpu-worker-id": "0"}, {"tpu-worker-id": "1"}]
+    hosts = _views(({"tpu-worker-id": "0"}, {"TPU": 4.0}),
+                   ({"tpu-worker-id": "1"}, {"TPU": 4.0}))
+    plan = plan_gang(hosts, bundles, selectors, "STRICT_SPREAD", None)
+    assert plan == ["h0", "h1"]
+    # Same hosts, but bundle 1's selector matches nobody.
+    bad = plan_gang(hosts, bundles,
+                    [{"tpu-worker-id": "0"}, {"tpu-worker-id": "9"}],
+                    "STRICT_SPREAD", None)
+    assert bad is None
+
+
+def test_slice_gang_launches_one_whole_unit_via_gke(head_cluster):
+    """A slice PG's gang demand drives ONE node-pool resize (the whole
+    slice), not per-bundle lone nodes."""
+    client = _FakeGkeClient()
+    slice_type = tpu_slice_node_type("4x4", name="v5e-slice",
+                                     max_workers=2)
+    provider = GkeTpuNodePoolProvider(
+        client, pool_for_type={"v5e-slice": "pool-v5e"})
+    autoscaler = Autoscaler(
+        head_cluster.gcs_address, provider,
+        AutoscalerConfig(node_types=[slice_type],
+                         gang_provision_grace_s=3600.0))
+    autoscaler.run_once()     # heartbeat so the PG waits for capacity
+
+    spg = slice_placement_group("4x4")  # 4 hosts — unplaceable here
+    deadline = time.monotonic() + 30
+    launched = []
+    while time.monotonic() < deadline and not launched:
+        launched.extend(autoscaler.run_once()["launched"])
+        time.sleep(0.3)
+    assert launched == ["v5e-slice"]
+    assert client.sizes["pool-v5e"] == 1   # ONE atomic slice resize
+    # The gang stays pending (fake client: hosts never register) but the
+    # grace period stops duplicate provisioning.
+    assert autoscaler.run_once()["launched"] == []
+    assert client.sizes["pool-v5e"] == 1
+    spg.remove()
+
+
+def test_gang_demand_never_launches_mismatched_node(head_cluster):
+    """A gang demand that no configured type can host atomically must
+    launch NOTHING (an empty shape must never look satisfiable)."""
+    autoscaler, provider = _make_autoscaler(
+        head_cluster,
+        [NodeTypeConfig("generic", {"CPU": 16.0}, max_workers=4)],
+        idle_timeout_s=3600.0)
+    autoscaler.run_once()
+
+    spg = slice_placement_group("4x4")   # needs TPU slice hosts
+    deadline = time.monotonic() + 8
+    while time.monotonic() < deadline:
+        assert autoscaler.run_once()["launched"] == []
+        time.sleep(0.5)
+    assert provider.non_terminated_nodes() == {}
+    spg.remove()
+
+
+@pytest.mark.slow
+def test_slice_pg_scales_up_and_commits_e2e(head_cluster):
+    """The flagship TPU story: slice_placement_group on an empty cluster
+    -> gang demand -> autoscaler launches EVERY host of one slice ->
+    hosts register with slice labels -> the PG commits."""
+    slice_type = tpu_slice_node_type("4x4", name="v5e-slice",
+                                     cpus_per_host=1.0, max_workers=1)
+    autoscaler, provider = _make_autoscaler(
+        head_cluster, [slice_type], idle_timeout_s=3600.0)
+    autoscaler.run_once()
+
+    spg = slice_placement_group("4x4", bundle_extra={"CPU": 0.5})
+    stop = threading.Event()
+    launched = []
+
+    def drive():
+        while not stop.is_set():
+            launched.extend(autoscaler.run_once()["launched"])
+            time.sleep(0.5)
+
+    thread = threading.Thread(target=drive, daemon=True)
+    thread.start()
+    try:
+        assert spg.ready(timeout=90), "slice PG never committed"
+    finally:
+        stop.set()
+        thread.join(timeout=10)
+    # One gang unit launch = all 4 hosts of the slice.
+    assert launched == ["v5e-slice"]
+    units = provider.non_terminated_nodes()
+    assert len(units) == 1
+    addresses = provider.node_addresses(next(iter(units)))
+    assert len(addresses) == 4
+    spg.remove()
+
+
+def test_two_identical_slice_pgs_get_two_units(head_cluster):
+    """Per-PG gang demands: two pending identical-shape slice PGs must
+    drive TWO unit launches (they can't share one slice's head claim)."""
+    client = _FakeGkeClient()
+    slice_type = tpu_slice_node_type("4x4", name="v5e-slice",
+                                     max_workers=2)
+    provider = GkeTpuNodePoolProvider(
+        client, pool_for_type={"v5e-slice": "pool-v5e"})
+    autoscaler = Autoscaler(
+        head_cluster.gcs_address, provider,
+        AutoscalerConfig(node_types=[slice_type],
+                         gang_provision_grace_s=3600.0))
+    autoscaler.run_once()
+
+    a = slice_placement_group("4x4")
+    b = slice_placement_group("4x4")
+    deadline = time.monotonic() + 30
+    launched = []
+    while time.monotonic() < deadline and len(launched) < 2:
+        launched.extend(autoscaler.run_once()["launched"])
+        time.sleep(0.3)
+    assert launched == ["v5e-slice", "v5e-slice"]
+    assert client.sizes["pool-v5e"] == 2
+    a.remove()
+    b.remove()
