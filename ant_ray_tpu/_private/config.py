@@ -42,6 +42,14 @@ class Config:
     object_store_memory: int = 0
     # Chunk size for node-to-node object transfer.
     object_transfer_chunk_size: int = 8 * 1024 * 1024
+    # Holder-side memo of recently served transfer chunks: a broadcast
+    # to N nodes costs one store read per chunk, not N (ref: PushManager
+    # chunk dedup, push_manager.h:28).  0 disables.
+    transfer_chunk_cache_bytes: int = 64 * 1024 * 1024
+    # Cap on a node's in-flight inbound transfer bytes; pulls beyond it
+    # queue (ref: pull_manager.h:50 quota).  0 = unlimited.  A single
+    # object larger than the quota still pulls (alone).
+    pull_quota_bytes: int = 256 * 1024 * 1024
     # An unsealed arena grant younger than this is presumed live (its
     # producer is still writing); only older grants are reclaimed.
     unsealed_grant_ttl_s: float = 30.0
@@ -105,6 +113,10 @@ class Config:
     # Node heartbeat period and the number of missed beats before death.
     heartbeat_period_s: float = 0.5
     num_heartbeats_timeout: int = 10
+    # A node daemon whose GCS has been unreachable this long exits
+    # (fail-stop for orphans; GCS FT restarts return well inside it).
+    # 0 disables.
+    gcs_dead_exit_s: float = 60.0
 
     # Node-side virtual-cluster fencing verdicts are cached this long
     # before re-checking with the GCS (ant ref: virtual-cluster GC/TTL

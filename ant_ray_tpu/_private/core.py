@@ -94,6 +94,7 @@ class _SchedKeyState:
     pg: tuple | None                  # (pg_id, bundle_index) if any
     queue: deque = field(default_factory=deque)  # (spec, pinned, attempt)
     workers: int = 0                  # granted leases currently draining
+    busy: int = 0                     # of those, executing a task now
     acquiring: int = 0                # LeaseWorker requests in flight
     wakeup: asyncio.Event = field(default_factory=asyncio.Event)
 
@@ -926,9 +927,15 @@ class ClusterRuntime(CoreRuntime):
         self._maybe_acquire(key, state)
 
     def _maybe_acquire(self, key: tuple, state: _SchedKeyState):
+        # Leases scale with queued tasks that IDLE capacity can't absorb:
+        # a worker mid-task is not capacity, so a task submitted while
+        # the key's only worker executes gets its own lease instead of
+        # serializing behind it (ref: NormalTaskSubmitter grows pending
+        # lease requests with the task queue, not the lease count).
         cap = global_config().max_pending_lease_requests
         while (state.acquiring < cap
-               and state.workers + state.acquiring < len(state.queue)):
+               and (state.acquiring + max(0, state.workers - state.busy)
+                    < len(state.queue))):
             state.acquiring += 1
             asyncio.ensure_future(self._acquire_worker(key, state))
 
@@ -1037,6 +1044,25 @@ class ClusterRuntime(CoreRuntime):
         client = self._clients.get(worker_addr)
         depth = max(1, cfg.task_push_pipeline_depth)
         linger = cfg.task_lease_linger_s
+        marked_busy = False
+
+        def _set_busy(value: bool):
+            nonlocal marked_busy
+            if value and not marked_busy:
+                marked_busy = True
+                state.busy += 1
+            elif not value and marked_busy:
+                marked_busy = False
+                state.busy -= 1
+
+        try:
+            await self._worker_drain_loop(
+                state, client, depth, linger, _set_busy)
+        finally:
+            _set_busy(False)
+
+    async def _worker_drain_loop(self, state, client, depth, linger,
+                                 _set_busy):
         inflight: deque = deque()
         dead: Exception | None = None
         while True:
@@ -1060,6 +1086,9 @@ class ClusterRuntime(CoreRuntime):
                     client.discard_deferred()
                     break
                 inflight.append((spec, pinned, attempt, fut))
+            # A worker with pushes in flight is busy — not idle capacity
+            # — so _maybe_acquire leases more workers for queue surplus.
+            _set_busy(bool(inflight))
             if dead is None and inflight:
                 try:
                     await client.flush_deferred()

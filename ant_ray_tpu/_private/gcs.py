@@ -62,6 +62,10 @@ class GcsServer:
         self._server = RpcServer(host, port)
         self._nodes: dict[NodeID, NodeInfo] = {}
         self._last_heartbeat: dict[NodeID, float] = {}
+        # Versioned resource-view sync: highest view version applied per
+        # node (ref: ray_syncer NodeState version tracking).  Absent
+        # after a restart -> the node is commanded to resync.
+        self._node_view_versions: dict[NodeID, int] = {}
         self._actors: dict[ActorID, ActorRecord] = {}
         self._named_actors: dict[tuple[str, str], ActorID] = {}
         self._kv: dict[str, bytes] = {}
@@ -286,15 +290,20 @@ class GcsServer:
                 await self._handle_actor_failure(
                     record, "node lost while the head was down")
 
-    def stop(self):
+    def stop(self, graceful: bool = True):
+        """``graceful=False`` (the subprocess SIGTERM path) skips waits
+        that need io-loop turns: the loop may be busy reacting to the
+        same cluster teardown (node deaths), and the dying process's
+        sockets close with it anyway."""
         if self._health_task is not None:
             self._health_task.cancel()
         flush_task = getattr(self, "_flush_task", None)
         if flush_task is not None:
             flush_task.cancel()
             self._flush_locations()  # final batch before shutdown
-        self._server.stop()
-        self._clients.close_all()
+        if graceful:
+            self._server.stop()
+            self._clients.close_all()
 
     async def _shutdown_rpc(self, _payload):
         loop = asyncio.get_running_loop()
@@ -356,6 +365,10 @@ class GcsServer:
     async def _register_node(self, info: NodeInfo):
         self._nodes[info.node_id] = info
         self._last_heartbeat[info.node_id] = time.monotonic()
+        # (Re-)registration carries a fresh full view and restarts the
+        # node's version counter — drop any stale high-water mark so the
+        # node's next deltas aren't rejected as old.
+        self._node_view_versions.pop(info.node_id, None)
         self._publish("node", {"node_id": info.node_id, "alive": True,
                                "address": info.address})
         logger.info("node %s registered at %s", info.node_id.hex()[:8],
@@ -363,14 +376,32 @@ class GcsServer:
         return True
 
     async def _heartbeat(self, payload):
+        """Liveness + versioned resource-view sync (ref:
+        src/ray/ray_syncer/ray_syncer.h:90).  A beat without a ``view``
+        is pure liveness; one WITH a view applies it if its version is
+        newer than what we hold and acks the version, so the node stops
+        resending.  After a GCS restart our version table is empty —
+        the ``resync`` command tells the node to send a full view."""
         node_id = payload["node_id"]
         info = self._nodes.get(node_id)
         if info is None:
             return {"unknown_node": True}  # node must re-register
-        info.available_resources = payload["available_resources"]
-        info.disk_full = payload.get("disk_full", False)
         self._last_heartbeat[node_id] = time.monotonic()
-        return {}
+        reply: dict = {}
+        view = payload.get("view")
+        if view is not None:
+            version = view.get("version", 0)
+            if version > self._node_view_versions.get(node_id, -1):
+                info.available_resources = view["available_resources"]
+                info.disk_full = view.get("disk_full", False)
+                self._node_view_versions[node_id] = version
+            reply["synced"] = self._node_view_versions[node_id]
+        elif node_id not in self._node_view_versions:
+            reply["commands"] = ["resync"]
+        if "available_resources" in payload:   # legacy full-view beat
+            info.available_resources = payload["available_resources"]
+            info.disk_full = payload.get("disk_full", False)
+        return reply
 
     async def _get_all_nodes(self, _payload):
         return dict(self._nodes)
@@ -1332,7 +1363,6 @@ class GcsServer:
 def main():  # pragma: no cover — exercised via subprocess in tests
     import argparse
     import signal
-    import sys
 
     parser = argparse.ArgumentParser()
     parser.add_argument("--port", type=int, required=True)
@@ -1364,8 +1394,10 @@ def main():  # pragma: no cover — exercised via subprocess in tests
                 f"/proc/{args.monitor_pid}"):
             logger.warning("monitored pid %d gone; exiting", args.monitor_pid)
             break
-    server.stop()
-    sys.exit(0)
+    server.stop(graceful=False)
+    # Skip interpreter teardown: daemon threads may hold the io loop and
+    # sys.exit would wait on finalizers; the tables are flushed above.
+    os._exit(0)
 
 
 if __name__ == "__main__":

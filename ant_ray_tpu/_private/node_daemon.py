@@ -13,10 +13,12 @@ from __future__ import annotations
 import asyncio
 import logging
 import os
+import random
 import subprocess
 import sys
 import time
 import uuid
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
 from ant_ray_tpu._private.config import global_config
@@ -93,6 +95,11 @@ class NodeManager:
         # (pg_id, bundle_index) -> {"resources", "available", "committed"}
         self._bundles: dict[tuple, dict] = {}
         self._workers: dict[WorkerID, WorkerHandle] = {}
+        # Spawned-but-unregistered workers: counted against the pool cap
+        # so N concurrent lease requests can't each spawn (check-then-
+        # spawn overshoot — a burst of leases on a small node must queue
+        # for the pool, not fork a process storm).
+        self._starting_workers = 0
         self._lease_event = asyncio.Event()
         self._max_workers = int(
             cfg.max_workers_per_node or max(1, int(resources.get("CPU", 1))))
@@ -105,6 +112,24 @@ class NodeManager:
         # zero-copy reader's lease.
         self._pin_leases: dict[ObjectID, dict[int, float]] = {}
         self._next_pin_token = 1
+        # Versioned-sync observability + early-send wakeup (see
+        # _heartbeat_loop; ref: ray_syncer resource-view component).
+        self.sync_stats = {"beats": 0, "views_sent": 0}
+        self._sync_wakeup = asyncio.Event()
+        # Broadcast-serving chunk cache (ref: PushManager chunk dedup,
+        # src/ray/object_manager/push_manager.h:28 — redesigned for the
+        # pull-driven plane: N nodes fetching one object each read every
+        # chunk from the holder, so the holder memoizes the chunk bytes
+        # and pays ONE store read per chunk per broadcast, not N).
+        self._chunk_cache: "OrderedDict[tuple, bytes]" = OrderedDict()
+        self._chunk_cache_bytes = 0
+        # Pull admission quota: bytes of in-flight inbound transfers
+        # (ref: pull_manager.h:50 num_bytes_being_pulled quota) — callers
+        # queue instead of pulling a dataset larger than memory at once.
+        self._pull_bytes_inflight = 0
+        self._pull_quota_cv: asyncio.Condition = asyncio.Condition()
+        self.transfer_stats = {"chunk_reads": 0, "chunk_cache_hits": 0,
+                               "quota_waits": 0}
         # terminated-but-unreaped workers (retired for env mismatch)
         self._retired_procs: list[subprocess.Popen] = []
         # job_id -> (allowed_here, expires_at): virtual-cluster fencing
@@ -137,6 +162,9 @@ class NodeManager:
             "DeleteObject": self._delete_object,
             "ContainsObject": self._contains_object,
             "GetNodeInfo": self._get_node_info,
+            "GetSyncStats": self._get_sync_stats,
+            "GetStoreStats": self._get_store_stats,
+            "GetTransferStats": self._get_transfer_stats,
             "ListLogs": self._list_logs,
             "ReadLog": self._read_log,
             "Shutdown": self._shutdown_rpc,
@@ -224,22 +252,80 @@ class NodeManager:
     async def _get_node_info(self, _payload):
         return self._node_info()
 
+    async def _get_sync_stats(self, _payload):
+        return dict(self.sync_stats)
+
+    async def _get_store_stats(self, _payload):
+        return {"used": self.store.used,
+                "capacity": self.store.capacity,
+                "spilled": self.store.spilled_bytes}
+
     async def _heartbeat_loop(self):
+        """Liveness heartbeat + versioned resource sync (ref:
+        src/ray/ray_syncer/ray_syncer.h:90 — versioned per-node state
+        gossip with "don't resend what the peer knows" semantics).
+
+        The resource view rides the heartbeat ONLY when it changed
+        since the version the GCS last acked: an idle cluster's beats
+        carry just the node id, so steady-state sync bytes are O(1) per
+        node instead of O(resource-dict).  A change wakes the loop
+        early (sub-period propagation — fresher than the fixed beat the
+        full-view design had), and the GCS can command a resync after
+        losing state.  Version bumps come from snapshot comparison, not
+        from instrumenting every mutation site, so a missed wakeup can
+        delay a delta by at most one period, never lose it."""
         gcs = self._clients.get(self._gcs_address)
         period = global_config().heartbeat_period_s
+        last_snap = None
+        version = 0
+        acked = -1
+        last_gcs_ok = time.monotonic()
         while not self._stopping:
-            try:
-                reply = await gcs.call_async("Heartbeat", {
-                    "node_id": self.node_id,
+            snap = (tuple(sorted(self._available.items())),
+                    self._disk_full)
+            if snap != last_snap:
+                last_snap = snap
+                version += 1
+            payload: dict = {"node_id": self.node_id}
+            if version > acked:
+                payload["view"] = {
                     "available_resources": dict(self._available),
                     "disk_full": self._disk_full,
-                }, timeout=10)
+                    "version": version,
+                }
+            try:
+                reply = await gcs.call_async("Heartbeat", payload,
+                                             timeout=10)
                 if reply.get("unknown_node"):
                     await self._register()
+                    acked = -1
+                else:
+                    if "synced" in reply:
+                        acked = max(acked, reply["synced"])
+                    if "resync" in reply.get("commands", ()):
+                        acked = -1
+                self.sync_stats["beats"] += 1
+                if "view" in payload:
+                    self.sync_stats["views_sent"] += 1
+                last_gcs_ok = time.monotonic()
             except Exception as e:  # noqa: BLE001 — head may be restarting
                 logger.debug("heartbeat failed: %s", e)
+                # Fail-stop on a permanently-gone head: GCS restarts
+                # (FT) come back within seconds; a daemon orphaned by a
+                # dead cluster must not linger burning CPU forever.
+                dead_after = global_config().gcs_dead_exit_s
+                if dead_after > 0 and \
+                        time.monotonic() - last_gcs_ok > dead_after:
+                    logger.error(
+                        "GCS unreachable for %.0fs; node daemon "
+                        "exiting", time.monotonic() - last_gcs_ok)
+                    os._exit(1)
             self._reap_expired_pins()
-            await asyncio.sleep(period)
+            self._sync_wakeup.clear()
+            try:
+                await asyncio.wait_for(self._sync_wakeup.wait(), period)
+            except asyncio.TimeoutError:
+                pass
 
     def stop(self):
         self._stopping = True
@@ -448,7 +534,9 @@ class NodeManager:
                     "local disk %.1f%% full (>= %.1f%%): node stops "
                     "accepting new leases until space frees",
                     100 * used, 100 * cfg.local_fs_capacity_threshold)
-            self._disk_full = full
+            if full != self._disk_full:
+                self._disk_full = full
+                self._sync_wakeup.set()
             await asyncio.sleep(cfg.fs_monitor_interval_s)
 
     async def _memory_monitor_loop(self):
@@ -492,11 +580,13 @@ class NodeManager:
     def _allocate(self, demand: dict[str, float]):
         for k, v in demand.items():
             self._available[k] = self._available.get(k, 0.0) - v
+        self._sync_wakeup.set()
 
     def _release(self, demand: dict[str, float]):
         for k, v in demand.items():
             self._available[k] = self._available.get(k, 0.0) + v
         self._lease_event.set()
+        self._sync_wakeup.set()
 
     async def _ensure_runtime_env(self, wire: dict | None):
         """Prefetch + extract a runtime env's packages (working_dir +
@@ -640,14 +730,17 @@ class NodeManager:
                                       f"capacity {bundle['resources']}"}
                 if self._bundle_can_allocate(pg_key, demand):
                     worker = self._idle_worker(env_key)
-                    if worker is None and \
-                            self._pool_size() >= self._max_workers + 4:
+                    pool = self._pool_size() + self._starting_workers
+                    if worker is None and pool >= self._max_workers + 4:
                         self._retire_idle_mismatch(env_key)
-                    if worker is None and \
-                            self._pool_size() < self._max_workers + 4:
-                        handle = self._spawn_worker(
-                            runtime_env=runtime_env)
-                        await handle.registered.wait()
+                    if worker is None and pool < self._max_workers + 4:
+                        self._starting_workers += 1
+                        try:
+                            handle = self._spawn_worker(
+                                runtime_env=runtime_env)
+                            await handle.registered.wait()
+                        finally:
+                            self._starting_workers -= 1
                         worker = handle if handle.state == IDLE else None
                     if worker is not None:
                         self._bundle_allocate(pg_key, demand)
@@ -695,12 +788,17 @@ class NodeManager:
         while True:
             if self._can_allocate(demand):
                 worker = self._idle_worker(env_key)
-                if worker is None and \
-                        self._pool_size() >= self._max_workers:
+                pool = self._pool_size() + self._starting_workers
+                if worker is None and pool >= self._max_workers:
                     self._retire_idle_mismatch(env_key)
-                if worker is None and self._pool_size() < self._max_workers:
-                    handle = self._spawn_worker(runtime_env=runtime_env)
-                    await handle.registered.wait()
+                if worker is None and pool < self._max_workers:
+                    self._starting_workers += 1
+                    try:
+                        handle = self._spawn_worker(
+                            runtime_env=runtime_env)
+                        await handle.registered.wait()
+                    finally:
+                        self._starting_workers -= 1
                     worker = handle if handle.state == IDLE else None
                 if worker is not None:
                     self._allocate(demand)
@@ -1042,6 +1140,10 @@ class NodeManager:
                             "no_holders": True}
             else:
                 no_holders_since = None
+            # Randomized holder order spreads a broadcast across every
+            # node that already completed its pull, instead of every
+            # puller hammering the first-listed holder.
+            random.shuffle(holders)
             for holder in holders:
                 try:
                     remote = self._clients.get(holder.address)
@@ -1071,6 +1173,37 @@ class NodeManager:
         if info is None:
             raise _HolderMiss("holder no longer has the object")
         size = info["size"]
+        await self._acquire_pull_quota(size)
+        try:
+            await self._pull_body(remote, object_id, chunk, size)
+        finally:
+            await self._release_pull_quota(size)
+
+    async def _acquire_pull_quota(self, size: int):
+        """Admission control on inbound transfer bytes (ref:
+        pull_manager.h:50 pull quota): a burst of pulls bigger than the
+        quota queues here instead of over-committing store memory."""
+        quota = global_config().pull_quota_bytes
+        if quota <= 0:
+            return
+        async with self._pull_quota_cv:
+            if self._pull_bytes_inflight > 0 and \
+                    self._pull_bytes_inflight + size > quota:
+                self.transfer_stats["quota_waits"] += 1
+            while (self._pull_bytes_inflight > 0
+                   and self._pull_bytes_inflight + size > quota):
+                await self._pull_quota_cv.wait()
+            self._pull_bytes_inflight += size
+
+    async def _release_pull_quota(self, size: int):
+        if global_config().pull_quota_bytes <= 0:
+            return
+        async with self._pull_quota_cv:
+            self._pull_bytes_inflight -= size
+            self._pull_quota_cv.notify_all()
+
+    async def _pull_body(self, remote, object_id: ObjectID, chunk: int,
+                         size: int):
 
         async def fetch_into(write):
             pos = 0
@@ -1130,6 +1263,11 @@ class NodeManager:
         if self._stopping or not self.address:
             return
         try:
+            self._io.loop.call_soon_threadsafe(
+                self._drop_cached_chunks, object_id)
+        except RuntimeError:   # loop closed: teardown eviction
+            pass
+        try:
             gcs = self._clients.get(self._gcs_address)
             self._io.loop.call_soon_threadsafe(
                 asyncio.ensure_future,
@@ -1139,12 +1277,38 @@ class NodeManager:
             pass
 
     async def _read_chunk(self, payload):
-        return self.store.read_chunk(
-            payload["object_id"], payload["offset"], payload["length"])
+        """Serve one transfer chunk, memoized: during a broadcast every
+        puller asks for the same chunks, so the store is read once per
+        chunk and the bytes are shared across repliers (objects are
+        immutable while they exist; deletion drops the cache entries)."""
+        key = (payload["object_id"], payload["offset"], payload["length"])
+        cached = self._chunk_cache.get(key)
+        if cached is not None:
+            self._chunk_cache.move_to_end(key)
+            self.transfer_stats["chunk_cache_hits"] += 1
+            return cached
+        data = self.store.read_chunk(*key)
+        self.transfer_stats["chunk_reads"] += 1
+        cap = global_config().transfer_chunk_cache_bytes
+        if cap > 0 and len(data) <= cap:
+            self._chunk_cache[key] = data
+            self._chunk_cache_bytes += len(data)
+            while self._chunk_cache_bytes > cap:
+                _old_key, old = self._chunk_cache.popitem(last=False)
+                self._chunk_cache_bytes -= len(old)
+        return data
+
+    def _drop_cached_chunks(self, object_id: ObjectID) -> None:
+        for key in [k for k in self._chunk_cache if k[0] == object_id]:
+            self._chunk_cache_bytes -= len(self._chunk_cache.pop(key))
+
+    async def _get_transfer_stats(self, _payload):
+        return dict(self.transfer_stats)
 
     async def _delete_object(self, payload):
         # GCS-driven delete: its location record is already retracted,
         # so skip the on_delete location-remove echo.
+        self._drop_cached_chunks(payload["object_id"])
         self.store.delete(payload["object_id"], notify=False)
         return True
 

@@ -209,6 +209,11 @@ class ObjectStore:
     def used(self) -> int:
         return self._used
 
+    @property
+    def spilled_bytes(self) -> int:
+        with self._lock:
+            return self._spilled_bytes()
+
     # ---- write path
 
     def create(self, object_id: ObjectID, payload: bytes | memoryview) -> str:

@@ -285,10 +285,18 @@ class RpcServer:
         if self._server is not None:
             async def _close(server):
                 server.close()
-                await server.wait_closed()
+                # 3.12 wait_closed() waits for every open CONNECTION,
+                # not just the listening socket — peers keep theirs open
+                # (pooled clients), so an unbounded wait stalls every
+                # shutdown for the full run_coro timeout.  Closing the
+                # listener is what matters; give stragglers a beat.
+                try:
+                    await asyncio.wait_for(server.wait_closed(), 0.2)
+                except asyncio.TimeoutError:
+                    pass
 
             try:
-                self._io.run_coro(_close(self._server), timeout=5)
+                self._io.run_coro(_close(self._server), timeout=2)
             except Exception:
                 pass
             self._server = None

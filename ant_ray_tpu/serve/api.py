@@ -256,35 +256,139 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
 
 
 
+class _RoutingState:
+    """Replica set + queue snapshot shared by an options()-derived
+    handle family, kept fresh by ONE controller long-poll listener
+    thread (ref: serve/_private/long_poll.py LongPollClient).  The
+    controller blocks the listen call until the deployment's version
+    advances, so scale-ups/downs reach every handle within one push —
+    no TTL staleness window.  A slow TTL poll remains as fallback for
+    the window before the listener's first reply (or if it dies)."""
+
+    def __init__(self, name: str, replicas: list, controller):
+        self.lock = threading.Lock()
+        self.name = name
+        self.replicas = list(replicas)
+        self.ongoing: list = [0] * len(replicas)
+        self.local_extra: dict[int, int] = {}
+        self.version = 0
+        self.controller = controller
+        self._listener: threading.Thread | None = None
+        self._last_poll = time.monotonic()
+
+    def apply(self, info: dict) -> None:
+        with self.lock:
+            old_replicas = self.replicas
+            old_extra = self.local_extra
+            new_replicas = list(info["replicas"])
+            # Carry this family's in-flight dispatch counts across the
+            # update (remapped by replica identity): wiping them would
+            # erase the load signal mid-burst and skew po2 routing.
+            new_index = {r.actor_id: i
+                         for i, r in enumerate(new_replicas)}
+            extra: dict[int, int] = {}
+            for index, count in old_extra.items():
+                if index < len(old_replicas):
+                    ni = new_index.get(old_replicas[index].actor_id)
+                    if ni is not None:
+                        extra[ni] = extra.get(ni, 0) + count
+            self.replicas = new_replicas
+            self.ongoing = list(info.get("ongoing",
+                                         [0] * len(new_replicas)))
+            self.local_extra = extra
+            self.version = info.get("version", self.version)
+        self._last_poll = time.monotonic()
+
+    def ensure_listener(self) -> None:
+        if self.controller is None or self._listener is not None:
+            return
+        with self.lock:
+            if self._listener is not None:
+                return
+            self._listener = threading.Thread(
+                target=self._listen_loop, daemon=True,
+                name=f"serve-listen-{self.name}")
+        self._listener.start()
+
+    def _listen_loop(self) -> None:
+        art = _art()
+        while True:
+            try:
+                changed = art.get(
+                    self.controller.listen_for_change.remote(
+                        {self.name: self.version}),
+                    timeout=_LISTEN_TIMEOUT_S + 15)
+            except Exception:  # noqa: BLE001 — controller restarting
+                time.sleep(0.5)
+                continue
+            if not changed:
+                continue                       # listen timeout: re-arm
+            info = changed.get(self.name)
+            if info is None:
+                return                         # deployment deleted
+            self.apply(info)
+
+    def poll_fallback(self) -> None:
+        """TTL refresh for the pre-listener window (and as a safety net
+        if the push channel wedges)."""
+        if self.controller is None:
+            return
+        if time.monotonic() - self._last_poll < \
+                DeploymentHandle._REFRESH_TTL_S:
+            return
+        self._last_poll = time.monotonic()
+        try:
+            info = _art().get(
+                self.controller.get_handle_info.remote(self.name))
+        except Exception:  # noqa: BLE001 — keep the cached set
+            return
+        if info:
+            self.apply(info)
+
+
+# Controller-side long-poll window; client waits a bit longer.
+_LISTEN_TIMEOUT_S = 30.0
+
+
 class DeploymentHandle:
     """Client handle routing calls across a deployment's replicas with
     power-of-two-choices over reported queue depths
     (ref: PowerOfTwoChoicesRequestRouter, serve/_private/router.py:472).
 
-    With a controller reference the handle refreshes its replica set and
-    queue snapshot on a short TTL, so it follows autoscaling."""
+    Replica-set changes are PUSHED: a listener long-polls the
+    controller's version channel and rewrites the shared routing state
+    the moment a deployment scales (ref: serve/_private/long_poll.py
+    LongPollClient) — a scale-up is visible to the very next request,
+    not after a TTL.  A slow TTL poll remains as the fallback when the
+    listener cannot run."""
 
-    _REFRESH_TTL_S = 1.0
+    _REFRESH_TTL_S = 30.0           # fallback only — push is primary
 
     def __init__(self, deployment_name: str, replicas: list,
                  method_name: str = "__call__", stream: bool = False,
                  controller=None, multiplexed_model_id: str = "",
-                 _mux_affinity: dict | None = None):
+                 _mux_affinity: dict | None = None,
+                 _routing: "_RoutingState | None" = None):
         self._name = deployment_name
-        self._replicas = list(replicas)
         self._method = method_name
         self._stream = stream
         self._controller = controller
         self._mux_model_id = multiplexed_model_id
-        # model id -> replica index; SHARED with handles derived via
+        # model id -> replica; SHARED with handles derived via
         # options() so affinity survives per-request option changes
         self._mux_affinity = ({} if _mux_affinity is None
                               else _mux_affinity)
         self._rr = itertools.count()
-        self._ongoing: list = [0] * len(self._replicas)
-        self._local_extra: dict[int, int] = {}
-        self._last_refresh = time.monotonic()
-        self._lock = threading.Lock()
+        # Routing state (replica set + queue snapshot) is shared across
+        # the options()-derived handle family: one listener serves all.
+        self._routing = (_routing if _routing is not None
+                         else _RoutingState(deployment_name, replicas,
+                                            controller))
+        # Arm the push listener NOW, not on first use: a scale-down can
+        # kill a replica from this handle's constructor-time list before
+        # the first request, and the drain grace assumes every live
+        # handle hears about shrinks promptly.
+        self._routing.ensure_listener()
 
     def options(self, method_name: str | None = None,
                 stream: bool | None = None,
@@ -295,36 +399,43 @@ class DeploymentHandle:
         (ref: handle.options(stream=True)).  ``multiplexed_model_id``
         routes to the replica that already serves that model."""
         return DeploymentHandle(
-            self._name, self._replicas,
+            self._name, self._routing.replicas,
             method_name if method_name is not None else self._method,
             self._stream if stream is None else stream,
             self._controller,
             (self._mux_model_id if multiplexed_model_id is None
              else multiplexed_model_id),
-            self._mux_affinity)
+            self._mux_affinity,
+            self._routing)
+
+    # Internal views over the shared routing state (kept as properties
+    # so the routing/mux logic below reads naturally).
+    @property
+    def _lock(self):
+        return self._routing.lock
+
+    @property
+    def _replicas(self):
+        return self._routing.replicas
+
+    @property
+    def _ongoing(self):
+        return self._routing.ongoing
+
+    @property
+    def _local_extra(self):
+        return self._routing.local_extra
 
     def _maybe_refresh(self):
-        if self._controller is None:
-            return
-        now = time.monotonic()
-        if now - self._last_refresh < self._REFRESH_TTL_S:
-            return
-        try:
-            info = _art().get(
-                self._controller.get_handle_info.remote(self._name))
-        except Exception:  # noqa: BLE001 — keep the cached set
-            return
-        if info:
-            with self._lock:
-                self._replicas = list(info["replicas"])
-                self._ongoing = list(info.get("ongoing",
-                                              [0] * len(self._replicas)))
-                self._local_extra = {}
-                self._last_refresh = now
+        self._routing.ensure_listener()
+        self._routing.poll_fallback()
 
-    def _pick(self) -> int:
+    def _pick(self):
         """Two random candidates, route to the shorter queue (cached
-        depth + dispatches this handle made since the last refresh)."""
+        depth + dispatches this handle made since the last refresh).
+        Returns the replica HANDLE, resolved inside the critical
+        section — the listener thread may swap the replica list at any
+        moment, so an index is stale the instant the lock drops."""
         with self._lock:
             n = len(self._replicas)
             if n == 0:
@@ -343,7 +454,7 @@ class DeploymentHandle:
                 index = i if load(i) <= load(j) else j
             self._local_extra[index] = \
                 self._local_extra.get(index, 0) + 1
-            return index
+            return self._replicas[index]
 
     def remote(self, *args, **kwargs):
         self._maybe_refresh()
@@ -352,22 +463,20 @@ class DeploymentHandle:
             # Affinity is by replica IDENTITY: handles refresh their
             # replica lists independently, so a stored index could point
             # at a different replica after a resize.
+            replica = None
             with self._lock:
                 target = self._mux_affinity.get(model_id)
-                index = None
                 if target is not None:
-                    for k, r in enumerate(self._replicas):
+                    for r in self._replicas:
                         if r.actor_id == target.actor_id:
-                            index = k
+                            replica = r
                             break
-            if index is None:
-                index = self._pick()
+            if replica is None:
+                replica = self._pick()
                 with self._lock:
-                    self._mux_affinity[model_id] = self._replicas[index]
+                    self._mux_affinity[model_id] = replica
         else:
-            index = self._pick()
-        with self._lock:
-            replica = self._replicas[index]
+            replica = self._pick()
         if self._stream:
             return replica.handle_request_streaming.remote(
                 self._method, args, kwargs, model_id)
@@ -456,10 +565,42 @@ class ServeController:
         self._deployments: dict[str, dict] = {}
         self._proxy = None
         self._lock = threading.Lock()
+        # Long-poll version channel: listeners block here until some
+        # deployment's version advances (ref: serve/_private/
+        # long_poll.py LongPollHost snapshot ids).
+        self._version_cv = threading.Condition(self._lock)
         self._stopping = False
         self._scaler = threading.Thread(
             target=self._scale_loop, daemon=True, name="serve-scaler")
         self._scaler.start()
+
+    def _bump_version_locked(self, entry: dict) -> None:
+        entry["version"] = entry.get("version", 0) + 1
+        self._version_cv.notify_all()
+
+    def listen_for_change(self, keys: dict, timeout_s: float = 30.0):
+        """Block until any listed deployment's version passes the
+        caller's, then return the changed routing infos; {} on timeout
+        (the caller re-arms).  A deleted deployment reports None."""
+        deadline = time.monotonic() + timeout_s
+        with self._lock:
+            while True:
+                changed: dict = {}
+                for name, known in keys.items():
+                    entry = self._deployments.get(name)
+                    if entry is None:
+                        changed[name] = None
+                    elif entry.get("version", 0) > known:
+                        changed[name] = {
+                            "version": entry["version"],
+                            "replicas": list(entry["replicas"]),
+                            "ongoing": list(entry["ongoing"])}
+                if changed:
+                    return changed
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {}
+                self._version_cv.wait(remaining)
 
     def _make_replicas(self, deployment: Deployment, args, kwargs, n: int):
         art = _art()
@@ -484,6 +625,11 @@ class ServeController:
     def deploy(self, deployment: Deployment, args, kwargs) -> dict:
         art = _art()
         existing = self._deployments.get(deployment.name)
+        # Versions survive redeploys: listeners hold the OLD entry's
+        # version, and a counter restarting below it would never wake
+        # them (they'd route to the killed replicas until the fallback
+        # TTL).
+        base_version = existing.get("version", 0) if existing else 0
         if existing is not None:
             for r in existing["replicas"]:
                 try:
@@ -495,7 +641,7 @@ class ServeController:
             n = deployment.autoscaling_config.min_replicas
         replicas = self._make_replicas(deployment, args, kwargs, n)
         with self._lock:
-            self._deployments[deployment.name] = {
+            entry = {
                 "deployment": deployment,
                 "args": args,
                 "kwargs": kwargs,
@@ -503,7 +649,10 @@ class ServeController:
                 "route_prefix": deployment.route_prefix,
                 "ongoing": [0] * len(replicas),
                 "low_streak": 0,
+                "version": base_version,
             }
+            self._deployments[deployment.name] = entry
+            self._bump_version_locked(entry)
         return {"name": deployment.name}
 
     def get_handle_info(self, name: str):
@@ -512,7 +661,8 @@ class ServeController:
             if entry is None:
                 return None
             return {"replicas": list(entry["replicas"]),
-                    "ongoing": list(entry["ongoing"])}
+                    "ongoing": list(entry["ongoing"]),
+                    "version": entry.get("version", 0)}
 
     # ------------------------------------------------------ autoscaling
 
@@ -593,6 +743,7 @@ class ServeController:
             entry["replicas"] = entry["replicas"] + new
             entry["ongoing"] = entry["ongoing"] + [0] * len(new)
             entry["low_streak"] = 0
+            self._bump_version_locked(entry)
 
     def _scale_down(self, name: str, count: int):
         doomed = []
@@ -608,6 +759,8 @@ class ServeController:
                     doomed.append(entry["replicas"].pop(index))
                     entry["ongoing"].pop(index)
             entry["low_streak"] = 0
+            if doomed:
+                self._bump_version_locked(entry)
         for replica in doomed:
             # Drain before killing: client handles cache the replica set
             # for up to the refresh TTL, so an immediate kill would turn
@@ -617,7 +770,10 @@ class ServeController:
 
     def _drain_then_kill(self, replica):
         art = _art()
-        time.sleep(DeploymentHandle._REFRESH_TTL_S * 2 + 0.5)
+        # Handles learn about the shrink via the long-poll push within
+        # one round trip; a short grace covers requests already routed
+        # and listeners between poll windows.
+        time.sleep(2.0)
         deadline = time.monotonic() + 30
         while time.monotonic() < deadline:
             try:
@@ -665,6 +821,12 @@ class ServeController:
                     art.kill(r)
                 except Exception:  # noqa: BLE001
                     pass
+        with self._lock:
+            self._deployments.clear()
+            # Wake parked listeners: their deployments now read as
+            # deleted, so listener threads exit instead of waiting out
+            # the poll window against a dead controller.
+            self._version_cv.notify_all()
         if self._proxy is not None:
             try:
                 art.kill(self._proxy)
@@ -682,6 +844,12 @@ class HttpProxy:
         self._controller = controller
         self._port = None
         self._runner = None
+        # name -> DeploymentHandle: handles are long-lived (each owns a
+        # routing state kept fresh by its long-poll listener), so the
+        # proxy reuses one per deployment instead of re-resolving every
+        # request.
+        self._handles: dict[str, DeploymentHandle] = {}
+        self._handles_lock = threading.Lock()
 
     def start(self, port: int) -> int:
         import asyncio  # noqa: PLC0415
@@ -696,10 +864,17 @@ class HttpProxy:
             routes = art.get(self._controller.routes.remote())
             for prefix, name in routes.items():
                 if path.startswith(prefix):
-                    info = art.get(
-                        self._controller.get_handle_info.remote(name))
-                    return DeploymentHandle(name, info["replicas"],
-                                            controller=self._controller)
+                    with self._handles_lock:
+                        handle = self._handles.get(name)
+                        if handle is None:
+                            info = art.get(
+                                self._controller.get_handle_info.remote(
+                                    name))
+                            handle = DeploymentHandle(
+                                name, info["replicas"],
+                                controller=self._controller)
+                            self._handles[name] = handle
+                    return handle
             return None
 
         def dispatch(path: str, body):
@@ -792,9 +967,12 @@ def _get_or_create_controller():
     try:
         return art.get_actor(CONTROLLER_NAME, namespace="_serve")
     except ValueError:
+        # Generous concurrency: each handle family parks one blocking
+        # listen_for_change call here (ref: LongPollHost runs on the
+        # controller event loop; this threaded controller needs slots).
         controller_cls = art.remote(ServeController).options(
             name=CONTROLLER_NAME, namespace="_serve", get_if_exists=True,
-            max_concurrency=16, num_cpus=0, lifetime="detached")
+            max_concurrency=64, num_cpus=0, lifetime="detached")
         return controller_cls.remote()
 
 

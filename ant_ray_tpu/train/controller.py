@@ -67,7 +67,8 @@ class TrainWorker:
                            "single-process", e)
             return False
 
-    def run(self, loop_fn, loop_config, controller, latest_checkpoint):
+    def run(self, loop_fn, loop_config, controller, latest_checkpoint,
+            attempt: int = 0):
         ctx = TrainContext(
             world_rank=self._rank,
             world_size=self._world_size,
@@ -76,6 +77,7 @@ class TrainWorker:
             storage_path=self._storage_path,
             controller=controller,
             latest_checkpoint=latest_checkpoint,
+            attempt=attempt,
         )
         _set_context(ctx)
         try:
@@ -143,7 +145,7 @@ class TrainController:
                 self._scaling, art.available_resources(),
                 art.cluster_resources(), attempt=attempt)
             try:
-                self._run_worker_group(art, self_handle, world)
+                self._run_worker_group(art, self_handle, world, attempt)
                 return self._result(error=None)
             # RuntimeError covers gang-reservation failures (an
             # infeasible PG after a node died is an attempt, not a
@@ -167,7 +169,8 @@ class TrainController:
                            else 0.5)
         return self._result(error=last_error)
 
-    def _run_worker_group(self, art, self_handle, world: int | None = None):
+    def _run_worker_group(self, art, self_handle, world: int | None = None,
+                          attempt: int = 0):
         from ant_ray_tpu.api import remote  # noqa: PLC0415
 
         scaling = self._scaling
@@ -205,7 +208,7 @@ class TrainController:
             latest = self._ckpt_manager.latest
             run_refs = [
                 w.run.remote(self._loop_fn, self._loop_config,
-                             self_handle, latest)
+                             self_handle, latest, attempt)
                 for w in workers
             ]
             # Fail FAST on the first rank failure (ref: worker_group

@@ -18,6 +18,10 @@ class TrainContext:
     storage_path: str = ""
     controller: Any = None              # ActorHandle of the controller
     latest_checkpoint: Any = None
+    # Group-restart counter (0 on the first launch): lets user loops
+    # derive attempt-unique rendezvous names so a restarted gang never
+    # collides with its predecessor's collective group.
+    attempt: int = 0
     _report_lock: threading.Lock = field(default_factory=threading.Lock)
 
 

@@ -18,11 +18,21 @@ from ant_ray_tpu.autoscaler import (
 from ant_ray_tpu.cluster_utils import Cluster
 
 
+_live_providers: list = []
+
+
 @pytest.fixture()
 def head_cluster():
     cluster = Cluster(head_node_args={"num_cpus": 1})
     cluster.connect()
     yield cluster
+    # Tear down provider-launched daemons BEFORE the cluster: they are
+    # separate subprocesses the cluster teardown knows nothing about,
+    # and leaking them starves the (single-CPU) test machine.
+    for provider in _live_providers:
+        for pid in list(provider.non_terminated_nodes()):
+            provider.terminate_node(pid)
+    _live_providers.clear()
     art.shutdown()
     cluster.shutdown()
 
@@ -30,6 +40,7 @@ def head_cluster():
 def _make_autoscaler(cluster, node_types, **cfg):
     provider = LocalSubprocessProvider(cluster.gcs_address,
                                        cluster._session_dir)
+    _live_providers.append(provider)
     config = AutoscalerConfig(node_types=node_types, **cfg)
     return Autoscaler(cluster.gcs_address, provider, config), provider
 
@@ -238,10 +249,10 @@ def test_gang_demand_never_launches_mismatched_node(head_cluster):
     autoscaler.run_once()
 
     spg = slice_placement_group("4x4")   # needs TPU slice hosts
-    deadline = time.monotonic() + 8
+    deadline = time.monotonic() + 3
     while time.monotonic() < deadline:
         assert autoscaler.run_once()["launched"] == []
-        time.sleep(0.5)
+        time.sleep(0.4)
     assert provider.non_terminated_nodes() == {}
     spg.remove()
 

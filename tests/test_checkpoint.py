@@ -148,6 +148,7 @@ def test_save_load_roundtrip(tmp_path):
     _assert_trees_equal(params, loaded)
 
 
+@pytest.mark.slow
 def test_engine_loads_checkpoint_dir(tmp_path):
     """LLMEngine(model=<dir>) serves REAL weights end to end."""
     from safetensors.numpy import save_file

@@ -166,6 +166,7 @@ def test_reducescatter_minmax_multidevice(xla_group):
                                     _np.full((1, 4), 1.0))
 
 
+@pytest.mark.slow
 def test_xla_send_recv_across_actors(shutdown_only):
     """Host-level p2p through GCS KV mailboxes — the xla backend's
     send/recv (ref verbs: collective.py:601,664)."""

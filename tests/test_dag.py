@@ -211,6 +211,7 @@ def test_channel_compiled_beats_interpreted(cluster):
     art.kill(s2)
 
 
+@pytest.mark.slow
 def test_collective_allreduce_dag_nodes(cluster):
     """allreduce bound as DAG nodes: per-actor tensors reduce across the
     group when the graph executes (ref: experimental/collective/
@@ -263,6 +264,7 @@ def test_collective_bind_rejects_same_actor(cluster):
         dag_col.allreduce.bind([InputNode()])
 
 
+@pytest.mark.slow
 def test_collective_dag_reexecution_sees_fresh_state(cluster):
     """Re-executing a bound collective re-runs the op against current
     actor state (the ref cache is per-execution, not per-bind)."""

@@ -48,6 +48,7 @@ def test_causality(tiny_params):
     assert not np.allclose(np.asarray(l1[0, -1]), np.asarray(l2[0, -1]))
 
 
+@pytest.mark.slow
 def test_loss_and_grad_finite(tiny_params):
     batch = {"tokens": _tokens(seq=65)}
     loss, grads = jax.value_and_grad(llama.loss_fn)(tiny_params, batch, CFG)

@@ -37,6 +37,7 @@ def _truncate_at_eos(ids, eos=255):
     return out
 
 
+@pytest.mark.slow
 def test_kv_cache_matches_reference(params):
     engine = LLMEngine(CFG, params, slots=2, max_seq=128)
     prompt = [5, 9, 17, 3, 88, 41]
@@ -47,6 +48,7 @@ def test_kv_cache_matches_reference(params):
     assert len(out.token_ids) >= min(len(ref), 1)
 
 
+@pytest.mark.slow
 def test_continuous_batching_matches_sequential(params):
     prompts = [[1, 2, 3], [44, 55], [7, 8, 9, 10, 11]]
     n = 8
@@ -95,6 +97,7 @@ def test_prompt_longer_than_bucket(params):
     assert 1 <= len(out.token_ids) <= 4
 
 
+@pytest.mark.slow
 def test_serve_llm_deployment(shutdown_only):
     art.init(num_cpus=2)
     from ant_ray_tpu import serve
@@ -110,6 +113,7 @@ def test_serve_llm_deployment(shutdown_only):
     serve.shutdown()
 
 
+@pytest.mark.slow
 def test_batch_inference(shutdown_only):
     art.init(num_cpus=2)
     from ant_ray_tpu import data
@@ -125,6 +129,7 @@ def test_batch_inference(shutdown_only):
     assert all("generated_text" in row for row in out)
 
 
+@pytest.mark.slow
 def test_llm_sse_token_streaming(shutdown_only):
     """End-to-end token streaming: the SSE response yields its first
     token chunk before generation finishes (ref: serve streaming path +

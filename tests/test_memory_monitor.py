@@ -26,6 +26,7 @@ def test_used_fraction_parsing(tmp_path):
         str(tmp_path / "nope")) is None
 
 
+@pytest.mark.slow
 def test_oom_kill_retries_task(tmp_path, shutdown_only):
     """Under fake pressure the daemon kills the leased worker; the task
     retries and completes once pressure clears."""
@@ -56,6 +57,7 @@ def test_oom_kill_retries_task(tmp_path, shutdown_only):
     assert marker.read_text().count("x") >= 2   # it really died once
 
 
+@pytest.mark.slow
 def test_disk_full_node_rejects_new_leases():
     """FS monitor: a node over the disk-capacity threshold stops taking
     leases (ref: src/ray/common/file_system_monitor.h)."""

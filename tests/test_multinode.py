@@ -80,6 +80,7 @@ def test_cross_node_object_transfer(three_node_cluster):
     assert art.get(ref)[-1] == 999_999.0
 
 
+@pytest.mark.slow
 def test_node_death_marks_cluster_view(three_node_cluster):
     cluster = three_node_cluster
     victim = cluster.add_node(num_cpus=1, resources={"victim": 1})
@@ -97,6 +98,7 @@ def test_node_death_marks_cluster_view(three_node_cluster):
     pytest.fail("dead node never marked dead")
 
 
+@pytest.mark.slow
 def test_actor_on_dead_node_dies(three_node_cluster):
     cluster = three_node_cluster
     victim = cluster.add_node(num_cpus=1, resources={"victim": 1})

@@ -148,6 +148,7 @@ def test_gpipe_single_stage_degenerate():
 # ------------------------------------------------- flash kernel backward
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("nkv", [8, 4])
 def test_flash_backward_matches_reference(nkv):
     """dq/dk/dv from the pallas backward kernels (interpret mode on CPU)

@@ -39,6 +39,7 @@ def test_gae_matches_manual():
     assert np.isclose(ret[1, 0], expected_ret2, atol=1e-5)
 
 
+@pytest.mark.slow
 def test_ppo_learns_cartpole_inline():
     algo = PPOConfig().environment("CartPole-v1").env_runners(
         num_env_runners=2, num_envs_per_env_runner=8,
@@ -59,6 +60,7 @@ def test_ppo_learns_cartpole_inline():
     assert np.isfinite(result["learner"]["total_loss"])
 
 
+@pytest.mark.slow
 def test_env_runners_as_actors(shutdown_only):
     art.init(num_cpus=3)
     algo = PPOConfig().env_runners(
@@ -83,6 +85,7 @@ def test_checkpoint_roundtrip(tmp_path):
     assert restored._iteration == 1
 
 
+@pytest.mark.slow
 def test_custom_env_registration_reaches_actors(shutdown_only):
     art.init(num_cpus=3)
     from ant_ray_tpu.rllib import register_env
@@ -244,6 +247,7 @@ def test_impala_learns_cartpole_inline():
     assert best > first + 30, (first, best)
 
 
+@pytest.mark.slow
 def test_dqn_runners_as_actors(shutdown_only):
     from ant_ray_tpu.rllib import DQNConfig
 

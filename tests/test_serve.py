@@ -151,6 +151,7 @@ def test_batching_coalesces_requests(cluster):
     serve.shutdown()
 
 
+@pytest.mark.slow
 def test_autoscaling_follows_load(cluster):
     """Replica count rises under queued load and returns to min when
     idle (ref: serve/_private/autoscaling_state.py)."""
@@ -252,3 +253,57 @@ def test_model_multiplexing(cluster):
     assert out_a["pid"] in a_pids
     assert out_a["loads"] == 1  # loaded once, cached since
     serve.shutdown()
+
+
+@pytest.mark.slow
+def test_scale_up_pushed_to_handle_without_ttl(cluster):
+    """Long-poll push (ref: serve/_private/long_poll.py): a scale-up
+    must reach the HANDLE's routing state well inside the fallback TTL
+    — the controller pushes the new replica set, the handle never
+    polls for it."""
+    import threading as _threading
+    import time as _time
+
+    from ant_ray_tpu import serve
+    from ant_ray_tpu.serve.api import DeploymentHandle
+
+    assert DeploymentHandle._REFRESH_TTL_S >= 10, \
+        "fallback TTL must be long, or this test proves nothing"
+
+    @serve.deployment(name="pushy",
+                      autoscaling_config={"min_replicas": 1,
+                                          "max_replicas": 3,
+                                          "target_ongoing_requests": 1.0,
+                                          "downscale_patience": 2})
+    class Pushy:
+        def __call__(self, x):
+            _time.sleep(0.8)
+            return x
+
+    handle = serve.run(Pushy.bind())
+    assert len(handle._routing.replicas) == 1
+    handle.remote(0)                      # arm the listener
+    start = _time.monotonic()
+    stop = start + 8
+    def pump():
+        while _time.monotonic() < stop:
+            try:
+                art.get(handle.remote(1), timeout=30)
+            except Exception:
+                return
+    threads = [_threading.Thread(target=pump) for _ in range(5)]
+    for t in threads:
+        t.start()
+    observed_at = None
+    while _time.monotonic() < stop:
+        if len(handle._routing.replicas) >= 2:
+            observed_at = _time.monotonic() - start
+            break
+        _time.sleep(0.1)
+    for t in threads:
+        t.join()
+    assert observed_at is not None, \
+        "handle never observed the scale-up"
+    # Well inside the 30s fallback TTL -> it was pushed, not polled.
+    assert observed_at < DeploymentHandle._REFRESH_TTL_S / 2, \
+        f"scale-up took {observed_at:.1f}s to reach the handle"

@@ -117,6 +117,7 @@ def test_infeasible_label_selector_errors(two_slices):
         art.get(nowhere.remote(), timeout=60)
 
 
+@pytest.mark.slow
 def test_train_fit_on_fake_slice(two_slices, tmp_path_factory):
     """End-to-end: JaxTrainer gang-places its rank actors INSIDE the
     slice bundles (rank i on slice host i) and completes a run — the

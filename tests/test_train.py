@@ -60,6 +60,7 @@ def test_multi_worker_ranks(cluster, tmp_path_factory):
     assert result.metrics["world"] == 2
 
 
+@pytest.mark.slow
 def test_checkpoint_roundtrip(cluster, tmp_path_factory):
     def loop(config):
         params = {"w": np.arange(4.0), "step": np.asarray(7)}
@@ -77,6 +78,7 @@ def test_checkpoint_roundtrip(cluster, tmp_path_factory):
     assert int(restored["step"]) == 7
 
 
+@pytest.mark.slow
 def test_failure_recovery_resumes_from_checkpoint(cluster,
                                                   tmp_path_factory):
     marker_dir = str(tmp_path_factory.mktemp("marker"))
@@ -124,6 +126,7 @@ def test_failure_exhausted_raises(cluster, tmp_path_factory):
         trainer.fit()
 
 
+@pytest.mark.slow
 def test_train_tiny_llama_e2e(cluster, tmp_path_factory):
     """End-to-end: the JaxTrainer driving a real (tiny) llama training
     loop on the virtual mesh inside a worker actor."""

@@ -25,6 +25,7 @@ def shutdown_only():
 
 
 
+@pytest.mark.slow
 def test_elastic_downscale_after_node_loss(shutdown_only,
                                            tmp_path_factory):
     """Node dies mid-run -> group restart launches with a smaller world

@@ -162,6 +162,7 @@ def test_pbt_exploits_checkpoint_and_mutates_config(cluster):
     assert all(r.metrics["loss"] < 5.0 for r in grid)
 
 
+@pytest.mark.slow
 def test_tpe_searcher_beats_random_on_quadratic(cluster):
     space = {"x": tune.uniform(-10.0, 10.0)}
 

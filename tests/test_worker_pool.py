@@ -1,0 +1,30 @@
+"""Worker-pool spawn discipline (ref: worker_pool.h capped starts)."""
+
+import os
+import time
+
+import ant_ray_tpu as art
+
+
+def test_task_burst_spawns_bounded_workers(tmp_path):
+    """A burst of queued tasks must not fork a process storm: spawns are
+    capped by the worker pool even while many leases race (regression:
+    check-then-spawn overshoot spawning 15 workers on a 4-CPU node)."""
+    art.init(num_cpus=2)
+    try:
+        @art.remote
+        def tick(i):
+            time.sleep(0.05)
+            return i
+
+        assert art.get([tick.remote(i) for i in range(16)],
+                       timeout=120) == list(range(16))
+        from ant_ray_tpu.api import global_worker
+
+        logs = os.path.join(global_worker.runtime.session_dir, "logs")
+        spawned = [f for f in os.listdir(logs)
+                   if f.startswith("worker-")]
+        assert len(spawned) <= 2 + 2, \
+            f"burst spawned {len(spawned)} workers on a 2-CPU node"
+    finally:
+        art.shutdown()
