@@ -47,17 +47,18 @@ class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  store_path: str | None = None):
         from ant_ray_tpu._private.store_client import (  # noqa: PLC0415
-            InMemoryStoreClient,
-            SqliteStoreClient,
+            store_client_for,
         )
 
         # Write-through persistence (ref: gcs store clients,
         # src/ray/gcs/store_client/redis_store_client.h): with a store
-        # path, every table mutation lands in sqlite and a restarted
+        # spec, every table mutation lands in the store and a restarted
         # head (same port + store) resumes the cluster — actors stay
         # callable, PGs stay reserved, nodes resync via heartbeats.
-        self._store = (SqliteStoreClient(store_path) if store_path
-                       else InMemoryStoreClient())
+        # ``art-store://host:port`` targets the RPC'd store service
+        # (store_server.py), which lives OFF this machine so a standby
+        # head anywhere can restore the tables (shared-store HA).
+        self._store = store_client_for(store_path)
         self._durable = store_path is not None
         self._server = RpcServer(host, port)
         self._nodes: dict[NodeID, NodeInfo] = {}
@@ -301,6 +302,9 @@ class GcsServer:
         if flush_task is not None:
             flush_task.cancel()
             self._flush_locations()  # final batch before shutdown
+        # Drain the store's async write queue: acknowledged mutations
+        # must reach the (possibly remote) store before the head exits.
+        self._store.close()
         if graceful:
             self._server.stop()
             self._clients.close_all()

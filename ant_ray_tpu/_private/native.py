@@ -31,10 +31,18 @@ def _source_path() -> str:
 
 def _build_dir() -> str:
     src = _source_path()
-    with open(src, "rb") as f:
-        digest = hashlib.sha256(f.read()).hexdigest()[:16]
-    d = os.path.join(os.path.expanduser("~"), ".cache", "art_native",
-                     f"{digest}-py{sys.version_info[0]}{sys.version_info[1]}")
+    digest = hashlib.sha256()
+    for path in (src, os.path.join(os.path.dirname(src),
+                                   "channel_core.h")):
+        try:
+            with open(path, "rb") as f:
+                digest.update(f.read())
+        except FileNotFoundError:
+            pass
+    d = os.path.join(
+        os.path.expanduser("~"), ".cache", "art_native",
+        f"{digest.hexdigest()[:16]}"
+        f"-py{sys.version_info[0]}{sys.version_info[1]}")
     os.makedirs(d, exist_ok=True)
     return d
 
@@ -58,7 +66,8 @@ def load_native():
             tmp_path = f"{so_path}.tmp.{os.getpid()}"
             cmd = [
                 "g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-                f"-I{include}", src, "-o", tmp_path,
+                f"-I{include}", f"-I{os.path.dirname(src)}",
+                src, "-o", tmp_path,
             ]
             try:
                 subprocess.run(cmd, check=True, capture_output=True,

@@ -12,6 +12,7 @@ The HA leader selector points standby heads at the same file.
 
 from __future__ import annotations
 
+import logging
 import os
 import sqlite3
 import threading
@@ -107,3 +108,108 @@ class SqliteStoreClient(StoreClient):
     def close(self):
         with self._lock:
             self._conn.close()
+
+
+class RemoteStoreClient(StoreClient):
+    """Store client over the RPC'd store service (store_server.py) —
+    the shared-store HA backend: the head's tables live on another
+    machine, so a standby head anywhere can restore them (ref:
+    src/ray/gcs/store_client/redis_store_client.h).
+
+    Address form: ``art-store://host:port`` (or bare ``host:port``).
+    Calls are synchronous with small retries — table writes are on the
+    GCS mutation path, where the reference accepts the same Redis RTT.
+    """
+
+    def __init__(self, address: str):
+        import asyncio
+
+        from ant_ray_tpu._private.protocol import ClientPool
+
+        self._asyncio = asyncio
+        self.address = address.removeprefix("art-store://")
+        self._client = ClientPool().get(self.address)
+        # Ordered async write-through: GCS table mutations happen ON
+        # the io loop, where a blocking round trip would deadlock the
+        # loop against itself.  A single drainer task sends the queue
+        # in order, retrying each write until it lands — so the store
+        # always holds a PREFIX of the mutation history even across
+        # store-server blips (the reference's async Redis write-through
+        # with callback retries, redis_store_client.h).
+        self._writes: asyncio.Queue | None = None
+        self._drainer = None
+
+    async def _drain_writes(self):
+        while True:
+            item = await self._writes.get()
+            if item is None:
+                return
+            method, payload = item
+            delay = 0.05
+            while True:
+                try:
+                    await self._client.call_async(method, payload,
+                                                  timeout=10)
+                    break
+                except Exception as e:  # noqa: BLE001 — store blip
+                    logging.getLogger(__name__).warning(
+                        "store write %s retrying: %s", method, e)
+                    await self._asyncio.sleep(delay)
+                    delay = min(delay * 2, 2.0)
+
+    def _submit_write(self, method: str, payload: dict) -> None:
+        loop = self._client._io.loop
+
+        def _enqueue():
+            if self._writes is None:
+                self._writes = self._asyncio.Queue()
+                self._drainer = self._asyncio.ensure_future(
+                    self._drain_writes())
+            self._writes.put_nowait((method, payload))
+
+        loop.call_soon_threadsafe(_enqueue)
+
+    def put(self, table, key, value):
+        self._submit_write("StorePut", {"table": table, "key": key,
+                                        "value": value})
+
+    def get(self, table, key):
+        return self._client.call("StoreGet",
+                                 {"table": table, "key": key}, retries=3)
+
+    def delete(self, table, key):
+        self._submit_write("StoreDelete",
+                           {"table": table, "key": key})
+
+    def load_table(self, table):
+        return self._client.call("StoreLoadTable", {"table": table},
+                                 retries=3)
+
+    def close(self):
+        """Drain queued writes (bounded) so an orderly head shutdown
+        leaves the store holding everything it acknowledged."""
+        import concurrent.futures
+
+        loop = self._client._io.loop
+
+        async def _flush():
+            if self._writes is None:
+                return
+            self._writes.put_nowait(None)
+            await self._drainer
+
+        try:
+            self._asyncio.run_coroutine_threadsafe(
+                _flush(), loop).result(timeout=5)
+        except (concurrent.futures.TimeoutError, Exception):  # noqa: BLE001
+            pass
+
+
+def store_client_for(spec: str | None) -> StoreClient:
+    """Resolve a store spec: None -> in-memory, ``art-store://...`` ->
+    remote service, anything else -> local sqlite path."""
+    if not spec:
+        return InMemoryStoreClient()
+    if spec.startswith("art-store://"):
+        return RemoteStoreClient(spec)
+    return SqliteStoreClient(spec)

@@ -124,6 +124,19 @@ class ShmChannel:
             self._reading = False
             self._ch.read_release()
 
+    def remove_reader(self) -> int:
+        """Reader-death recovery: a registered reader died without
+        releasing, so stop requiring its releases forever — the writer
+        side unwedges on the next publish attempt (ref: reader-failure
+        handling, experimental_mutable_object_manager.h:44).  Call once
+        per dead reader from whoever observed the death (the DAG driver
+        sees exec-loop actor deaths).  Returns the remaining reader
+        count."""
+        try:
+            return self._ch.remove_reader()
+        except ValueError as e:
+            raise ChannelClosedError(str(e)) from None
+
     # ------------------------------------------------------------ misc
 
     @property

@@ -6,11 +6,16 @@ redis_leader_selector.py:90 RedisBasedLeaderSelector): standby heads
 poll a lease; the holder renews it; a holder that misses renewals is
 fenced out by expiry and a standby takes over.
 
-The default backend is a shared-filesystem lease (atomic O_EXCL create
-+ mtime-based expiry + fencing token), which covers single-host HA
-tests and NFS deployments without a Redis dependency; the protocol —
-acquire / renew / expire / fence — matches the Redis variant, and a
-Redis backend can implement the same ABC where redis is available.
+Two backends share one lifecycle (:class:`_LeaseSelectorBase` —
+acquire / renew / fence / release):
+
+* :class:`FileBasedLeaderSelector` — shared-filesystem lease (atomic
+  O_EXCL create + mtime-based expiry), for single-host HA tests and
+  NFS deployments without any external service;
+* :class:`StoreBasedLeaderSelector` — compare-and-swap TTL lease on
+  the RPC'd store service (store_server.py), the cross-MACHINE
+  backend: the lease lives on a third party both heads reach, exactly
+  the Redis variant's role.
 """
 
 from __future__ import annotations
@@ -40,7 +45,74 @@ class HeadNodeLeaderSelector:
         raise NotImplementedError
 
 
-class FileBasedLeaderSelector(HeadNodeLeaderSelector):
+class _LeaseSelectorBase(HeadNodeLeaderSelector):
+    """Shared lease lifecycle: a poll thread tries to acquire while
+    standby and renews while leader; a failed renew means the lease was
+    usurped or the backend is unreachable — either way the holder can
+    no longer prove leadership and steps down (fencing).  Backends
+    implement ``_try_acquire`` / ``_renew`` / ``_release``."""
+
+    def __init__(self, *, holder_id: str | None = None,
+                 lease_ttl_s: float = 3.0, renew_period_s: float = 1.0):
+        self._holder = holder_id or f"head-{os.getpid()}"
+        self._token = uuid.uuid4().hex
+        self._ttl = lease_ttl_s
+        self._renew_period = renew_period_s
+        self._stop = threading.Event()
+        self._became_leader = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # Backend hooks -----------------------------------------------------
+
+    def _try_acquire(self) -> bool:
+        raise NotImplementedError
+
+    def _renew(self) -> bool:
+        raise NotImplementedError
+
+    def _release(self) -> None:
+        raise NotImplementedError
+
+    # Lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self.role == "leader":
+                if not self._renew():
+                    # Fenced (or the backend is gone): a leader that
+                    # cannot prove its lease must not act.
+                    self.role = "standby"
+                    self._became_leader.clear()
+            elif self._try_acquire():
+                self.role = "leader"
+                self._became_leader.set()
+            self._stop.wait(self._renew_period)
+
+    def wait_until_leader(self, timeout: float | None = None) -> bool:
+        return self._became_leader.wait(timeout)
+
+    def fencing_token(self) -> str:
+        return self._token
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        # Release the lease if we still hold it so standbys fail over
+        # immediately instead of waiting out the TTL.
+        try:
+            self._release()
+        except Exception:  # noqa: BLE001 — best effort
+            pass
+        self.role = "standby"
+        self._became_leader.clear()
+
+
+class FileBasedLeaderSelector(_LeaseSelectorBase):
     """Lease file on a shared filesystem.
 
     The lease is a JSON file {holder, token, renewed_at}, held by
@@ -56,14 +128,9 @@ class FileBasedLeaderSelector(HeadNodeLeaderSelector):
 
     def __init__(self, lease_path: str, *, holder_id: str | None = None,
                  lease_ttl_s: float = 3.0, renew_period_s: float = 1.0):
+        super().__init__(holder_id=holder_id, lease_ttl_s=lease_ttl_s,
+                         renew_period_s=renew_period_s)
         self._path = lease_path
-        self._holder = holder_id or f"head-{os.getpid()}"
-        self._token = uuid.uuid4().hex
-        self._ttl = lease_ttl_s
-        self._renew_period = renew_period_s
-        self._stop = threading.Event()
-        self._became_leader = threading.Event()
-        self._thread: threading.Thread | None = None
 
     # ---- lease file primitives
 
@@ -114,47 +181,66 @@ class FileBasedLeaderSelector(HeadNodeLeaderSelector):
             except OSError:
                 pass
 
-    # ---- lifecycle
+    def _renew(self) -> bool:
+        lease = self._read_lease()
+        if lease is None or lease.get("token") != self._token:
+            return False     # usurped while we slept: fenced
+        self._write_lease()
+        return True
 
-    def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, daemon=True)
-        self._thread.start()
-
-    def _run(self) -> None:
-        while not self._stop.is_set():
-            if self._try_acquire():
-                if self.role != "leader":
-                    self.role = "leader"
-                    self._became_leader.set()
-                self._stop.wait(self._renew_period)
-                if not self._stop.is_set():
-                    lease = self._read_lease()
-                    if lease is None or lease.get("token") != self._token:
-                        # we were fenced — step down
-                        self.role = "standby"
-                        self._became_leader.clear()
-                    else:
-                        self._write_lease()  # renew
-            else:
-                self.role = "standby"
-                self._stop.wait(self._renew_period)
-
-    def wait_until_leader(self, timeout: float | None = None) -> bool:
-        return self._became_leader.wait(timeout)
-
-    def fencing_token(self) -> str:
-        return self._token
-
-    def stop(self) -> None:
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-        # Release the lease if we still hold it so standbys fail over
-        # immediately instead of waiting out the TTL.
+    def _release(self) -> None:
         lease = self._read_lease()
         if lease is not None and lease.get("token") == self._token:
             try:
                 os.unlink(self._path)
             except FileNotFoundError:
                 pass
-        self.role = "standby"
+
+
+class StoreBasedLeaderSelector(_LeaseSelectorBase):
+    """Lease against the RPC'd store service (store_server.py) — the
+    cross-MACHINE election backend (capability mirror of the ant fork's
+    RedisBasedLeaderSelector, ha/redis_leader_selector.py:90: the lease
+    lives on a third party both heads can reach, so a standby on
+    another machine takes over when the primary stops renewing).
+
+    The store's LeaseAcquire/LeaseRenew are compare-and-swap on the
+    holder token, so a fenced ex-leader's renewals are rejected and it
+    steps down."""
+
+    _LEASE_NAME = "head-leader"
+
+    def __init__(self, store_address: str, *,
+                 holder_id: str | None = None,
+                 lease_ttl_s: float = 3.0, renew_period_s: float = 1.0):
+        from ant_ray_tpu._private.protocol import ClientPool
+
+        super().__init__(holder_id=holder_id, lease_ttl_s=lease_ttl_s,
+                         renew_period_s=renew_period_s)
+        self._client = ClientPool().get(
+            store_address.removeprefix("art-store://"))
+
+    def _try_acquire(self) -> bool:
+        try:
+            reply = self._client.call(
+                "LeaseAcquire",
+                {"name": self._LEASE_NAME, "holder": self._holder,
+                 "token": self._token, "ttl": self._ttl}, timeout=5)
+            return bool(reply.get("acquired"))
+        except Exception:  # noqa: BLE001 — store unreachable: stand by
+            return False
+
+    def _renew(self) -> bool:
+        try:
+            reply = self._client.call(
+                "LeaseRenew",
+                {"name": self._LEASE_NAME, "token": self._token,
+                 "ttl": self._ttl}, timeout=5)
+            return bool(reply.get("renewed"))
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _release(self) -> None:
+        self._client.call("LeaseRelease",
+                          {"name": self._LEASE_NAME,
+                           "token": self._token}, timeout=5)

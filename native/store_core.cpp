@@ -47,6 +47,8 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+#include "channel_core.h"
+
 namespace {
 
 constexpr uint64_t kMagic = 0x41525453484d3031ull;  // "ARTSHM01"
@@ -289,17 +291,11 @@ PyObject* arena_get_heap_start(Arena* self, void*) {
 
 // Header layout (all u64, 64-byte aligned block):
 //   magic, capacity, num_readers, closed, version, msg_len, readers_done
-struct ChannelHeader {
-  uint64_t magic;
-  uint64_t capacity;
-  uint64_t num_readers;
-  uint64_t closed;
-  uint64_t version;       // published generation; 0 = nothing written yet
-  uint64_t msg_len;       // payload bytes of the current version
-  uint64_t readers_done;  // readers that released the current version
-};
-
-constexpr uint64_t kChannelMagic = 0x415254434831ull;  // "ARTCH1"
+using art_channel::ChannelHeader;
+using art_channel::kChannelMagic;
+using art_channel::ch_load;
+using art_channel::ch_store;
+using art_channel::ch_add;
 
 struct Channel {
   PyObject_HEAD
@@ -311,41 +307,6 @@ struct Channel {
   ChannelHeader* header() { return reinterpret_cast<ChannelHeader*>(base); }
   uint8_t* payload() { return base + align_up(sizeof(ChannelHeader), kAlign); }
 };
-
-inline uint64_t ch_load(uint64_t* p) {
-  return __atomic_load_n(p, __ATOMIC_ACQUIRE);
-}
-inline void ch_store(uint64_t* p, uint64_t v) {
-  __atomic_store_n(p, v, __ATOMIC_RELEASE);
-}
-inline void ch_add(uint64_t* p, uint64_t v) {
-  __atomic_fetch_add(p, v, __ATOMIC_ACQ_REL);
-}
-
-// Spin with escalating sleep until `pred` returns true, the channel
-// closes, or the deadline passes.  Returns 0 ok, 1 closed, 2 timeout.
-// Runs WITHOUT the GIL; pred must touch only the mmap.
-template <typename Pred>
-int ch_wait(Channel* self, double timeout_s, Pred pred) {
-  struct timespec ts;
-  clock_gettime(CLOCK_MONOTONIC, &ts);
-  double deadline = ts.tv_sec + ts.tv_nsec * 1e-9 + timeout_s;
-  int spins = 0;
-  while (true) {
-    if (pred()) return 0;
-    if (ch_load(&self->header()->closed)) return 1;
-    clock_gettime(CLOCK_MONOTONIC, &ts);
-    if (timeout_s >= 0 && ts.tv_sec + ts.tv_nsec * 1e-9 > deadline)
-      return 2;
-    if (spins < 1024) {  // ~fast path: just yield the core
-      ++spins;
-      sched_yield();
-    } else {  // slow path: sleep 50us (latency floor for idle channels)
-      struct timespec req = {0, 50 * 1000};
-      nanosleep(&req, nullptr);
-    }
-  }
-}
 
 int channel_tp_init(PyObject* self_obj, PyObject* args, PyObject* kwargs) {
   Channel* self = reinterpret_cast<Channel*>(self_obj);
@@ -426,9 +387,7 @@ PyObject* channel_write_begin(Channel* self, PyObject* args) {
   }
   int rc;
   Py_BEGIN_ALLOW_THREADS
-  rc = ch_wait(self, timeout_s, [&] {
-    return ch_load(&h->readers_done) >= h->num_readers;
-  });
+  rc = art_channel::channel_writer_wait(h, timeout_s);
   Py_END_ALLOW_THREADS
   if (rc == 1) {
     PyErr_SetString(PyExc_ValueError, "channel is closed");
@@ -458,9 +417,7 @@ PyObject* channel_write_commit(Channel* self, PyObject* arg) {
     return nullptr;
   }
   self->pending_write = 0;
-  h->msg_len = nbytes;
-  ch_store(&h->readers_done, 0);
-  ch_add(&h->version, 1);  // publish
+  art_channel::channel_publish(h, nbytes);
   Py_RETURN_NONE;
 }
 
@@ -476,9 +433,7 @@ PyObject* channel_read_acquire(Channel* self, PyObject* args) {
   ChannelHeader* h = self->header();
   int rc;
   Py_BEGIN_ALLOW_THREADS
-  rc = ch_wait(self, timeout_s, [&] {
-    return ch_load(&h->version) > last_version;
-  });
+  rc = art_channel::channel_reader_wait(h, last_version, timeout_s);
   Py_END_ALLOW_THREADS
   if (rc == 1) {
     PyErr_SetString(PyExc_ValueError, "channel is closed");
@@ -499,8 +454,20 @@ PyObject* channel_read_release(Channel* self, PyObject*) {
     PyErr_SetString(PyExc_ValueError, "channel is closed");
     return nullptr;
   }
-  ch_add(&self->header()->readers_done, 1);
+  art_channel::channel_release(self->header());
   Py_RETURN_NONE;
+}
+
+PyObject* channel_remove_reader(Channel* self, PyObject*) {
+  if (self->base == nullptr) {
+    PyErr_SetString(PyExc_ValueError, "channel is closed");
+    return nullptr;
+  }
+  // Reader-death recovery (ref: mutable-object reader failure handling,
+  // experimental_mutable_object_manager.h): the control plane observed
+  // a reader die; the writer must stop waiting for its releases.
+  return PyLong_FromUnsignedLongLong(
+      art_channel::channel_remove_reader(self->header()));
 }
 
 PyObject* channel_close(Channel* self, PyObject*) {
@@ -548,6 +515,9 @@ PyMethodDef channel_methods[] = {
      "read_acquire(last_version, timeout=-1) -> (version, view) | None"},
     {"read_release", reinterpret_cast<PyCFunction>(channel_read_release),
      METH_NOARGS, "read_release() — done with the current version"},
+    {"remove_reader", reinterpret_cast<PyCFunction>(channel_remove_reader),
+     METH_NOARGS,
+     "remove_reader() -> remaining — a reader died; stop waiting for it"},
     {"close", reinterpret_cast<PyCFunction>(channel_close), METH_NOARGS,
      "set closed flag and unmap"},
     {nullptr, nullptr, 0, nullptr}};
