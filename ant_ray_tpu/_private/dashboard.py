@@ -307,6 +307,11 @@ def create_app(gcs_address: str, session_dir: str):
             return build_chrome_trace(events)
         return web.json_response(await _call(build))
 
+    async def index(_req):
+        from ant_ray_tpu._private.dashboard_ui import INDEX_HTML  # noqa: PLC0415
+
+        return web.Response(text=INDEX_HTML, content_type="text/html")
+
     async def metrics(_req):
         def build():
             series = gcs.call("MetricsGet", retries=3)
@@ -319,6 +324,36 @@ def create_app(gcs_address: str, session_dir: str):
                      i.alive for i in infos.values()),
                  "description": "alive nodes"},
             ]
+            # Per-node series, gathered from each daemon (role of the
+            # reference's per-node metrics agents,
+            # dashboard/agent.py:24 + _private/metrics_agent.py —
+            # redesigned: the node daemon exports its own gauges over
+            # RPC and the head scrapes, so there is no extra agent
+            # process per node).  Scrapes run in PARALLEL: a hung
+            # daemon costs one timeout, not one per node, keeping
+            # /metrics inside Prometheus's scrape window.
+            import concurrent.futures  # noqa: PLC0415
+
+            def scrape(info):
+                node_series = clients.get(info.address).call(
+                    "GetNodeMetrics", {}, timeout=5)
+                short = info.node_id.hex()[:12]
+                for entry in node_series:
+                    entry.setdefault("tags", {})["node_id"] = short
+                return node_series
+
+            alive = [i for i in infos.values() if i.alive]
+            with concurrent.futures.ThreadPoolExecutor(
+                    max_workers=min(16, max(1, len(alive)))) as pool:
+                for fut in [pool.submit(scrape, i) for i in alive]:
+                    try:
+                        builtin.extend(fut.result())
+                    except Exception:  # noqa: BLE001 — node mid-death
+                        continue
+            # The text format requires one contiguous group per metric
+            # family; per-node appends interleave families, so sort
+            # (stable: per-node order within a family is kept).
+            builtin.sort(key=lambda e: e["name"])
             for res, tot in total.items():
                 builtin.append({
                     "name": "art_cluster_resource_total", "type": "gauge",
@@ -365,6 +400,7 @@ def create_app(gcs_address: str, session_dir: str):
         return web.json_response({"stopped": bool(ok)})
 
     app = web.Application()
+    app.router.add_get("/", index)
     app.router.add_get("/api/nodes", nodes)
     app.router.add_get("/api/actors", actors)
     app.router.add_get("/api/placement_groups", pgs)

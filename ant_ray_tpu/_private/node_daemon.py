@@ -164,6 +164,7 @@ class NodeManager:
             "GetNodeInfo": self._get_node_info,
             "GetSyncStats": self._get_sync_stats,
             "GetStoreStats": self._get_store_stats,
+            "GetNodeMetrics": self._get_node_metrics,
             "GetTransferStats": self._get_transfer_stats,
             "ListLogs": self._list_logs,
             "ReadLog": self._read_log,
@@ -259,6 +260,52 @@ class NodeManager:
         return {"used": self.store.used,
                 "capacity": self.store.capacity,
                 "spilled": self.store.spilled_bytes}
+
+    async def _get_node_metrics(self, _payload):
+        """Per-node gauges for the head's /metrics aggregation (role of
+        the reference's per-node metrics agents, dashboard/agent.py:24 +
+        _private/metrics_agent.py — the daemon exports its own numbers
+        over RPC, no extra agent process per node)."""
+        series = [
+            ("art_node_store_used_bytes", self.store.used,
+             "object store bytes in use"),
+            ("art_node_store_capacity_bytes", self.store.capacity,
+             "object store capacity"),
+            ("art_node_store_spilled_bytes", self.store.spilled_bytes,
+             "bytes spilled to disk"),
+            ("art_node_workers", len(self._workers),
+             "registered workers"),
+            ("art_node_read_pins", len(self._pin_leases),
+             "objects held by read pins"),
+        ]
+        try:
+            load1 = os.getloadavg()[0]
+            series.append(("art_node_load1", load1, "1m load average"))
+        except OSError:  # pragma: no cover
+            pass
+        try:
+            with open("/proc/meminfo") as f:
+                mem = {}
+                for line in f:
+                    parts = line.split()
+                    if parts[0] in ("MemTotal:", "MemAvailable:"):
+                        mem[parts[0]] = int(parts[1]) * 1024
+            series.append(("art_node_mem_total_bytes",
+                           mem.get("MemTotal:", 0), "host memory"))
+            series.append(("art_node_mem_available_bytes",
+                           mem.get("MemAvailable:", 0),
+                           "host memory available"))
+        except OSError:  # pragma: no cover — non-Linux
+            pass
+        for key, value in self._available.items():
+            series.append(("art_node_resource_available",
+                           value, "available resource", {"resource": key}))
+        return [
+            {"name": name, "type": "gauge", "value": float(value),
+             "description": desc,
+             "tags": (extra[0] if extra else {})}
+            for name, value, desc, *extra in series
+        ]
 
     async def _heartbeat_loop(self):
         """Liveness heartbeat + versioned resource sync (ref:

@@ -215,3 +215,28 @@ def test_timeline_dashboard_endpoint(dashboard_cluster):
                   and t["name"].endswith("traced_for_dash")]
         time.sleep(0.3)
     assert slices
+
+
+def test_web_ui_served_at_root(dashboard_cluster):
+    """The dashboard serves its single-page UI at / (ref capability:
+    the reference's dashboard SPA, python/ray/dashboard/head.py:49)."""
+    with urllib.request.urlopen(dashboard_cluster + "/",
+                                timeout=10) as resp:
+        assert resp.headers.get_content_type() == "text/html"
+        html = resp.read().decode()
+    for marker in ("ant-ray-tpu", "/api/cluster_status", "/api/nodes",
+                   "/api/jobs", "overview"):
+        assert marker in html
+
+
+def test_per_node_metrics_in_prometheus(dashboard_cluster):
+    """Per-node gauges (store, workers, host memory) flow from each
+    daemon into the head's /metrics with node_id tags (role of the
+    reference's per-node metrics agents, dashboard/agent.py:24)."""
+    with urllib.request.urlopen(dashboard_cluster + "/metrics",
+                                timeout=10) as resp:
+        text = resp.read().decode()
+    assert "art_node_store_used_bytes{" in text
+    assert "art_node_store_capacity_bytes{" in text
+    assert 'node_id="' in text
+    assert "art_node_workers{" in text
