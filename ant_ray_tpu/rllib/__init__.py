@@ -9,11 +9,23 @@ TPU instead of torch DDP.
 
 Algorithm families (each a config-builder → ``build()`` → ``train()``):
 
-* **PPO** — clipped-surrogate on-policy (ref: rllib/algorithms/ppo/)
+* **PPO** — clipped-surrogate on-policy (ref: rllib/algorithms/ppo/);
+  scales its update over a :class:`LearnerGroup` with
+  ``config.learners(num_learners=N)``
+* **APPO** — async PPO: IMPALA collection + V-trace-corrected clipped
+  surrogate (ref: rllib/algorithms/appo/)
 * **DQN** — double-Q with uniform replay + target net
   (ref: rllib/algorithms/dqn/)
 * **IMPALA** — V-trace-corrected actor-critic
   (ref: rllib/algorithms/impala/)
+* **SAC** — discrete max-entropy off-policy with twin critics and a
+  learned temperature (ref: rllib/algorithms/sac/)
+* **BC** — behavior cloning from offline data
+  (ref: rllib/algorithms/bc/)
+
+Building blocks: :class:`RLModule` / :class:`RLModuleSpec` (the
+network unit, ref rl_module.py) and :class:`LearnerGroup` (DDP-style
+sharded-gradient learners, ref learner_group.py:101).
 """
 
 from ant_ray_tpu.rllib.algorithm import (
@@ -24,7 +36,20 @@ from ant_ray_tpu.rllib.algorithm import (
     IMPALAConfig,
     PPOConfig,
 )
+from ant_ray_tpu.rllib.appo import APPO, APPOConfig
+from ant_ray_tpu.rllib.bc import BC
 from ant_ray_tpu.rllib.env import CartPoleEnv, make_env, register_env
+from ant_ray_tpu.rllib.learner_group import Learner, LearnerGroup
+from ant_ray_tpu.rllib.rl_module import (
+    DiscretePolicyModule,
+    RLModule,
+    RLModuleSpec,
+    TwinQModule,
+)
+from ant_ray_tpu.rllib.sac import SAC, SACConfig
 
-__all__ = ["Algorithm", "CartPoleEnv", "DQN", "DQNConfig", "IMPALA",
-           "IMPALAConfig", "PPOConfig", "make_env", "register_env"]
+__all__ = ["APPO", "APPOConfig", "Algorithm", "BC", "CartPoleEnv",
+           "DQN", "DQNConfig", "DiscretePolicyModule", "IMPALA",
+           "IMPALAConfig", "Learner", "LearnerGroup", "PPOConfig",
+           "RLModule", "RLModuleSpec", "SAC", "SACConfig",
+           "TwinQModule", "make_env", "register_env"]

@@ -31,6 +31,9 @@ class PPOConfig:
     minibatch_size: int = 256
     hidden: int = 64
     seed: int = 0
+    # >1: updates run on a LearnerGroup of learner actors with gradient
+    # allreduce (ref: learner_group.py:101 DDP learners).
+    num_learners: int = 1
     extra: dict = field(default_factory=dict)
 
     # builder-style mutators (RLlib API shape)
@@ -59,6 +62,11 @@ class PPOConfig:
                 f"unknown training option(s) {unknown}; valid: "
                 f"{sorted(type(self).__dataclass_fields__)}")
         return replace(self, **kwargs)
+
+    def learners(self, *, num_learners: int) -> "PPOConfig":
+        """Scale the update across N learner actors (ref:
+        AlgorithmConfig.learners)."""
+        return replace(self, num_learners=num_learners)
 
     def build(self) -> "Algorithm":
         return Algorithm(self)
@@ -159,6 +167,37 @@ class Algorithm:
         self._iteration = 0
         self._rng = np.random.RandomState(config.seed)
 
+        # Multi-learner mode: the update runs on a LearnerGroup of
+        # actors with gradient allreduce; this driver only shuffles
+        # minibatches and syncs weights to the env runners.
+        self._learners = None
+        if getattr(config, "num_learners", 1) > 1:
+            from ant_ray_tpu.rllib.learner_group import LearnerGroup  # noqa: PLC0415
+            from ant_ray_tpu.rllib.rl_module import (  # noqa: PLC0415
+                DiscretePolicyModule,
+                RLModuleSpec,
+            )
+
+            clip = config.clip_param
+            vf_coeff = config.vf_loss_coeff
+            ent_coeff = config.entropy_coeff
+
+            def ppo_loss_builder(module, params, batch):
+                from ant_ray_tpu.rllib import ppo as _ppo  # noqa: PLC0415
+
+                return _ppo.ppo_loss(params, batch, clip=clip,
+                                     vf_coeff=vf_coeff,
+                                     ent_coeff=ent_coeff)
+
+            spec = RLModuleSpec(
+                DiscretePolicyModule, self._obs_dim, self._n_actions,
+                {"hidden": config.hidden, "value_head": True})
+            self._learners = LearnerGroup(
+                spec, ppo_loss_builder,
+                num_learners=config.num_learners,
+                lr=config.lr, seed=config.seed)
+            self.params = self._learners.get_weights()
+
         self._runners = self._make_runners()
 
     _runner_cls = _EnvRunner
@@ -217,10 +256,16 @@ class Algorithm:
                 idx = perm[lo:lo + cfg.minibatch_size]
                 if len(idx) < cfg.minibatch_size and n > cfg.minibatch_size:
                     continue  # ragged tail would recompile the step
+                if self._learners is not None:
+                    metrics = self._learners.update_from_batch(
+                        {k: v[idx] for k, v in flat.items()})
+                    continue
                 batch = {k: ppo.jnp.asarray(v[idx])
                          for k, v in flat.items()}
                 self.params, self._opt_state, metrics = self._update(
                     self.params, self._opt_state, batch)
+        if self._learners is not None:
+            self.params = self._learners.get_weights()
 
         episode_returns = [r for s in samples
                            for r in s["episode_returns"]]
@@ -243,6 +288,12 @@ class Algorithm:
     def set_weights(self, params):
         self.params = self._ppo.jax.tree.map(
             self._ppo.jnp.asarray, params)
+        if getattr(self, "_learners", None) is not None:
+            # The group holds the authoritative training copy — without
+            # this, the next train() would overwrite the new weights
+            # with the group's old ones.  (Optimizer moments reset on
+            # restore in multi-learner mode.)
+            self._learners.set_weights(params)
 
     def save(self, path: str):
         import pickle  # noqa: PLC0415
@@ -260,7 +311,7 @@ class Algorithm:
         with open(path, "rb") as f:
             state = pickle.load(f)
         algo = cls(state["config"])
-        algo.params = state["params"]
+        algo.set_weights(state["params"])  # reaches the LearnerGroup too
         algo._opt_state = state["opt_state"]
         algo._iteration = state["iteration"]
         return algo
@@ -268,6 +319,8 @@ class Algorithm:
     def stop(self):
         import ant_ray_tpu as art  # noqa: PLC0415
 
+        if getattr(self, "_learners", None) is not None:
+            self._learners.shutdown()
         if art.is_initialized():
             for r in self._runners:
                 try:
@@ -323,15 +376,20 @@ class _DQNRunner:
     def set_weights(self, params):
         self.params = params
 
-    def sample(self, epsilon: float) -> dict:
+    def _act(self, obs, epsilon: float) -> np.ndarray:
+        """Action-selection hook — subclasses (SAC) override this and
+        reuse the whole collection loop below."""
+        dqn = self._dqn
+        self._key, sub = dqn.jax.random.split(self._key)
+        return np.asarray(dqn.act(self.params, obs, sub, epsilon))
+
+    def sample(self, epsilon: float = 0.0) -> dict:
         """One fragment of flat transitions (T·N, ...)."""
-        dqn, cfg = self._dqn, self.config
-        T, N = cfg.rollout_fragment_length, cfg.num_envs_per_runner
+        cfg = self.config
+        T = cfg.rollout_fragment_length
         obs_l, act_l, rew_l, next_l, done_l = [], [], [], [], []
         for _ in range(T):
-            self._key, sub = dqn.jax.random.split(self._key)
-            actions = np.asarray(
-                dqn.act(self.params, self.obs, sub, epsilon))
+            actions = self._act(self.obs, epsilon)
             obs_l.append(self.obs)
             self.obs, reward, done, truncated, final_obs = \
                 self.env.step(actions)

@@ -1,0 +1,97 @@
+"""APPO: asynchronous PPO — IMPALA's stale-fragment collection with
+PPO's clipped surrogate computed on V-trace-corrected advantages
+(ref: rllib/algorithms/appo/ — "PPO loss + V-trace + async sampling").
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ant_ray_tpu._private.jax_utils import import_jax
+from ant_ray_tpu.rllib.algorithm import IMPALA, IMPALAConfig
+from ant_ray_tpu.rllib.impala import vtrace
+from ant_ray_tpu.rllib.ppo import policy_logits, value
+
+jax = import_jax()
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+
+def appo_loss(params, batch, *, gamma: float, clip: float,
+              vf_coeff: float, ent_coeff: float, clip_rho: float,
+              clip_c: float):
+    """Clipped surrogate against the BEHAVIOR policy's logp, advantages
+    from V-trace (ref: appo_torch_policy loss)."""
+    logits = policy_logits(params, batch["obs"])          # (T, N, A)
+    logp_all = jax.nn.log_softmax(logits)
+    target_logp = jnp.take_along_axis(
+        logp_all, batch["actions"][..., None], axis=-1)[..., 0]
+    values_tn = value(params, batch["obs"])
+    bootstrap = value(params, batch["bootstrap_obs"])
+
+    vs, pg_adv = vtrace(
+        batch["behavior_logp"], target_logp, batch["rewards"],
+        values_tn, bootstrap, batch["dones"],
+        gamma=gamma, clip_rho=clip_rho, clip_c=clip_c)
+    adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+
+    ratio = jnp.exp(target_logp - batch["behavior_logp"])
+    surrogate = jnp.minimum(
+        ratio * adv, jnp.clip(ratio, 1.0 - clip, 1.0 + clip) * adv)
+    pi_loss = -jnp.mean(surrogate)
+    vf_loss = 0.5 * jnp.mean((values_tn - vs) ** 2)
+    entropy = -jnp.mean(jnp.sum(jnp.exp(logp_all) * logp_all, axis=-1))
+    total = pi_loss + vf_coeff * vf_loss - ent_coeff * entropy
+    return total, {"pi_loss": pi_loss, "vf_loss": vf_loss,
+                   "entropy": entropy,
+                   "mean_ratio": jnp.mean(ratio)}
+
+
+def make_update_step(optimizer, *, gamma: float, clip: float,
+                     vf_coeff: float, ent_coeff: float,
+                     clip_rho: float, clip_c: float):
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            appo_loss, has_aux=True)(
+                params, batch, gamma=gamma, clip=clip,
+                vf_coeff=vf_coeff, ent_coeff=ent_coeff,
+                clip_rho=clip_rho, clip_c=clip_c)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, dict(metrics, total_loss=loss)
+
+    return step
+
+
+from dataclasses import dataclass  # noqa: E402
+
+
+@dataclass(frozen=True)
+class APPOConfig(IMPALAConfig):
+    """APPO config (ref: rllib/algorithms/appo/appo.py APPOConfig).
+
+    Must be a dataclass itself: without the decorator the inherited
+    __init__ would set instance attributes from the PARENT's field
+    defaults, silently shadowing the overrides below."""
+
+    clip_param: float = 0.3
+    num_sgd_iter: int = 4
+
+    def build(self) -> "APPO":
+        return APPO(self)
+
+
+class APPO(IMPALA):
+    """IMPALA collection + clipped-surrogate learner."""
+
+    def __init__(self, config: APPOConfig):
+        super().__init__(config)
+        # Replace the plain V-trace update with the clipped surrogate.
+        self._update = make_update_step(
+            self._optimizer, gamma=config.gamma,
+            clip=config.clip_param,
+            vf_coeff=config.vf_loss_coeff,
+            ent_coeff=config.entropy_coeff,
+            clip_rho=config.clip_rho_threshold,
+            clip_c=config.clip_c_threshold)

@@ -1,0 +1,67 @@
+"""BC (behavior cloning): supervised policy learning from offline
+(obs, action) data (ref: rllib/algorithms/bc/ — the simplest offline
+algorithm, and the catalog's exercise of the RLModule + LearnerGroup
+path: the loss is pure cross-entropy over a module's train forward).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ant_ray_tpu._private.jax_utils import import_jax
+from ant_ray_tpu.rllib.learner_group import LearnerGroup
+from ant_ray_tpu.rllib.rl_module import DiscretePolicyModule, RLModuleSpec
+
+jax = import_jax()
+import jax.numpy as jnp  # noqa: E402
+
+
+def bc_loss(module, params, batch):
+    """Negative log-likelihood of the dataset actions under the policy
+    (ref: bc_torch_policy loss)."""
+    out = module.forward_train(params, batch)
+    logp = jax.nn.log_softmax(out["logits"])
+    nll = -jnp.mean(logp[jnp.arange(batch["actions"].shape[0]),
+                         batch["actions"]])
+    accuracy = jnp.mean(
+        (jnp.argmax(out["logits"], axis=-1)
+         == batch["actions"]).astype(jnp.float32))
+    return nll, {"nll": nll, "accuracy": accuracy}
+
+
+class BC:
+    """Offline trainer: iterate minibatches of a fixed dataset through
+    a LearnerGroup (1..N learners with gradient allreduce)."""
+
+    def __init__(self, *, obs_dim: int, n_actions: int,
+                 hidden: int = 64, lr: float = 1e-3,
+                 num_learners: int = 1, seed: int = 0):
+        spec = RLModuleSpec(DiscretePolicyModule, obs_dim, n_actions,
+                            {"hidden": hidden})
+        self.learners = LearnerGroup(spec, bc_loss,
+                                     num_learners=num_learners,
+                                     lr=lr, seed=seed)
+        self._rng = np.random.RandomState(seed)
+        self._iteration = 0
+
+    def train_on_dataset(self, obs: np.ndarray, actions: np.ndarray, *,
+                         epochs: int = 1, minibatch_size: int = 128
+                         ) -> dict:
+        n = len(actions)
+        metrics: dict = {}
+        for _ in range(epochs):
+            perm = self._rng.permutation(n)
+            for lo in range(0, n, minibatch_size):
+                idx = perm[lo:lo + minibatch_size]
+                if len(idx) < minibatch_size and n > minibatch_size:
+                    continue
+                metrics = self.learners.update_from_batch(
+                    {"obs": obs[idx], "actions": actions[idx]})
+        self._iteration += 1
+        return {"training_iteration": self._iteration, **metrics}
+
+    def get_weights(self):
+        return self.learners.get_weights()
+
+    def stop(self):
+        self.learners.shutdown()

@@ -1,0 +1,166 @@
+"""LearnerGroup: data-parallel update over N learner actors with
+gradient allreduce (capability mirror of the reference's
+rllib/core/learner/learner_group.py:101 — the torch-DDP learner group
+becomes: each learner jits grad on its batch shard, gradients average
+across the group over the collective backend, every learner applies the
+identical update, so replicas never drift).
+
+Single-learner groups skip the actors entirely (RLlib local mode).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ant_ray_tpu.rllib.rl_module import RLModuleSpec
+
+
+class Learner:
+    """One learner replica: module params + optimizer + jitted
+    grad/apply (ref: rllib/core/learner/learner.py).  ``loss_builder``
+    is a PURE function (module, batch-of-jnp) -> (loss, metrics dict) —
+    shipped to the actor and closed over by the jit."""
+
+    def __init__(self, spec: RLModuleSpec, loss_builder, *,
+                 lr: float = 3e-4, seed: int = 0,
+                 world: int = 1, rank: int = 0, group_name: str = ""):
+        import optax
+
+        from ant_ray_tpu._private.jax_utils import import_jax
+
+        jax = import_jax()
+        self._jax = jax
+        self._jnp = jax.numpy
+        self.module = spec.build()
+        self.params = self.module.init_params(jax.random.PRNGKey(seed))
+        self._optimizer = optax.adam(lr)
+        self._opt_state = self._optimizer.init(self.params)
+        self._world = world
+        self._rank = rank
+        self._group = group_name
+        if world > 1:
+            from ant_ray_tpu.util import collective as col
+
+            col.init_collective_group(world, rank, backend="gloo",
+                                      group_name=group_name)
+            self._col = col
+        module = self.module
+
+        def grad_fn(params, batch):
+            def loss_of(p):
+                return loss_builder(module, p, batch)
+
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_of, has_aux=True)(params)
+            return grads, dict(metrics, total_loss=loss)
+
+        def apply_fn(params, opt_state, grads):
+            updates, opt_state = self._optimizer.update(
+                grads, opt_state, params)
+            import optax as _optax
+
+            return _optax.apply_updates(params, updates), opt_state
+
+        self._grad = jax.jit(grad_fn)
+        self._apply = jax.jit(apply_fn, donate_argnums=(0, 1))
+
+    def update(self, shard: dict) -> dict:
+        """Grad on my shard -> allreduce-mean across the group -> apply.
+        Every learner applies the same averaged gradient, so params stay
+        bit-identical across replicas (the DDP invariant)."""
+        jnp_batch = {k: self._jnp.asarray(v) for k, v in shard.items()}
+        grads, metrics = self._grad(self.params, jnp_batch)
+        if self._world > 1:
+            leaves, treedef = self._jax.tree.flatten(grads)
+            reduced = [np.asarray(self._col.allreduce(
+                np.asarray(leaf), group_name=self._group)) / self._world
+                for leaf in leaves]
+            grads = self._jax.tree.unflatten(treedef, reduced)
+        self.params, self._opt_state = self._apply(
+            self.params, self._opt_state, grads)
+        return {k: float(v) for k, v in metrics.items()}
+
+    def get_weights(self):
+        return self._jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, params):
+        self.params = self._jax.tree.map(self._jnp.asarray, params)
+
+
+class LearnerGroup:
+    """N learners as actors (or one inline) sharing every update
+    (ref: learner_group.py:101 — update_from_batch shards the batch,
+    learners allreduce gradients)."""
+
+    _seq = 0
+
+    def __init__(self, spec: RLModuleSpec, loss_builder, *,
+                 num_learners: int = 1, lr: float = 3e-4, seed: int = 0):
+        import ant_ray_tpu as art
+
+        self._num = max(1, num_learners)
+        if self._num == 1:
+            self._local = Learner(spec, loss_builder, lr=lr, seed=seed)
+            self._actors = []
+            return
+        if not art.is_initialized():
+            raise RuntimeError(
+                "num_learners > 1 needs a running cluster (art.init)")
+        LearnerGroup._seq += 1
+        group_name = f"learner-group-{LearnerGroup._seq}"
+        self._local = None
+        learner_cls = art.remote(Learner)
+        self._actors = [
+            learner_cls.remote(spec, loss_builder, lr=lr, seed=seed,
+                               world=self._num, rank=rank,
+                               group_name=group_name)
+            for rank in range(self._num)
+        ]
+        self._art = art
+
+    @property
+    def num_learners(self) -> int:
+        return self._num
+
+    def update_from_batch(self, batch: dict) -> dict:
+        """Shard the batch across learners; one synchronized update."""
+        if self._local is not None:
+            return self._local.update(batch)
+        n = len(next(iter(batch.values())))
+        if n < self._num:
+            raise ValueError(
+                f"batch of {n} rows cannot shard across "
+                f"{self._num} learners — an empty shard means NaN "
+                "gradients poisoning every replica; use fewer learners "
+                "or bigger minibatches")
+        bounds = [round(i * n / self._num) for i in range(self._num + 1)]
+        shards = [{k: v[bounds[i]:bounds[i + 1]]
+                   for k, v in batch.items()}
+                  for i in range(self._num)]
+        all_metrics = self._art.get(
+            [actor.update.remote(shard)
+             for actor, shard in zip(self._actors, shards)],
+            timeout=600)
+        return {k: float(np.mean([m[k] for m in all_metrics]))
+                for k in all_metrics[0]}
+
+    def get_weights(self):
+        if self._local is not None:
+            return self._local.get_weights()
+        return self._art.get(self._actors[0].get_weights.remote(),
+                             timeout=120)
+
+    def set_weights(self, params) -> None:
+        if self._local is not None:
+            self._local.set_weights(params)
+            return
+        self._art.get([a.set_weights.remote(params)
+                       for a in self._actors], timeout=120)
+
+    def shutdown(self) -> None:
+        for actor in self._actors:
+            try:
+                self._art.kill(actor)
+            except Exception:  # noqa: BLE001
+                pass
+        self._actors = []

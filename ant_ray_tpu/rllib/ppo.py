@@ -16,27 +16,35 @@ import jax.numpy as jnp  # noqa: E402
 import optax  # noqa: E402
 
 
+def dense_init(key, fan_in: int, fan_out: int):
+    """He-initialized dense layer params (shared by every MLP in the
+    catalog — ppo towers and rl_module modules alike)."""
+    w = jax.random.normal(key, (fan_in, fan_out), jnp.float32)
+    return {"w": w * np.sqrt(2.0 / fan_in),
+            "b": jnp.zeros((fan_out,), jnp.float32)}
+
+
+def mlp_forward(layers, x):
+    """tanh-MLP forward over a layer list (RLlib's default fcnet)."""
+    for layer in layers[:-1]:
+        x = jnp.tanh(x @ layer["w"] + layer["b"])
+    last = layers[-1]
+    return x @ last["w"] + last["b"]
+
+
+_mlp = mlp_forward  # internal alias, kept for existing call sites
+
+
 def init_policy(key, obs_dim: int, n_actions: int, hidden: int = 64):
     """Separate policy/value MLP towers (RLlib's default fcnet)."""
-    def dense(k, fan_in, fan_out):
-        w = jax.random.normal(k, (fan_in, fan_out), jnp.float32)
-        return {"w": w * np.sqrt(2.0 / fan_in),
-                "b": jnp.zeros((fan_out,), jnp.float32)}
-
     ks = jax.random.split(key, 6)
+    dense = dense_init
     return {
         "pi": [dense(ks[0], obs_dim, hidden), dense(ks[1], hidden, hidden),
                dense(ks[2], hidden, n_actions)],
         "vf": [dense(ks[3], obs_dim, hidden), dense(ks[4], hidden, hidden),
                dense(ks[5], hidden, 1)],
     }
-
-
-def _mlp(layers, x):
-    for layer in layers[:-1]:
-        x = jnp.tanh(x @ layer["w"] + layer["b"])
-    last = layers[-1]
-    return x @ last["w"] + last["b"]
 
 
 def policy_logits(params, obs):

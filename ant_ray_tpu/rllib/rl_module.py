@@ -1,0 +1,127 @@
+"""RLModule: the neural-network unit of an algorithm, separated from
+the training loop (capability mirror of the reference's RLModule API,
+ref: rllib/core/rl_module/rl_module.py — forward_inference /
+forward_exploration / forward_train as distinct entry points so the
+same module serves acting, sampling, and loss computation).
+
+TPU-first shape: a module is a pytree of params plus PURE forward
+functions — everything the learner jits closes over module functions,
+never over mutable objects, so one compiled step covers the whole
+update regardless of which module is plugged in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from ant_ray_tpu._private.jax_utils import import_jax
+
+jax = import_jax()
+import jax.numpy as jnp  # noqa: E402
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """Builder for an RLModule (ref: rl_module.RLModuleSpec): the
+    catalog entry an algorithm instantiates per learner/runner."""
+
+    module_class: type
+    obs_dim: int
+    n_actions: int
+    model_config: dict = field(default_factory=dict)
+
+    def build(self) -> "RLModule":
+        return self.module_class(self.obs_dim, self.n_actions,
+                                 **self.model_config)
+
+
+class RLModule:
+    """ABC: params live OUTSIDE the module (functional JAX style); the
+    module provides init + pure forwards."""
+
+    def __init__(self, obs_dim: int, n_actions: int, **model_config):
+        self.obs_dim = obs_dim
+        self.n_actions = n_actions
+        self.model_config = model_config
+
+    def init_params(self, key) -> Any:
+        raise NotImplementedError
+
+    def forward_inference(self, params, obs):
+        """Greedy action logits/values for serving (no exploration)."""
+        raise NotImplementedError
+
+    def forward_exploration(self, params, obs, key):
+        """(actions, aux) for sampling — stochastic."""
+        raise NotImplementedError
+
+    def forward_train(self, params, batch) -> dict:
+        """Tensors the loss needs (logits, values, q-values...)."""
+        raise NotImplementedError
+
+
+# One source of truth for the dense init + MLP forward: the ppo module
+# (so the RLModule path and the ppo/impala/dqn towers can never diverge).
+from ant_ray_tpu.rllib.ppo import dense_init as _dense  # noqa: E402
+from ant_ray_tpu.rllib.ppo import mlp_forward as _mlp  # noqa: E402
+
+
+class DiscretePolicyModule(RLModule):
+    """Default catalog module: tanh-MLP policy head over discrete
+    actions (the reference's fcnet default), with an optional value
+    head (``value_head=True``)."""
+
+    def init_params(self, key):
+        hidden = self.model_config.get("hidden", 64)
+        n_layers = 3
+        keys = jax.random.split(key, 2 * n_layers)
+        params = {"pi": [_dense(keys[0], self.obs_dim, hidden),
+                         _dense(keys[1], hidden, hidden),
+                         _dense(keys[2], hidden, self.n_actions)]}
+        if self.model_config.get("value_head"):
+            params["vf"] = [_dense(keys[3], self.obs_dim, hidden),
+                            _dense(keys[4], hidden, hidden),
+                            _dense(keys[5], hidden, 1)]
+        return params
+
+    def forward_inference(self, params, obs):
+        return _mlp(params["pi"], obs)
+
+    def forward_exploration(self, params, obs, key):
+        logits = self.forward_inference(params, obs)
+        actions = jax.random.categorical(key, logits)
+        logp = jax.nn.log_softmax(logits)[
+            jnp.arange(obs.shape[0]), actions]
+        return actions, {"logp": logp, "logits": logits}
+
+    def forward_train(self, params, batch):
+        out = {"logits": self.forward_inference(params, batch["obs"])}
+        if "vf" in params:
+            out["values"] = _mlp(params["vf"], batch["obs"])[..., 0]
+        return out
+
+
+class TwinQModule(RLModule):
+    """Twin Q-networks over discrete actions (SAC's critic pair,
+    ref: rllib/algorithms/sac/ — clipped double-Q)."""
+
+    def init_params(self, key):
+        hidden = self.model_config.get("hidden", 64)
+        keys = jax.random.split(key, 6)
+        def tower(ks):
+            return [_dense(ks[0], self.obs_dim, hidden),
+                    _dense(ks[1], hidden, hidden),
+                    _dense(ks[2], hidden, self.n_actions)]
+        return {"q1": tower(keys[:3]), "q2": tower(keys[3:])}
+
+    def forward_inference(self, params, obs):
+        return jnp.minimum(_mlp(params["q1"], obs),
+                           _mlp(params["q2"], obs))
+
+    def forward_train(self, params, batch):
+        obs = batch["obs"]
+        return {"q1": _mlp(params["q1"], obs),
+                "q2": _mlp(params["q2"], obs)}

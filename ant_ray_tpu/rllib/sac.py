@@ -1,0 +1,221 @@
+"""Discrete SAC: maximum-entropy off-policy actor-critic with twin
+critics, target networks, and learned temperature (ref:
+rllib/algorithms/sac/ — the torch policy/critic/alpha losses become one
+jitted update; the discrete variant follows Christodoulou 2019, the
+formulation RLlib's discrete-SAC path implements).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from ant_ray_tpu._private.jax_utils import import_jax
+from ant_ray_tpu.rllib.rl_module import (
+    DiscretePolicyModule,
+    RLModuleSpec,
+    TwinQModule,
+)
+
+jax = import_jax()
+import jax.numpy as jnp  # noqa: E402
+import optax  # noqa: E402
+
+
+def init_sac_params(key, obs_dim: int, n_actions: int, hidden: int = 64):
+    """policy + twin critics + target critics + log-temperature."""
+    k_pi, k_q = jax.random.split(key)
+    policy = DiscretePolicyModule(obs_dim, n_actions, hidden=hidden)
+    critics = TwinQModule(obs_dim, n_actions, hidden=hidden)
+    q_params = critics.init_params(k_q)
+    return {
+        "pi": policy.init_params(k_pi)["pi"],
+        "q": q_params,
+        "q_target": jax.tree.map(jnp.copy, q_params),
+        "log_alpha": jnp.zeros(()),
+    }, policy, critics
+
+
+def sac_losses(params, batch, policy, critics, *, gamma: float,
+               target_entropy: float):
+    """Critic + actor + temperature losses for discrete SAC
+    (expectations over the action distribution — no reparameterized
+    sampling needed in the discrete case)."""
+    obs, next_obs = batch["obs"], batch["next_obs"]
+    actions = batch["actions"]
+    alpha = jnp.exp(params["log_alpha"])
+
+    # ---- critic target: soft state value of the next state
+    next_logits = policy.forward_inference({"pi": params["pi"]}, next_obs)
+    next_logp = jax.nn.log_softmax(next_logits)
+    next_probs = jnp.exp(next_logp)
+    next_q = critics.forward_train(params["q_target"],
+                                   {"obs": next_obs})
+    next_q_min = jnp.minimum(next_q["q1"], next_q["q2"])
+    next_v = jnp.sum(next_probs * (next_q_min - alpha * next_logp),
+                     axis=-1)
+    target = jax.lax.stop_gradient(
+        batch["rewards"] + gamma * (1.0 - batch["dones"]) * next_v)
+
+    q_out = critics.forward_train(params["q"], {"obs": obs})
+    idx = jnp.arange(obs.shape[0])
+    q1_a = q_out["q1"][idx, actions]
+    q2_a = q_out["q2"][idx, actions]
+    critic_loss = 0.5 * (jnp.mean((q1_a - target) ** 2)
+                         + jnp.mean((q2_a - target) ** 2))
+
+    # ---- actor: minimize E_pi[alpha*logp - Q_min] (critics frozen;
+    # alpha detached — its OWN gradient comes only from alpha_loss,
+    # matching the reference's alpha.detach() in the actor term)
+    logits = policy.forward_inference({"pi": params["pi"]}, obs)
+    logp = jax.nn.log_softmax(logits)
+    probs = jnp.exp(logp)
+    q_min = jax.lax.stop_gradient(jnp.minimum(q_out["q1"], q_out["q2"]))
+    alpha_detached = jax.lax.stop_gradient(alpha)
+    actor_loss = jnp.mean(jnp.sum(
+        probs * (alpha_detached * logp - q_min), axis=-1))
+
+    # ---- temperature: match the target entropy
+    entropy = -jnp.sum(probs * logp, axis=-1)
+    alpha_loss = jnp.mean(params["log_alpha"] * jax.lax.stop_gradient(
+        entropy - target_entropy))
+
+    total = critic_loss + actor_loss + alpha_loss
+    return total, {"critic_loss": critic_loss, "actor_loss": actor_loss,
+                   "alpha_loss": alpha_loss, "alpha": alpha,
+                   "entropy": jnp.mean(entropy)}
+
+
+def make_update_step(optimizer, policy, critics, *, gamma: float,
+                     target_entropy: float, tau: float):
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        (loss, metrics), grads = jax.value_and_grad(
+            sac_losses, has_aux=True)(
+                params, batch, policy, critics, gamma=gamma,
+                target_entropy=target_entropy)
+        grads["q_target"] = jax.tree.map(jnp.zeros_like,
+                                         grads["q_target"])
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        # Polyak-average the critic into the target net.
+        params["q_target"] = jax.tree.map(
+            lambda t, s: (1.0 - tau) * t + tau * s,
+            params["q_target"], params["q"])
+        return params, opt_state, dict(metrics, total_loss=loss)
+
+    return step
+
+
+def act(policy, params, obs, key):
+    actions, _aux = policy.forward_exploration({"pi": params["pi"]},
+                                               obs, key)
+    return np.asarray(actions)
+
+
+# ------------------------------------------------------------- algorithm
+
+from dataclasses import dataclass  # noqa: E402
+
+from ant_ray_tpu.rllib.algorithm import Algorithm, PPOConfig  # noqa: E402
+
+
+@dataclass(frozen=True)
+class SACConfig(PPOConfig):
+    """Discrete-SAC config (ref: rllib/algorithms/sac/sac.py SACConfig;
+    PPO-only fields are inherited but unused)."""
+
+    lr: float = 3e-4
+    buffer_size: int = 50_000
+    train_batch_size: int = 128
+    num_updates_per_iteration: int = 32
+    learning_starts: int = 500
+    tau: float = 0.01
+    # target_entropy = coeff * log(n_actions) (RLlib's "auto" scaling)
+    target_entropy_coeff: float = 0.7
+
+    def build(self) -> "SAC":
+        return SAC(self)
+
+
+from ant_ray_tpu.rllib.algorithm import _DQNRunner  # noqa: E402
+
+
+class _SACRunner(_DQNRunner):
+    """Actor: _DQNRunner's transition-collection loop with actions
+    sampled FROM the stochastic policy (max-entropy exploration — no
+    epsilon schedule)."""
+
+    def __init__(self, config: "SACConfig", index: int, env_ctor=None):
+        super().__init__(config, index, env_ctor)
+        self._policy = DiscretePolicyModule(
+            self.env.obs_dim, self.env.n_actions, hidden=config.hidden)
+
+    def _act(self, obs, epsilon: float) -> np.ndarray:
+        del epsilon  # the policy's own entropy explores
+        self._key, sub = jax.random.split(self._key)
+        return act(self._policy, self.params, obs, sub)
+
+
+class SAC(Algorithm):
+    """Off-policy max-entropy learner over replayed transitions."""
+
+    _runner_cls = _SACRunner
+
+    def __init__(self, config: SACConfig):
+        from ant_ray_tpu.rllib import env as env_mod  # noqa: PLC0415
+        from ant_ray_tpu.rllib.dqn import ReplayBuffer  # noqa: PLC0415
+
+        self.config = config
+        probe = env_mod.make_env(config.env, num_envs=1)
+        self._obs_dim, self._n_actions = probe.obs_dim, probe.n_actions
+        key = jax.random.PRNGKey(config.seed)
+        self.params, self._policy, self._critics = init_sac_params(
+            key, self._obs_dim, self._n_actions, config.hidden)
+        self._optimizer = optax.adam(config.lr)
+        self._opt_state = self._optimizer.init(self.params)
+        target_entropy = (config.target_entropy_coeff
+                          * float(np.log(self._n_actions)))
+        self._update = make_update_step(
+            self._optimizer, self._policy, self._critics,
+            gamma=config.gamma, target_entropy=target_entropy,
+            tau=config.tau)
+        self._buffer = ReplayBuffer(config.buffer_size, self._obs_dim,
+                                    seed=config.seed)
+        self._iteration = 0
+        self._env_steps = 0
+        self._runners = self._make_runners()
+
+    def train(self) -> dict:
+        cfg = self.config
+        self._runner_call("set_weights", self.params)
+        samples = self._runner_call("sample")
+        for s in samples:
+            self._buffer.add_batch(s["obs"], s["actions"], s["rewards"],
+                                   s["next_obs"], s["dones"])
+            self._env_steps += len(s["actions"])
+        metrics = {}
+        if len(self._buffer) >= cfg.learning_starts:
+            for _ in range(cfg.num_updates_per_iteration):
+                batch = self._buffer.sample(cfg.train_batch_size)
+                jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+                self.params, self._opt_state, metrics = self._update(
+                    self.params, self._opt_state, jbatch)
+        episode_returns = [r for s in samples
+                           for r in s["episode_returns"]]
+        self._iteration += 1
+        return {
+            "training_iteration": self._iteration,
+            "episode_return_mean": (float(np.mean(episode_returns))
+                                    if episode_returns else float("nan")),
+            "num_episodes": len(episode_returns),
+            "num_env_steps_sampled": self._env_steps,
+            "learner": {k: float(v) for k, v in metrics.items()},
+        }
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, params):
+        self.params = jax.tree.map(jnp.asarray, params)
