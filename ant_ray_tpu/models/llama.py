@@ -335,6 +335,20 @@ def forward(params: dict, tokens, config: LlamaConfig, *, mesh=None,
         raise ValueError(f"unknown remat policy {remat!r}")
 
     x = params["embed"][tokens].astype(c.dtype)
+    # Staged reshard: first acknowledge the gather's TABLE-natural
+    # output sharding (embed dim carries the table's fsdp shards; batch
+    # keeps its dp shard — fsdp moves from batch to embed for one hop),
+    # then relayout to the activation spec.  One constraint straight to
+    # the target makes SPMD fall back to "involuntary full
+    # rematerialization" (replicate-everything) on the sp/tp meshes;
+    # the explicit intermediate lets it emit a plain all-gather +
+    # dynamic-slice.  Spec built directly: the logical rule table can't
+    # say "batch over dp only".
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec  # noqa: PLC0415
+
+        x = lax.with_sharding_constraint(
+            x, NamedSharding(mesh, PartitionSpec("dp", "sp", "fsdp")))
     x = constrain_act(x, ("batch", "seq", "embed"))
     x, kv = lax.scan(block, x, params["layers"])
     x = rmsnorm(x, params["norm_f"], c.norm_eps)
