@@ -117,6 +117,10 @@ class Config:
     # (fail-stop for orphans; GCS FT restarts return well inside it).
     # 0 disables.
     gcs_dead_exit_s: float = 60.0
+    # Hybrid (DEFAULT) scheduling: pack onto feasible nodes until their
+    # utilization passes this, then spread (ref:
+    # hybrid_scheduling_policy.h spread_threshold).
+    hybrid_pack_threshold: float = 0.5
 
     # Node-side virtual-cluster fencing verdicts are cached this long
     # before re-checking with the GCS (ant ref: virtual-cluster GC/TTL

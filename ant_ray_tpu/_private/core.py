@@ -53,6 +53,7 @@ from ant_ray_tpu._private.specs import (
     TaskSpec,
 )
 from ant_ray_tpu._private.task_options import ActorOptions, TaskOptions
+from ant_ray_tpu.util.scheduling_strategies import strategy_wire
 from ant_ray_tpu._private.worker import CoreRuntime
 from ant_ray_tpu.object_ref import ObjectRef, set_refcount_hook
 
@@ -92,6 +93,7 @@ class _SchedKeyState:
     runtime_env: Any
     label_selector: dict | None
     pg: tuple | None                  # (pg_id, bundle_index) if any
+    strategy: Any = None              # wire-form scheduling strategy
     queue: deque = field(default_factory=deque)  # (spec, pinned, attempt)
     workers: int = 0                  # granted leases currently draining
     busy: int = 0                     # of those, executing a task now
@@ -818,6 +820,8 @@ class ClusterRuntime(CoreRuntime):
                 options.placement_group_bundle_index, 0),
             runtime_env=self._package_runtime_env(options.runtime_env),
             label_selector=options.label_selector,
+            scheduling_strategy=strategy_wire(
+                options.scheduling_strategy),
         )
         if cfg.enable_insight:
             from ant_ray_tpu.util import insight  # noqa: PLC0415
@@ -901,12 +905,15 @@ class ClusterRuntime(CoreRuntime):
     def _sched_key(self, spec: TaskSpec) -> tuple:
         from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
 
+        strategy = spec.scheduling_strategy
         return (
             tuple(sorted(spec.resources.items())),
             renv.env_key(spec.runtime_env),
             tuple(sorted((spec.label_selector or {}).items())),
             (spec.placement_group_id, spec.placement_group_bundle_index)
             if spec.placement_group_id is not None else None,
+            (tuple(sorted(strategy.items()))
+             if isinstance(strategy, dict) else strategy),
         )
 
     def _enqueue_task(self, spec: TaskSpec, pinned, attempt: int):
@@ -920,7 +927,8 @@ class ClusterRuntime(CoreRuntime):
                 label_selector=spec.label_selector,
                 pg=((spec.placement_group_id,
                      spec.placement_group_bundle_index)
-                    if spec.placement_group_id is not None else None))
+                    if spec.placement_group_id is not None else None),
+                strategy=spec.scheduling_strategy)
             self._sched_states[key] = state
         state.queue.append((spec, pinned, attempt))
         state.wakeup.set()
@@ -979,7 +987,8 @@ class ClusterRuntime(CoreRuntime):
         lease_payload = {"resources": state.resources,
                          "runtime_env": state.runtime_env,
                          "job_id": self.job_id,
-                         "label_selector": state.label_selector}
+                         "label_selector": state.label_selector,
+                         "strategy": state.strategy}
         if state.pg is not None:
             node = await self._resolve_bundle_node(*state.pg)
             lease_payload["pg"] = state.pg
@@ -1011,6 +1020,11 @@ class ClusterRuntime(CoreRuntime):
                 return node, reply["granted"], reply["worker_id"]
             if "spill" in reply:
                 node = self._clients.get(reply["spill"])
+                if reply.get("routed"):
+                    # A strategy redirect already picked this target:
+                    # the next daemon serves it instead of re-running
+                    # the picker (which would ping-pong).
+                    lease_payload = dict(lease_payload, routed=True)
             elif "infeasible" in reply:
                 # With a live autoscaler the recorded demand may
                 # provision a node — wait and retry instead of failing
@@ -1195,7 +1209,8 @@ class ClusterRuntime(CoreRuntime):
         lease_payload = {"resources": spec.resources,
                          "runtime_env": spec.runtime_env,
                          "job_id": self.job_id,
-                         "label_selector": spec.label_selector}
+                         "label_selector": spec.label_selector,
+                         "strategy": spec.scheduling_strategy}
         if spec.placement_group_id is not None:
             node = await self._resolve_bundle_node(
                 spec.placement_group_id, spec.placement_group_bundle_index)
@@ -1235,6 +1250,8 @@ class ClusterRuntime(CoreRuntime):
                         pass
             elif "spill" in reply:
                 node = self._clients.get(reply["spill"])
+                if reply.get("routed"):
+                    lease_payload = dict(lease_payload, routed=True)
             elif "infeasible" in reply:
                 # With a live autoscaler the recorded demand may
                 # provision a node — wait and retry instead of failing
@@ -1567,6 +1584,8 @@ class ClusterRuntime(CoreRuntime):
                 options.placement_group_bundle_index, 0),
             runtime_env=self._package_runtime_env(options.runtime_env),
             label_selector=options.label_selector,
+            scheduling_strategy=strategy_wire(
+                options.scheduling_strategy),
         )
         reply = self._gcs.call("CreateActor", spec, retries=3)
         if "error" in reply:

@@ -67,6 +67,7 @@ class GcsServer:
         # node (ref: ray_syncer NodeState version tracking).  Absent
         # after a restart -> the node is commanded to resync.
         self._node_view_versions: dict[NodeID, int] = {}
+        self._spread_rr = 0       # SPREAD strategy round-robin cursor
         self._actors: dict[ActorID, ActorRecord] = {}
         self._named_actors: dict[tuple[str, str], ActorID] = {}
         self._kv: dict[str, bytes] = {}
@@ -688,10 +689,41 @@ class GcsServer:
             limit = 600.0 if self._has_live_autoscaler() else 30.0
             if time.monotonic() - start > limit:
                 break
+            strategy = getattr(spec, "scheduling_strategy", None)
             if spec.placement_group_id is not None:
                 node = self._pg_bundle_node(
                     spec.placement_group_id,
                     spec.placement_group_bundle_index)
+            elif strategy == "SPREAD":
+                node = self._pick_node_spread(
+                    placement,
+                    self._allowed_nodes_for_job(spec.job_id),
+                    spec.label_selector)
+            elif isinstance(strategy, dict) and \
+                    strategy.get("kind") == "node_affinity":
+                # The pin must still respect every fence the other
+                # placement paths enforce: virtual-cluster membership,
+                # label selector, and capacity feasibility.
+                allowed = self._allowed_nodes_for_job(spec.job_id)
+                node = next(
+                    (n for n in self._feasible_nodes(
+                        placement, False, allowed, spec.label_selector)
+                     if n.node_id.hex() == strategy["node_id"]), None)
+                if node is None and not strategy.get("soft"):
+                    record.state = ACTOR_DEAD
+                    record.death_reason = (
+                        "node-affinity target "
+                        f"{strategy['node_id'][:12]} is not alive, not "
+                        "in the job's virtual cluster, or cannot "
+                        "satisfy the actor's demand")
+                    record.state_event.set()
+                    self._save_actor(record)
+                    return
+                if node is None:       # soft: fall back to DEFAULT
+                    node = self._pick_node(
+                        placement,
+                        allowed=allowed,
+                        label_selector=spec.label_selector)
             else:
                 node = self._pick_node(
                     placement,
@@ -725,21 +757,11 @@ class GcsServer:
             return True
         return all(info.labels.get(k) == v for k, v in selector.items())
 
-    def _pick_node(self, resources: dict[str, float],
-                   by_available: bool = True,
-                   allowed: set | None = None,
-                   label_selector: dict | None = None) -> NodeInfo | None:
-        """Least-loaded feasible node (hybrid policy seed).
-
-        by_available=True matches against the (heartbeat-fed, possibly
-        stale) availability view; by_available=False against total
-        capacity — used to distinguish "busy right now" from "can never
-        run" (ref: ClusterResourceScheduler feasibility vs availability).
-        ``allowed`` restricts candidates (virtual-cluster membership);
-        ``label_selector`` restricts to nodes advertising those labels
-        (TPU generation / pod / worker-id).
-        """
-        best, best_score = None, -1.0
+    def _feasible_nodes(self, resources: dict[str, float],
+                        by_available: bool,
+                        allowed: set | None,
+                        label_selector: dict | None) -> list[NodeInfo]:
+        out = []
         for info in self._nodes.values():
             if not info.alive:
                 continue
@@ -752,12 +774,64 @@ class GcsServer:
             view = (info.available_resources if by_available
                     else info.total_resources)
             if all(view.get(k, 0.0) >= v for k, v in resources.items()):
-                total = sum(info.total_resources.values()) or 1.0
-                free = sum(info.available_resources.values())
-                score = free / total
-                if score > best_score:
-                    best, best_score = info, score
-        return best
+                out.append(info)
+        return out
+
+    @staticmethod
+    def _utilization(info: NodeInfo) -> float:
+        total = sum(info.total_resources.values()) or 1.0
+        free = sum(info.available_resources.values())
+        return 1.0 - free / total
+
+    def _pick_node(self, resources: dict[str, float],
+                   by_available: bool = True,
+                   allowed: set | None = None,
+                   label_selector: dict | None = None) -> NodeInfo | None:
+        """Hybrid pack/spread policy (ref:
+        src/ray/raylet/scheduling/policy/hybrid_scheduling_policy.h —
+        the reference's DEFAULT): prefer the BUSIEST feasible node
+        whose utilization stays under the threshold (packing keeps
+        small tasks off idle accelerator nodes and lets the autoscaler
+        drain them), and once every candidate is past the threshold,
+        spread to the least-utilized.
+
+        by_available=True matches against the (heartbeat-fed, possibly
+        stale) availability view; by_available=False against total
+        capacity — used to distinguish "busy right now" from "can never
+        run" (ref: ClusterResourceScheduler feasibility vs availability).
+        ``allowed`` restricts candidates (virtual-cluster membership);
+        ``label_selector`` restricts to nodes advertising those labels
+        (TPU generation / pod / worker-id).
+        """
+        candidates = self._feasible_nodes(resources, by_available,
+                                          allowed, label_selector)
+        if not candidates:
+            return None
+        threshold = global_config().hybrid_pack_threshold
+        under = [n for n in candidates
+                 if self._utilization(n) <= threshold]
+        if under:
+            # Pack: busiest first; node id tie-break for determinism.
+            return max(under, key=lambda n: (self._utilization(n),
+                                             n.node_id.hex()))
+        # All hot: spread to the least-utilized.
+        return min(candidates, key=lambda n: (self._utilization(n),
+                                              n.node_id.hex()))
+
+    def _pick_node_spread(self, resources, allowed,
+                          label_selector) -> NodeInfo | None:
+        """SPREAD policy: round-robin over feasible nodes (ref:
+        spread_scheduling_policy.h)."""
+        candidates = self._feasible_nodes(resources, True, allowed,
+                                          label_selector)
+        if not candidates:
+            candidates = self._feasible_nodes(resources, False, allowed,
+                                              label_selector)
+        if not candidates:
+            return None
+        candidates.sort(key=lambda n: n.node_id.hex())
+        self._spread_rr += 1
+        return candidates[self._spread_rr % len(candidates)]
 
     def _pg_bundle_node(self, pg_id, bundle_index: int) -> NodeInfo | None:
         record = self._placement_groups.get(pg_id)
@@ -1218,6 +1292,11 @@ class GcsServer:
         exclude = payload.get("exclude")
         selector = payload.get("label_selector")
         allowed = self._allowed_nodes_for_job(payload.get("job_id"))
+        if payload.get("strategy") == "SPREAD":
+            node = self._pick_node_spread(resources, allowed, selector)
+            if node is None:
+                self._record_demand(resources, selector)
+            return node
 
         def _excluding(by_available: bool) -> NodeInfo | None:
             node = self._pick_node(resources, by_available, allowed,

@@ -730,11 +730,61 @@ class NodeManager:
         pg_key = payload.get("pg")
         job_id = payload.get("job_id")
         selector = payload.get("label_selector")
+        strategy = payload.get("strategy")
+        # Hard node affinity: this lease must run HERE once routed —
+        # every redirect path below turns into an infeasible error
+        # instead of a spill that would break the pin.
+        pinned_here = (pg_key is None and isinstance(strategy, dict)
+                       and strategy.get("kind") == "node_affinity"
+                       and not strategy.get("soft")
+                       and strategy["node_id"] == self.node_id.hex())
+        # Strategy routing (ref: the raylet policy set,
+        # composite_scheduling_policy.h:33).  PG leases are exempt —
+        # the bundle reservation already placed them.  A lease that
+        # already followed a strategy redirect carries "routed" (set by
+        # the client on strategy spills) and is served where it landed —
+        # re-running the picker on every hop would ping-pong forever
+        # (the spread cursor advances per query, so it never returns
+        # the node currently asking).
+        if pg_key is None and strategy is not None and \
+                not payload.get("routed"):
+            if strategy == "SPREAD":
+                node = await gcs.call_async(
+                    "SelectNode",
+                    {"resources": demand, "job_id": job_id,
+                     "label_selector": selector,
+                     "strategy": "SPREAD"}, timeout=10)
+                if node is not None and node.node_id != self.node_id:
+                    return {"spill": node.address, "routed": True}
+                # self is the spread pick (or nothing feasible yet):
+                # serve locally below.
+            elif isinstance(strategy, dict) and \
+                    strategy.get("kind") == "node_affinity":
+                target_hex = strategy["node_id"]
+                if self.node_id.hex() != target_hex:
+                    infos = await gcs.call_async("GetAllNodes", {},
+                                                 timeout=10)
+                    target = next(
+                        (n for n in infos.values()
+                         if n.node_id.hex() == target_hex and n.alive),
+                        None)
+                    if target is not None:
+                        return {"spill": target.address, "routed": True}
+                    if not strategy.get("soft"):
+                        return {"infeasible": True,
+                                "reason": f"node-affinity target "
+                                          f"{target_hex[:12]} is not "
+                                          "alive"}
+                    # soft affinity on a dead node: DEFAULT placement.
         # A label-constrained lease on a non-matching node redirects
         # immediately (the GCS picks a matching node); PG leases are
         # exempt — the bundle was placed under the selector already.
         if pg_key is None and selector and not all(
                 self._labels.get(k) == v for k, v in selector.items()):
+            if pinned_here:
+                return {"infeasible": True,
+                        "reason": "node-affinity target does not match "
+                                  f"label selector {selector}"}
             node = await gcs.call_async(
                 "SelectNode", {"resources": demand, "job_id": job_id,
                                "exclude": self.node_id,
@@ -750,6 +800,10 @@ class NodeManager:
         # VC filter at creation time) is the authority.
         if pg_key is None and job_id is not None and \
                 not await self._job_allowed_here(job_id):
+            if pinned_here:
+                return {"infeasible": True,
+                        "reason": "node-affinity target is outside the "
+                                  "job's virtual cluster"}
             node = await gcs.call_async(
                 "SelectNode", {"resources": demand, "job_id": job_id,
                                "exclude": self.node_id,
@@ -807,6 +861,9 @@ class NodeManager:
                     pass
 
         if self._disk_full:
+            if pinned_here:
+                return {"infeasible": True,
+                        "reason": "node-affinity target is out of disk"}
             # Out-of-disk node: redirect rather than accept work that
             # would need spill/log space this node doesn't have
             # (ref: file_system_monitor.h "Out of disk" rejections).
@@ -821,6 +878,10 @@ class NodeManager:
                               "node can satisfy the request"}
 
         if not self._feasible(demand):
+            if pinned_here:
+                return {"infeasible": True,
+                        "reason": f"node-affinity target can never "
+                                  f"satisfy {demand}"}
             node = await gcs.call_async(
                 "SelectNode", {"resources": demand, "job_id": job_id,
                                "exclude": self.node_id,
@@ -853,12 +914,16 @@ class NodeManager:
                     worker.lease_resources = dict(demand)
                     return {"granted": worker.address,
                             "worker_id": worker.worker_id}
-            elif time.monotonic() > spill_deadline:
+            elif not pinned_here and time.monotonic() > spill_deadline:
                 node = await gcs.call_async(
                     "SelectNode",
                     {"resources": demand, "job_id": job_id,
                      "exclude": self.node_id,
-                     "label_selector": selector},
+                     "label_selector": selector,
+                     # A saturated SPREAD lease keeps spreading; routing
+                     # it with the default packer would concentrate it.
+                     "strategy": ("SPREAD" if strategy == "SPREAD"
+                                  else None)},
                     timeout=10)
                 if node is not None and node.node_id != self.node_id:
                     return {"spill": node.address}

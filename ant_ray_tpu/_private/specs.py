@@ -46,6 +46,10 @@ class TaskSpec:
     # Exact-match node-label constraint (ref: label_selector,
     # src/ray/common/scheduling/label_selector.h)
     label_selector: dict | None = None
+    # Wire form of the scheduling strategy (None = hybrid default,
+    # "SPREAD", or {"kind": "node_affinity", ...}; ref: the raylet
+    # policy set, composite_scheduling_policy.h:33)
+    scheduling_strategy: "dict | str | None" = None
 
     def __reduce__(self):
         # Positional-tuple pickling: the default dataclass path pickles
@@ -59,7 +63,7 @@ class TaskSpec:
             self.actor_id, self.method_name, self.sequence_no,
             self.concurrency_group, self.placement_group_id,
             self.placement_group_bundle_index, self.runtime_env,
-            self.label_selector))
+            self.label_selector, self.scheduling_strategy))
 
 
 @dataclass
@@ -85,6 +89,8 @@ class ActorSpec:
     placement_group_bundle_index: int = -1
     runtime_env: dict | None = None
     label_selector: dict | None = None
+    # Wire-form scheduling strategy (see TaskSpec.scheduling_strategy).
+    scheduling_strategy: "dict | str | None" = None
 
 
 @dataclass

@@ -1,0 +1,148 @@
+"""Scheduling-strategy tests: SPREAD round-robin, node affinity
+(hard + soft), and the hybrid pack/spread default (ref:
+src/ray/raylet/scheduling/policy/composite_scheduling_policy.h:33 and
+the reference's scheduling policy unit tests)."""
+
+import os
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu.util.scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    strategy_wire,
+)
+from ant_ray_tpu.cluster_utils import Cluster
+
+
+def test_strategy_wire_forms():
+    assert strategy_wire(None) is None
+    assert strategy_wire("DEFAULT") is None
+    assert strategy_wire("SPREAD") == "SPREAD"
+    wire = strategy_wire(NodeAffinitySchedulingStrategy("abc", soft=True))
+    assert wire == {"kind": "node_affinity", "node_id": "abc",
+                    "soft": True}
+    with pytest.raises(ValueError):
+        strategy_wire("BOGUS")
+
+
+@pytest.fixture()
+def three_nodes():
+    cluster = Cluster(head_node_args={"num_cpus": 4})
+    cluster.add_node(num_cpus=4)
+    cluster.add_node(num_cpus=4)
+    cluster.connect()
+    yield cluster
+    art.shutdown()
+    cluster.shutdown()
+
+
+def test_spread_uses_multiple_nodes(three_nodes):
+    """SPREAD tasks land across nodes even when one node could hold
+    them all (the DEFAULT packs; SPREAD must not)."""
+
+    @art.remote
+    def where():
+        time.sleep(0.4)            # overlap so leases can't all reuse
+        return os.environ["ART_NODE_ID"]
+
+    refs = [where.options(scheduling_strategy="SPREAD").remote()
+            for _ in range(6)]
+    nodes = set(art.get(refs, timeout=120))
+    assert len(nodes) >= 2, f"SPREAD stayed on {nodes}"
+
+
+def test_node_affinity_hard_pins(three_nodes):
+    """Hard affinity: every task lands on exactly the chosen node."""
+    target = art.nodes()[-1]["NodeID"]
+
+    @art.remote
+    def where():
+        return os.environ["ART_NODE_ID"]
+
+    strategy = NodeAffinitySchedulingStrategy(target)
+    out = art.get([where.options(scheduling_strategy=strategy).remote()
+                   for _ in range(4)], timeout=120)
+    assert set(out) == {target}
+
+
+def test_node_affinity_hard_dead_node_fails(three_nodes):
+    @art.remote
+    def where():
+        return os.environ["ART_NODE_ID"]
+
+    strategy = NodeAffinitySchedulingStrategy("f" * 32)
+    with pytest.raises(Exception, match="not alive|infeasible"):
+        art.get(where.options(scheduling_strategy=strategy).remote(),
+                timeout=60)
+
+
+def test_node_affinity_soft_falls_back(three_nodes):
+    @art.remote
+    def where():
+        return os.environ["ART_NODE_ID"]
+
+    strategy = NodeAffinitySchedulingStrategy("f" * 32, soft=True)
+    out = art.get(where.options(scheduling_strategy=strategy).remote(),
+                  timeout=60)
+    assert out                                    # ran somewhere
+
+
+def test_actor_spread_and_affinity(three_nodes):
+    @art.remote
+    class Where:
+        def node(self):
+            return os.environ["ART_NODE_ID"]
+
+    spread = [Where.options(scheduling_strategy="SPREAD").remote()
+              for _ in range(4)]
+    nodes = set(art.get([a.node.remote() for a in spread], timeout=120))
+    assert len(nodes) >= 2
+
+    target = art.nodes()[0]["NodeID"]
+    pinned = Where.options(scheduling_strategy=(
+        NodeAffinitySchedulingStrategy(target))).remote()
+    assert art.get(pinned.node.remote(), timeout=60) == target
+
+
+def test_hybrid_packs_under_threshold():
+    """Unit: the DEFAULT policy packs onto the busier feasible node
+    while it stays under the threshold, then spreads."""
+    from ant_ray_tpu._private.gcs import GcsServer
+    from ant_ray_tpu._private.ids import NodeID
+    from ant_ray_tpu._private.specs import NodeInfo
+
+    gcs = object.__new__(GcsServer)
+    gcs._nodes = {}
+    busy, idle = NodeID.from_random(), NodeID.from_random()
+    gcs._nodes[busy] = NodeInfo(
+        node_id=busy, address="a",
+        total_resources={"CPU": 10.0},
+        available_resources={"CPU": 7.0})          # 30% utilized
+    gcs._nodes[idle] = NodeInfo(
+        node_id=idle, address="b",
+        total_resources={"CPU": 10.0},
+        available_resources={"CPU": 10.0})         # idle
+    pick = gcs._pick_node({"CPU": 1.0})
+    assert pick.node_id == busy                    # pack
+
+    gcs._nodes[busy].available_resources = {"CPU": 2.0}  # 80% utilized
+    pick = gcs._pick_node({"CPU": 1.0})
+    assert pick.node_id == idle                    # past threshold: spread
+
+
+def test_single_spread_task_completes_promptly(three_nodes):
+    """Regression: ONE spread task must not ping-pong between nodes
+    (each hop re-running the advancing round-robin picker would never
+    grant it) — the routed flag parks it where the picker sent it."""
+
+    @art.remote
+    def quick():
+        return os.environ["ART_NODE_ID"]
+
+    start = time.monotonic()
+    out = art.get(quick.options(scheduling_strategy="SPREAD").remote(),
+                  timeout=30)
+    assert out
+    assert time.monotonic() - start < 15, "single SPREAD task stalled"
