@@ -1014,6 +1014,10 @@ class ClusterRuntime(CoreRuntime):
                 self._clients.invalidate(node.address)
                 node = (self._node if state.pg is None
                         else await self._resolve_bundle_node(*state.pg))
+                # Back on the home node the strategy must re-route from
+                # scratch — a stale routed flag would let a hard pin be
+                # served wherever we fell back to.
+                lease_payload.pop("routed", None)
                 await asyncio.sleep(min(0.1 * conn_failures, 2.0))
                 continue
             if "granted" in reply:

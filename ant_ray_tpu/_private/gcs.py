@@ -818,15 +818,20 @@ class GcsServer:
         return min(candidates, key=lambda n: (self._utilization(n),
                                               n.node_id.hex()))
 
-    def _pick_node_spread(self, resources, allowed,
-                          label_selector) -> NodeInfo | None:
+    def _pick_node_spread(self, resources, allowed, label_selector,
+                          exclude=None) -> NodeInfo | None:
         """SPREAD policy: round-robin over feasible nodes (ref:
-        spread_scheduling_policy.h)."""
+        spread_scheduling_policy.h).  ``exclude`` drops the saturated
+        requester (it asked to spill AWAY) unless it is the only
+        candidate."""
         candidates = self._feasible_nodes(resources, True, allowed,
                                           label_selector)
         if not candidates:
             candidates = self._feasible_nodes(resources, False, allowed,
                                               label_selector)
+        if exclude is not None and len(candidates) > 1:
+            candidates = [n for n in candidates
+                          if n.node_id != exclude]
         if not candidates:
             return None
         candidates.sort(key=lambda n: n.node_id.hex())
@@ -1293,7 +1298,8 @@ class GcsServer:
         selector = payload.get("label_selector")
         allowed = self._allowed_nodes_for_job(payload.get("job_id"))
         if payload.get("strategy") == "SPREAD":
-            node = self._pick_node_spread(resources, allowed, selector)
+            node = self._pick_node_spread(resources, allowed, selector,
+                                          exclude=exclude)
             if node is None:
                 self._record_demand(resources, selector)
             return node

@@ -653,11 +653,11 @@ class NodeManager:
                 raise RuntimeError(
                     f"runtime_env package {key} missing from GCS KV")
             renv.extract(key, blob, self._session_dir)
-        pip = wire.get("pip")
-        if pip:
-            # venv build is slow (subprocess pip) — off the event loop.
+        if any(wire.get(f) for f in ("pip", "uv", "conda", "container")):
+            # Env materialization is slow (subprocess pip/uv/conda) —
+            # off the event loop.
             await asyncio.get_running_loop().run_in_executor(
-                None, renv.ensure_venv, pip, self._session_dir)
+                None, renv.ensure_env_ready, wire, self._session_dir)
 
     async def _job_allowed_here(self, job_id) -> bool:
         """Virtual-cluster membership of this node for a job, cached

@@ -149,8 +149,9 @@ def test_runtime_env_validation():
     from ant_ray_tpu._private.runtime_env import validate
 
     validate({"pip": ["requests"]})  # supported since round 2
+    validate({"conda": {"name": "ml", "dependencies": []}})  # round 4
     with pytest.raises(ValueError, match="unsupported"):
-        validate({"conda": {"dependencies": []}})
+        validate({"docker_image": "x"})
     with pytest.raises(ValueError, match="str->str"):
         validate({"env_vars": {"A": 1}})
 

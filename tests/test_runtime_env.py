@@ -29,7 +29,7 @@ def _write_module(tmp_path, name, body):
 
 def test_validate_rejects_unknown_and_bad_shapes():
     with pytest.raises(ValueError, match="unsupported"):
-        renv.validate({"working_dir": ".", "container": {}})
+        renv.validate({"working_dir": ".", "bogus_field": {}})
     with pytest.raises(ValueError, match="py_modules"):
         renv.validate({"py_modules": "not-a-list"})
     with pytest.raises(ValueError, match="pip"):
@@ -128,3 +128,73 @@ def test_pip_venv_is_content_addressed(tmp_path):
     b = renv.venv_dir(["pkg==1.0"], session)
     c = renv.venv_dir(["pkg==2.0"], session)
     assert a == b and a != c
+
+
+def test_extended_env_validation():
+    renv.validate({"uv": ["einops"]})
+    renv.validate({"uv": {"packages": ["einops"]}})
+    renv.validate({"conda": "base"})
+    renv.validate({"conda": {"name": "x", "dependencies": ["pip"]}})
+    with pytest.raises(ValueError, match="name"):
+        renv.validate({"conda": {"dependencies": []}})
+    renv.validate({"container": {"image": "img:latest"}})
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        renv.validate({"pip": ["a"], "uv": ["b"]})
+    with pytest.raises(ValueError, match="conda"):
+        renv.validate({"conda": 7})
+    with pytest.raises(ValueError, match="container"):
+        renv.validate({"container": {}})
+
+
+def test_uv_venv_is_tool_tagged(tmp_path):
+    session = str(tmp_path)
+    assert renv.venv_dir(["p==1"], session, "uv") != \
+        renv.venv_dir(["p==1"], session, "pip")
+
+
+@pytest.mark.slow
+def test_uv_venv_workers_run_on_venv_interpreter(cluster, tmp_path):
+    """The uv builder produces the same env shape as pip: worker runs
+    on the venv interpreter with the requested package importable and
+    the base env stays clean (ref: runtime_env/uv.py)."""
+    import shutil
+
+    if shutil.which("uv") is None:
+        pytest.skip("uv binary unavailable")
+    wheel = _make_wheel(tmp_path)
+
+    @art.remote(runtime_env={"uv": [wheel]})
+    def use_wheel():
+        import sys
+        import artwheel
+        return artwheel.MAGIC, sys.prefix
+
+    magic, prefix = art.get(use_wheel.remote(), timeout=180)
+    assert magic == 777
+    assert "venvs" in prefix
+
+    @art.remote
+    def base_env():
+        try:
+            import artwheel  # noqa: F401
+            return "leaked"
+        except ImportError:
+            return "isolated"
+
+    assert art.get(base_env.remote()) == "isolated"
+
+
+def test_conda_unavailable_raises_clearly(cluster):
+    """Without conda on the node the task fails with an actionable
+    message, not a cryptic spawn error."""
+    import shutil
+
+    if shutil.which("conda") is not None:
+        pytest.skip("conda IS available here; the gated path is moot")
+
+    @art.remote(runtime_env={"conda": "someenv"})
+    def f():
+        return 1
+
+    with pytest.raises(Exception, match="conda"):
+        art.get(f.remote(), timeout=120)
