@@ -81,6 +81,19 @@ class Config:
     # LRU-evict unpinned objects when the store is this full.
     object_store_high_watermark: float = 0.8
 
+    # ---- data engine ----
+    # Max concurrent tasks per streaming-Data stage (map / split / merge).
+    data_inflight_tasks: int = 8
+    # Per-stage cap on estimated in-flight block bytes: further launches
+    # wait once the sum of known in-window block sizes passes it (ref:
+    # streaming executor backpressure policies,
+    # data/_internal/execution/backpressure_policy/).  0 disables.
+    data_inflight_bytes: int = 128 * 1024 * 1024
+    # Target output block size: size-aware repartition/shuffle pick
+    # their partition count from total bytes / this when the caller
+    # gives no explicit block count.
+    data_target_block_bytes: int = 32 * 1024 * 1024
+
     # ---- scheduling ----
     # Workers pre-started per node at boot (-1 = auto: min(2, num_cpus)).
     num_prestart_workers: int = -1

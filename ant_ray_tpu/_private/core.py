@@ -191,6 +191,7 @@ class ClusterRuntime(CoreRuntime):
         self.server.routes({
             "GetObject": self._handle_get_object,
             "GetObjectStatus": self._handle_get_object_status,
+            "GetObjectInfo": self._handle_get_object_info,
             "BorrowAdd": self._handle_borrow_add,
             "BorrowRemove": self._handle_borrow_remove,
             "ReconstructObject": self._handle_reconstruct_object,
@@ -589,6 +590,52 @@ class ClusterRuntime(CoreRuntime):
             return "unknown"
         return "ready" if entry[0] != "pending" else "pending"
 
+    async def _handle_get_object_info(self, payload):
+        """Status + payload size in one round trip — the Data engine's
+        byte-budgeted backpressure asks owners for completed block sizes
+        (ref: BlockMetadata.size_bytes driving the streaming executor's
+        resource manager, data/_internal/execution/resource_manager.py)."""
+        entry = self.memory.get_entry(payload["object_id"])
+        if entry is None:
+            return {"status": "unknown", "size": None}
+        if entry[0] == "pending":
+            return {"status": "pending", "size": None}
+        return {"status": "ready", "size": self._entry_nbytes(entry)}
+
+    @staticmethod
+    def _entry_nbytes(entry: tuple) -> int | None:
+        kind, value = entry
+        if kind == "plasma":
+            return value
+        try:
+            return (len(value) if isinstance(value, (bytes, bytearray,
+                                                     memoryview))
+                    else None)
+        except Exception:  # noqa: BLE001
+            return None
+
+    def object_sizes(self, refs) -> list:
+        """Best-effort payload size per ref (None when pending/unknown).
+        Owned refs answer from the memory store; borrowed refs ask the
+        owner.  Never blocks on a pending object."""
+        async def _one(ref: ObjectRef):
+            if self.memory.is_owned(ref.id):
+                entry = self.memory.get_entry(ref.id)
+                if entry is None or entry[0] == "pending":
+                    return None
+                return self._entry_nbytes(entry)
+            try:
+                info = await self._clients.get(ref.owner_address).call_async(
+                    "GetObjectInfo", {"object_id": ref.id}, timeout=5)
+            except Exception:  # noqa: BLE001 — owner unreachable: unknown
+                return None
+            return info.get("size")
+
+        async def _gather():
+            return await asyncio.gather(*[_one(r) for r in refs])
+
+        return self._io.run_coro(_gather())
+
     def _deserialize_payload(self, payload, pin_owner=None) -> Any:
         ser = serialization.SerializedObject.from_payload(
             payload, pin_owner=pin_owner)
@@ -752,25 +799,53 @@ class ClusterRuntime(CoreRuntime):
         return out
 
     def wait(self, refs, num_returns, timeout, fetch_local):
-        async def _status(ref: ObjectRef):
+        """Block until `num_returns` refs are terminal or `timeout`
+        elapses (ref: CoreWorker::Wait — a real blocking wait, not a
+        status poll; timeout=0 degrades to a poll).  Owned refs wait on
+        the in-process memory store; borrowed refs poll the owner with
+        backoff."""
+        async def _one_ready(ref: ObjectRef):
             if self.memory.is_owned(ref.id):
-                entry = self.memory.get_entry(ref.id)
-                return entry is not None and entry[0] != "pending"
+                await self.memory.wait_async(ref.id)
+                return
             owner = self._clients.get(ref.owner_address)
-            try:
-                status = await owner.call_async(
-                    "GetObjectStatus", {"object_id": ref.id}, timeout=5)
-            except Exception:  # noqa: BLE001 — owner gone counts as ready(err)
-                return True
-            return status == "ready"
+            delay = 0.005
+            while True:
+                try:
+                    status = await owner.call_async(
+                        "GetObjectStatus", {"object_id": ref.id}, timeout=5)
+                except Exception:  # noqa: BLE001 — owner gone: ready(err)
+                    return
+                if status != "pending":
+                    return
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 0.1)
 
         async def _gather():
-            return await asyncio.gather(*[_status(r) for r in refs])
+            futs = {asyncio.ensure_future(_one_ready(r)): i
+                    for i, r in enumerate(refs)}
+            pending = set(futs)
+            ready_idx: set[int] = set()
+            deadline = (None if timeout is None
+                        else self._io.loop.time() + timeout)
+            while pending and len(ready_idx) < num_returns:
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - self._io.loop.time()))
+                done, pending = await asyncio.wait(
+                    pending, timeout=remaining,
+                    return_when=asyncio.FIRST_COMPLETED)
+                for fut in done:
+                    ready_idx.add(futs[fut])
+                if not done and remaining is not None:
+                    break  # timed out
+            for fut in pending:
+                fut.cancel()
+            return ready_idx
 
         with self._blocked():
-            statuses = self._io.run_coro(_gather())
-        ready = [r for r, s in zip(refs, statuses) if s]
-        not_ready = [r for r, s in zip(refs, statuses) if not s]
+            ready_idx = self._io.run_coro(_gather())
+        ready = [r for i, r in enumerate(refs) if i in ready_idx]
+        not_ready = [r for i, r in enumerate(refs) if i not in ready_idx]
         return ready, not_ready
 
     def _blocked(self):

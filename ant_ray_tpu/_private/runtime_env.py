@@ -351,23 +351,31 @@ def conda_env_name(conda) -> str:
 _conda_python_cache: dict = {}
 
 
-def conda_python(conda) -> str:
-    """Interpreter of an EXISTING conda env (ref: runtime_env/conda.py
-    — named envs resolve to their prefix; yaml envs are created by
-    ensure_env_ready)."""
+def _conda_exe() -> str:
+    """The node's conda executable — the single place the
+    conda-not-installed error comes from."""
     import shutil  # noqa: PLC0415
-    import subprocess  # noqa: PLC0415
 
-    name = conda_env_name(conda)
-    cached = _conda_python_cache.get(name)
-    if cached is not None:
-        return cached
     exe = shutil.which("conda")
     if exe is None:
         raise RuntimeError(
             "runtime_env conda requires the conda executable on the "
             "node; it is not installed here (use pip/uv runtime envs, "
             "or install miniconda on every node)")
+    return exe
+
+
+def conda_python(conda) -> str:
+    """Interpreter of an EXISTING conda env (ref: runtime_env/conda.py
+    — named envs resolve to their prefix; yaml envs are created by
+    ensure_env_ready)."""
+    import subprocess  # noqa: PLC0415
+
+    name = conda_env_name(conda)
+    cached = _conda_python_cache.get(name)
+    if cached is not None:
+        return cached
+    exe = _conda_exe()
     proc = subprocess.run(
         [exe, "run", "-n", name, "python", "-c",
          "import sys; print(sys.executable)"],
@@ -432,11 +440,7 @@ def ensure_env_ready(wire: dict, session_dir: str) -> None:
     elif wire.get("conda"):
         conda = wire["conda"]
         if isinstance(conda, dict):
-            exe = shutil.which("conda")
-            if exe is None:
-                raise RuntimeError(
-                    "runtime_env conda requires the conda executable "
-                    "on the node; it is not installed here")
+            exe = _conda_exe()
             name = conda_env_name(conda)
             probe = subprocess.run(
                 [exe, "env", "list"], capture_output=True, text=True,

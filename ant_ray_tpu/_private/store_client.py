@@ -138,6 +138,7 @@ class RemoteStoreClient(StoreClient):
         # with callback retries, redis_store_client.h).
         self._writes: asyncio.Queue | None = None
         self._drainer = None
+        self._closed = False
 
     async def _drain_writes(self):
         while True:
@@ -145,6 +146,11 @@ class RemoteStoreClient(StoreClient):
             if item is None:
                 return
             method, payload = item
+            if method == "__fence__":
+                # Read barrier: every write enqueued before this fence
+                # has landed — release the waiting reader.
+                payload.set_result(None)
+                continue
             delay = 0.05
             while True:
                 try:
@@ -169,11 +175,41 @@ class RemoteStoreClient(StoreClient):
 
         loop.call_soon_threadsafe(_enqueue)
 
+    def _read_fence(self, timeout: float = 10.0) -> None:
+        """Read-your-writes: block until every write this client
+        enqueued so far has landed (a fence item through the ordered
+        queue).  Without it a get() racing a queued delete/put reads
+        the pre-write value."""
+        import concurrent.futures
+
+        fence: concurrent.futures.Future = concurrent.futures.Future()
+        loop = self._client._io.loop
+
+        def _enqueue():
+            # No queue yet = nothing was ever written; closed = the
+            # drainer is gone (close() flushed everything it will).
+            # Otherwise the fence must ride the queue even when it
+            # looks empty — the drainer pops an item *before* sending
+            # it, so emptiness does not mean the last write landed.
+            if self._writes is None or self._closed:
+                fence.set_result(None)
+                return
+            self._writes.put_nowait(("__fence__", fence))
+
+        loop.call_soon_threadsafe(_enqueue)
+        try:
+            fence.result(timeout)
+        except concurrent.futures.TimeoutError:
+            logging.getLogger(__name__).warning(
+                "store read fence timed out after %.0fs; reading "
+                "possibly-stale state", timeout)
+
     def put(self, table, key, value):
         self._submit_write("StorePut", {"table": table, "key": key,
                                         "value": value})
 
     def get(self, table, key):
+        self._read_fence()
         return self._client.call("StoreGet",
                                  {"table": table, "key": key}, retries=3)
 
@@ -182,6 +218,7 @@ class RemoteStoreClient(StoreClient):
                            {"table": table, "key": key})
 
     def load_table(self, table):
+        self._read_fence()
         return self._client.call("StoreLoadTable", {"table": table},
                                  retries=3)
 
@@ -191,12 +228,19 @@ class RemoteStoreClient(StoreClient):
         import concurrent.futures
 
         loop = self._client._io.loop
+        self._closed = True
 
         async def _flush():
             if self._writes is None:
                 return
             self._writes.put_nowait(None)
             await self._drainer
+            # Resolve any fence that raced in behind the shutdown
+            # sentinel so late readers don't stall out their timeout.
+            while not self._writes.empty():
+                method, payload = self._writes.get_nowait()
+                if method == "__fence__" and not payload.done():
+                    payload.set_result(None)
 
         try:
             self._asyncio.run_coroutine_threadsafe(

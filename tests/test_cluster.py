@@ -130,6 +130,29 @@ def test_wait(cluster):
     assert not_ready == [slow]
 
 
+def test_wait_blocks_until_ready(cluster):
+    """wait() is a real blocking wait (ref: CoreWorker::Wait), not a
+    status poll: it returns as soon as num_returns refs are terminal —
+    not immediately, not only at the timeout."""
+    @art.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    ref = sleepy.remote(0.8)
+    t0 = time.monotonic()
+    ready, not_ready = art.wait([ref], num_returns=1, timeout=30.0)
+    elapsed = time.monotonic() - t0
+    assert ready == [ref] and not not_ready
+    assert elapsed >= 0.5, f"wait returned in {elapsed:.3f}s — polled"
+    assert elapsed < 25.0, "wait only returned at its timeout"
+
+    # timeout=0 degrades to a poll on a pending ref.
+    pending_ref = sleepy.remote(5.0)
+    ready, not_ready = art.wait([pending_ref], num_returns=1, timeout=0)
+    assert not ready and not_ready == [pending_ref]
+
+
 def test_wait_num_returns_caps_ready(cluster):
     """num_returns bounds the ready list even when more refs are done, and
     the surplus stays in the continuation list (reference contract)."""

@@ -97,3 +97,33 @@ def test_serialize_jax_array():
     arr = jnp.arange(64, dtype=jnp.float32).reshape(8, 8)
     out = serialization.deserialize(serialization.serialize({"w": arr}))
     np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(arr))
+
+
+def test_task_spec_reduce_covers_every_field():
+    """TaskSpec.__reduce__ hand-lists its fields positionally for wire
+    speed; this guard fails the moment a field is added or reordered
+    without updating it (silently misassigned fields across the wire
+    otherwise)."""
+    import dataclasses
+
+    from ant_ray_tpu._private.ids import JobID, TaskID
+    from ant_ray_tpu._private.specs import TaskSpec
+
+    spec = TaskSpec(
+        task_id=TaskID.for_normal_task(JobID(b"\x01" * JobID.SIZE)),
+        function_id=b"f" * 8, function_name="fn", args_payload=b"args",
+        num_returns=2, owner_address="127.0.0.1:1", resources={"CPU": 1.0},
+        max_retries=3, retry_exceptions=False, actor_id=None,
+        method_name=None, sequence_no=7, concurrency_group=None,
+        placement_group_id=None, placement_group_bundle_index=-1,
+        runtime_env={"env_vars": {"A": "1"}}, label_selector={"k": "v"},
+        scheduling_strategy="SPREAD")
+    ctor, args = spec.__reduce__()
+    assert ctor is TaskSpec
+    expected = tuple(getattr(spec, f.name)
+                     for f in dataclasses.fields(TaskSpec))
+    assert args == expected, (
+        "__reduce__ tuple drifted from dataclass field order — update "
+        "TaskSpec.__reduce__ alongside the field change")
+    clone = ctor(*args)
+    assert clone == spec
