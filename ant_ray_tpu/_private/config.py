@@ -69,6 +69,10 @@ class Config:
     include_dashboard: bool = True
     # Emit flow-insight call-graph events (ant-fork util/insight).
     enable_insight: bool = False
+    # Stream worker stdout/stderr lines to the driver console via GCS
+    # pubsub (ref: log_monitor.py) — `print()` inside a task shows up
+    # on the driver as `(worker=.. pid=..) line`.
+    log_to_driver: bool = True
     # Task lifecycle events (submitted/started/finished) buffered per
     # process and batch-flushed to the GCS — feeds the Chrome-trace
     # timeline and the state API (ref: task_event_buffer.h).

@@ -333,11 +333,16 @@ class ClusterRuntime(CoreRuntime):
     # ------------------------------------------------------------ pubsub
 
     async def _pubsub_loop(self):
+        channels = ["actor_state"]
+        if self.role == "driver" and global_config().log_to_driver:
+            # Drivers also stream worker stdout/stderr lines (ref:
+            # log_monitor.py — `print()` in a task appears here).
+            channels.append("worker_logs")
         cursor = -1  # start from "now" — no interest in history
         while not self._shutdown:
             try:
                 reply = await self._gcs.call_async(
-                    "SubPoll", {"channels": ("actor_state",),
+                    "SubPoll", {"channels": tuple(channels),
                                 "cursor": cursor, "timeout": 25.0},
                     timeout=35)
             except asyncio.CancelledError:
@@ -357,6 +362,16 @@ class ClusterRuntime(CoreRuntime):
                     logger.exception("pubsub event handling failed")
 
     def _on_pubsub_event(self, channel: str, data: dict) -> None:
+        if channel == "worker_logs":
+            # Worker output → driver console, ray-style prefixes.
+            node = data.get("node", "?")
+            for entry in data.get("entries", ()):
+                prefix = f"(worker={entry.get('worker', '?')}" + (
+                    f" pid={entry['pid']}" if entry.get("pid") else "") + \
+                    f" node={node})"
+                for line in entry.get("lines", ()):
+                    print(f"{prefix} {line}", flush=True)
+            return
         if channel == "actor_state":
             state = self._actor_states.get(data["actor_id"])
             if state is None:
@@ -804,6 +819,18 @@ class ClusterRuntime(CoreRuntime):
         status poll; timeout=0 degrades to a poll).  Owned refs wait on
         the in-process memory store; borrowed refs poll the owner with
         backoff."""
+        async def _status_once(ref: ObjectRef) -> bool:
+            if self.memory.is_owned(ref.id):
+                entry = self.memory.get_entry(ref.id)
+                return entry is not None and entry[0] != "pending"
+            owner = self._clients.get(ref.owner_address)
+            try:
+                status = await owner.call_async(
+                    "GetObjectStatus", {"object_id": ref.id}, timeout=5)
+            except Exception:  # noqa: BLE001 — owner gone: ready(err)
+                return True
+            return status != "pending"
+
         async def _one_ready(ref: ObjectRef):
             if self.memory.is_owned(ref.id):
                 await self.memory.wait_async(ref.id)
@@ -822,6 +849,13 @@ class ClusterRuntime(CoreRuntime):
                 delay = min(delay * 2, 0.1)
 
         async def _gather():
+            if timeout is not None and timeout <= 0:
+                # Poll semantics: one status round for every ref (a
+                # borrowed ref's owner RPC still completes — timeout=0
+                # bounds *waiting*, not the status check itself).
+                statuses = await asyncio.gather(
+                    *[_status_once(r) for r in refs])
+                return {i for i, s in enumerate(statuses) if s}
             futs = {asyncio.ensure_future(_one_ready(r)): i
                     for i, r in enumerate(refs)}
             pending = set(futs)

@@ -155,6 +155,7 @@ class GcsServer:
             "TaskEventsAdd": self._task_events_add,
             "TaskEventsGet": self._task_events_get,
             "SubPoll": self._sub_poll,
+            "PublishLogs": self._publish_logs,
             "Shutdown": self._shutdown_rpc,
         })
         if self._durable:
@@ -334,6 +335,13 @@ class GcsServer:
                     self._pub_cond.notify_all()
 
             asyncio.ensure_future(_notify())
+
+    async def _publish_logs(self, payload):
+        """Fan worker stdout/stderr lines out to subscribed drivers
+        (ref: log_monitor.py → GCS pubsub — the mechanism behind
+        `print()` in a task appearing on the driver's console)."""
+        self._publish("worker_logs", payload)
+        return True
 
     async def _sub_poll(self, payload):
         """Long-poll subscription: blocks until events newer than the

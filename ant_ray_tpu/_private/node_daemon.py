@@ -14,6 +14,7 @@ import asyncio
 import logging
 import os
 import random
+import signal
 import subprocess
 import sys
 import time
@@ -28,6 +29,23 @@ from ant_ray_tpu._private.protocol import ClientPool, IoThread, RpcServer
 from ant_ray_tpu._private.specs import ACTOR_DEAD, ActorSpec, NodeInfo
 
 logger = logging.getLogger(__name__)
+
+
+def _enable_subreaper() -> bool:
+    """PR_SET_CHILD_SUBREAPER: a dead worker's user subprocesses
+    re-parent to this daemon instead of init, so they can be detected
+    and killed rather than leak (ref: src/ray/util/subreaper.h).
+    Linux-only; returns False where unavailable."""
+    if not sys.platform.startswith("linux"):
+        return False
+    try:
+        import ctypes  # noqa: PLC0415
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        PR_SET_CHILD_SUBREAPER = 36
+        return libc.prctl(PR_SET_CHILD_SUBREAPER, 1, 0, 0, 0) == 0
+    except Exception:  # noqa: BLE001 — best-effort hardening
+        return False
 
 
 class _HolderMiss(RuntimeError):
@@ -177,6 +195,10 @@ class NodeManager:
             self._heartbeat_loop(), self._io.loop))
         self._tasks.append(asyncio.run_coroutine_threadsafe(
             self._monitor_workers_loop(), self._io.loop))
+        if global_config().log_to_driver:
+            self._tasks.append(asyncio.run_coroutine_threadsafe(
+                self._log_stream_loop(), self._io.loop))
+        self._subreaper_enabled = _enable_subreaper()
         if global_config().fs_monitor_interval_s > 0:
             self._tasks.append(asyncio.run_coroutine_threadsafe(
                 self._fs_monitor_loop(), self._io.loop))
@@ -249,6 +271,63 @@ class NodeManager:
                     "eof": offset + len(data) >= size}
         except OSError as e:
             return {"error": str(e)}
+
+    async def _log_stream_loop(self):
+        """Tail worker logs and fan new USER lines out to drivers via
+        GCS pubsub (ref: log_monitor.py — `print()` inside a task shows
+        up on the driver console as `(worker=.. pid=..) line`).  System
+        lines (the worker's own `[worker ...]` logging format) stay in
+        the file but are not streamed."""
+        offsets: dict[str, int] = {}
+        gcs = self._clients.get(self._gcs_address)
+        logs_dir = self._logs_dir()
+        while not self._stopping:
+            await asyncio.sleep(0.25)
+            entries = []
+            try:
+                names = [n for n in os.listdir(logs_dir)
+                         if n.startswith("worker-") and n.endswith(".log")]
+            except OSError:
+                continue
+            for name in names:
+                path = os.path.join(logs_dir, name)
+                try:
+                    size = os.path.getsize(path)
+                    pos = offsets.get(name, 0)
+                    if size <= pos:
+                        continue
+                    with open(path, "rb") as f:
+                        f.seek(pos)
+                        chunk = f.read(min(size - pos, 1 << 20))
+                except OSError:
+                    continue
+                # keep any trailing partial line for the next pass —
+                # unless the read window is full and newline-free (one
+                # giant line): flush it as-is or the tail would re-read
+                # the same window forever.
+                cut = chunk.rfind(b"\n")
+                if cut < 0:
+                    if len(chunk) < (1 << 20):
+                        continue
+                    cut = len(chunk) - 1
+                offsets[name] = pos + cut + 1
+                short = name[len("worker-"):-len(".log")]
+                pid = next((h.proc.pid for h in self._workers.values()
+                            if h.worker_id.hex().startswith(short)), None)
+                lines = [ln.decode("utf-8", "replace")
+                         for ln in chunk[:cut].split(b"\n")
+                         if ln and not ln.startswith(b"[worker ")]
+                if lines:
+                    entries.append({"worker": short, "pid": pid,
+                                    "lines": lines})
+            if entries:
+                try:
+                    await gcs.call_async(
+                        "PublishLogs",
+                        {"node": self.node_id.hex()[:8],
+                         "entries": entries}, timeout=10)
+                except Exception:  # noqa: BLE001 — head restarting
+                    pass
 
     async def _get_node_info(self, _payload):
         return self._node_info()
@@ -427,6 +506,9 @@ class NodeManager:
             renv.ensure_framework_on_pythonpath(env)
         env["ART_NODE_ADDRESS"] = self.address
         env["ART_GCS_ADDRESS"] = self._gcs_address
+        # Worker stdout is a log file (block-buffered by default): run
+        # unbuffered so user print()s stream to the driver promptly.
+        env["PYTHONUNBUFFERED"] = "1"
         env["ART_STORE_DIR"] = self.store.directory
         env["ART_WORKER_ID"] = worker_id.hex()
         env["ART_NODE_ID"] = self.node_id.hex()
@@ -469,11 +551,16 @@ class NodeManager:
 
     async def _monitor_workers_loop(self):
         gcs = self._clients.get(self._gcs_address)
+        last_orphan_sweep = 0.0
         while not self._stopping:
             await asyncio.sleep(0.1)
             if self._retired_procs:
                 self._retired_procs = [p for p in self._retired_procs
                                        if p.poll() is None]
+            now = time.monotonic()
+            if self._subreaper_enabled and now - last_orphan_sweep > 2.0:
+                last_orphan_sweep = now
+                self._reap_orphans()
             for worker_id, handle in list(self._workers.items()):
                 if handle.proc.poll() is None:
                     continue
@@ -493,6 +580,49 @@ class NodeManager:
                     asyncio.ensure_future(self._report_worker_died(
                         gcs, worker_id, handle))
                 self._lease_event.set()
+
+    def _reap_orphans(self) -> None:
+        """Kill + reap grandchildren re-parented to this daemon by the
+        subreaper (a dead worker's user subprocesses).  Direct children
+        the daemon spawned itself (workers, runtime-env builds) share
+        its session or are registered — only processes from a *foreign*
+        session that aren't known workers are orphans (ref:
+        src/ray/util/subreaper.h kill-unknown-children policy)."""
+        known = {h.proc.pid for h in self._workers.values()}
+        known |= {p.pid for p in self._retired_procs}
+        my_pid = os.getpid()
+        try:
+            my_sid = os.getsid(0)
+        except OSError:
+            return
+        try:
+            candidates = [int(n) for n in os.listdir("/proc")
+                          if n.isdigit()]
+        except OSError:
+            return
+        for pid in candidates:
+            # NEVER waitpid(-1): reaping a known worker here would
+            # steal its exit status from Popen.poll() and turn every
+            # death reason into "exited with code 0".
+            if pid in known or pid == my_pid:
+                continue
+            try:
+                with open(f"/proc/{pid}/stat") as f:
+                    fields = f.read().rsplit(")", 1)[1].split()
+                state, ppid = fields[0], int(fields[1])
+                if ppid != my_pid:
+                    continue
+                if state == "Z":               # orphan already exited
+                    os.waitpid(pid, os.WNOHANG)
+                    continue
+                if os.getsid(pid) == my_sid:   # our own transient spawn
+                    continue
+                os.kill(pid, signal.SIGKILL)
+                os.waitpid(pid, os.WNOHANG)
+                logger.info("reaped orphaned process %d (parent worker "
+                            "died)", pid)
+            except (OSError, ValueError, IndexError):
+                continue
 
     async def _report_worker_died(self, gcs, worker_id, handle):
         payload = {

@@ -288,11 +288,13 @@ def _as_row(out) -> list:
     return out if isinstance(out, list) else [out]
 
 
-def _collect_rows(upstream: Iterator, make_split: Callable) -> list:
+def _collect_rows(upstream: Iterator, make_split: Callable,
+                  ref_of: Callable = lambda item: item) -> list:
     """Windowed split phase: returns the piece-ref matrix (refs only —
     the pieces themselves live in the store and spill under pressure).
     Source refs are dropped as their splits complete."""
-    return [_as_row(out) for out in _windowed(upstream, make_split)]
+    return [_as_row(out)
+            for out in _windowed(upstream, make_split, ref_of=ref_of)]
 
 
 # ------------------------------------------------------------- stages
@@ -374,11 +376,11 @@ def _shuffle_stage(upstream: Iterator, requested_k: int | None,
         if seed is None:  # derived streams must differ run to run
             seed = random.randrange(2**63)
         merge_shuffled = art.remote(_merge_shuffled)
-        rows = [_as_row(out) for out in _windowed(
+        rows = _collect_rows(
             enumerate(refs),
             lambda item: split_remote.remote(
                 item[1], k, mode, _stable_hash(("split", seed, item[0]))),
-            ref_of=lambda item: item[1])]
+            ref_of=lambda item: item[1])
         yield from _merge_stream(
             rows, lambda j, col: merge_shuffled.remote(
                 _stable_hash(("merge", seed, j)), *col), k)

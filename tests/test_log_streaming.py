@@ -1,0 +1,93 @@
+"""Worker-output streaming + orphan reaping (ref:
+python/ray/_private/log_monitor.py — worker stdout/stderr lines fan out
+to the driver console via GCS pubsub; src/ray/util/subreaper.h — a dead
+worker's user subprocesses re-parent to the daemon and are killed)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+
+
+@pytest.fixture()
+def one_cpu_cluster():
+    art.init(num_cpus=1)
+    yield None
+    art.shutdown()
+
+
+def test_task_prints_reach_driver(one_cpu_cluster, capfd):
+    @art.remote
+    def chatty(i):
+        print(f"log-stream-probe-{i}")
+        return i
+
+    assert art.get([chatty.remote(i) for i in range(3)]) == [0, 1, 2]
+    deadline = time.monotonic() + 15
+    seen = ""
+    while time.monotonic() < deadline:
+        seen += capfd.readouterr().out
+        if all(f"log-stream-probe-{i}" in seen for i in range(3)):
+            break
+        time.sleep(0.2)
+    for i in range(3):
+        assert f"log-stream-probe-{i}" in seen, seen[-2000:]
+    # ray-style source prefix on every streamed line
+    assert "(worker=" in seen and "pid=" in seen
+    # the worker's own system logging format is filtered out
+    assert "[worker " not in seen
+
+
+def test_system_log_lines_not_streamed(one_cpu_cluster, capfd):
+    """The worker boot line ('[worker INFO ...] serving at ...') lands
+    in the log file but must not spam the driver console."""
+    @art.remote
+    def quiet():
+        return "ok"
+
+    assert art.get(quiet.remote()) == "ok"
+    time.sleep(1.0)
+    out = capfd.readouterr().out
+    assert "serving at" not in out
+
+
+@pytest.mark.skipif(not sys.platform.startswith("linux"),
+                    reason="subreaper is linux-only")
+def test_orphaned_grandchild_is_reaped(one_cpu_cluster):
+    """A worker that dies with a live user subprocess must not leak it:
+    the subreaper re-parents the orphan to the node daemon, whose sweep
+    kills it within a few seconds."""
+    @art.remote
+    class Spawner:
+        def spawn(self):
+            proc = subprocess.Popen([sys.executable, "-c",
+                                     "import time; time.sleep(300)"])
+            self._proc = proc
+            return proc.pid
+
+        def pid(self):
+            return os.getpid()
+
+    actor = Spawner.remote()
+    orphan_pid = art.get(actor.spawn.remote())
+    assert _alive(orphan_pid)
+    art.kill(actor)                      # worker dies, orphan re-parents
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline and _alive(orphan_pid):
+        time.sleep(0.5)
+    assert not _alive(orphan_pid), \
+        f"orphan {orphan_pid} survived its worker's death"
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
