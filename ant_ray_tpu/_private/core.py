@@ -364,8 +364,16 @@ class ClusterRuntime(CoreRuntime):
     def _on_pubsub_event(self, channel: str, data: dict) -> None:
         if channel == "worker_logs":
             # Worker output → driver console, ray-style prefixes.
+            # Job-scoped: on shared clusters another driver's task
+            # output stays off this console (entries without a job tag
+            # — e.g. a worker booting — print everywhere).
             node = data.get("node", "?")
+            my_job = self.job_id.hex() if self.job_id else None
             for entry in data.get("entries", ()):
+                entry_job = entry.get("job_id")
+                if entry_job is not None and my_job is not None \
+                        and entry_job != my_job:
+                    continue
                 prefix = f"(worker={entry.get('worker', '?')}" + (
                     f" pid={entry['pid']}" if entry.get("pid") else "") + \
                     f" node={node})"

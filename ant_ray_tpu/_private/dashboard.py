@@ -7,7 +7,7 @@ job_manager.py:62, _private/metrics_agent.py Prometheus export), as one
 aiohttp process colocated with the head node.  Endpoints:
 
     GET  /api/nodes | /api/actors | /api/placement_groups | /api/objects
-    GET  /api/cluster_status
+    GET  /api/cluster_status | /api/export_events
     GET  /metrics                         (Prometheus text format)
     POST /api/jobs                        {entrypoint, runtime_env, ...}
     GET  /api/jobs            /api/jobs/{id}   /api/jobs/{id}/logs
@@ -251,6 +251,14 @@ def create_app(gcs_address: str, session_dir: str):
                     "graph": build_call_graph(events)}
         return web.json_response(await _call(build))
 
+    async def export_events(req):
+        def build():
+            return gcs.call("ExportEventsGet", {
+                "source_type": req.query.get("source_type"),
+                "limit": int(req.query.get("limit", 1000)),
+            }, retries=3)
+        return web.json_response(await _call(build))
+
     async def node_logs(req):
         node_id = req.query.get("node_id")
 
@@ -407,6 +415,7 @@ def create_app(gcs_address: str, session_dir: str):
     app.router.add_get("/api/objects", objects)
     app.router.add_get("/api/cluster_status", cluster_status)
     app.router.add_get("/api/insight", insight)
+    app.router.add_get("/api/export_events", export_events)
     app.router.add_get("/api/timeline", timeline)
     app.router.add_get("/api/logs", node_logs)
     app.router.add_get("/api/logs/{filename}", node_log_read)

@@ -45,10 +45,22 @@ class ActorRecord:
 
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 store_path: str | None = None):
+                 store_path: str | None = None,
+                 export_dir: str | None = None):
         from ant_ray_tpu._private.store_client import (  # noqa: PLC0415
             store_client_for,
         )
+
+        # Export-event pipeline (ref: RayEventRecorder + export_*.proto
+        # — durable JSONL lifecycle events for external pipelines);
+        # active only when the session provides an export dir.
+        self._exporter = None
+        if export_dir:
+            from ant_ray_tpu._private.export_events import (  # noqa: PLC0415
+                ExportEventRecorder,
+            )
+
+            self._exporter = ExportEventRecorder(export_dir)
 
         # Write-through persistence (ref: gcs store clients,
         # src/ray/gcs/store_client/redis_store_client.h): with a store
@@ -156,6 +168,7 @@ class GcsServer:
             "TaskEventsGet": self._task_events_get,
             "SubPoll": self._sub_poll,
             "PublishLogs": self._publish_logs,
+            "ExportEventsGet": self._export_events_get,
             "Shutdown": self._shutdown_rpc,
         })
         if self._durable:
@@ -326,6 +339,19 @@ class GcsServer:
         schedules ONE notify, not one per event."""
         self._pub_seq += 1
         self._pub_events.append((self._pub_seq, channel, data))
+        if self._exporter is not None and channel != "worker_logs":
+            # Mirror control-plane pubsub into the export pipeline:
+            # node alive/dead and actor state transitions ARE the
+            # lifecycle events external consumers want.
+            if channel == "node":
+                self._exporter.record(
+                    "EXPORT_NODE",
+                    "ALIVE" if data.get("alive") else "DEAD",
+                    data.get("node_id"), data)
+            elif channel == "actor_state":
+                self._exporter.record("EXPORT_ACTOR",
+                                      str(data.get("state", "")).upper(),
+                                      data.get("actor_id"), data)
         if self._pub_cond is not None and not self._pub_notify_pending:
             self._pub_notify_pending = True
 
@@ -335,6 +361,18 @@ class GcsServer:
                     self._pub_cond.notify_all()
 
             asyncio.ensure_future(_notify())
+
+    async def _export_events_get(self, payload):
+        """Read back export-pipeline events (dashboard /api and tests;
+        external pipelines normally tail the JSONL files directly).
+        File parsing runs off the event loop — a full export dir must
+        not stall heartbeats and lease RPCs."""
+        if self._exporter is None:
+            return {"enabled": False, "events": []}
+        events = await asyncio.to_thread(
+            self._exporter.read, payload.get("source_type"),
+            int(payload.get("limit", 1000)))
+        return {"enabled": True, "events": events}
 
     async def _publish_logs(self, payload):
         """Fan worker stdout/stderr lines out to subscribed drivers
@@ -571,7 +609,13 @@ class GcsServer:
     # ------------------------------------------------------ task events
 
     async def _task_events_add(self, payload):
-        self._task_events.extend(payload.get("events", ()))
+        events = payload.get("events", ())
+        self._task_events.extend(events)
+        if self._exporter is not None:
+            for ev in events:
+                self._exporter.record("EXPORT_TASK",
+                                      str(ev.get("state", "")).upper(),
+                                      ev.get("task_id"), ev)
         return True
 
     async def _task_events_get(self, payload):
@@ -655,6 +699,10 @@ class GcsServer:
         }
         self._persist("jobs", payload["job_id"].hex(),
                       (payload["job_id"], self._jobs[payload["job_id"]]))
+        if self._exporter is not None:
+            self._exporter.record("EXPORT_DRIVER_JOB", "STARTED",
+                                  payload["job_id"],
+                                  self._jobs[payload["job_id"]])
         return True
 
     # ------------------------------------------------------------- actors
@@ -979,6 +1027,9 @@ class GcsServer:
         return True
 
     async def _worker_died(self, payload):
+        if self._exporter is not None:
+            self._exporter.record("EXPORT_WORKER", "DIED",
+                                  payload.get("worker_id"), payload)
         actor_id = payload.get("actor_id")
         if actor_id is not None:
             record = self._actors.get(actor_id)
@@ -1071,6 +1122,11 @@ class GcsServer:
         }
         self._placement_groups[payload["pg_id"]] = record
         self._save_pg(record)
+        if self._exporter is not None:
+            self._exporter.record(
+                "EXPORT_PLACEMENT_GROUP", "PENDING", payload["pg_id"],
+                {"strategy": record["strategy"], "name": record["name"],
+                 "bundles": record["bundles"]})
         asyncio.ensure_future(self._schedule_placement_group(record))
         return True
 
@@ -1273,6 +1329,9 @@ class GcsServer:
         if record is None:
             return False
         record["state"] = "REMOVED"
+        if self._exporter is not None:
+            self._exporter.record("EXPORT_PLACEMENT_GROUP", "REMOVED",
+                                  record["pg_id"], {})
         self._drop_gang_demand(record)
         # Persist the terminal state FIRST: a head crash mid-removal must
         # not resurrect a CREATED/PENDING record whose bundles the nodes
@@ -1468,12 +1527,16 @@ def main():  # pragma: no cover — exercised via subprocess in tests
     parser.add_argument("--store", default="",
                         help="sqlite path for durable tables (restart-"
                              "resync; empty = in-memory only)")
+    parser.add_argument("--export-dir", default="",
+                        help="directory for export-event JSONL files "
+                             "(empty = export pipeline disabled)")
     args = parser.parse_args()
 
     logging.basicConfig(
         level=global_config().log_level,
         format="[gcs %(levelname)s %(asctime)s] %(message)s")
-    server = GcsServer(port=args.port, store_path=args.store or None)
+    server = GcsServer(port=args.port, store_path=args.store or None,
+                       export_dir=args.export_dir or None)
     server.start()
     print(f"GCS_READY {server.address}", flush=True)
 

@@ -63,6 +63,7 @@ class WorkerHandle:
     lease_resources: dict[str, float] = field(default_factory=dict)
     lease_pg: tuple | None = None        # (pg_id, bundle_index) if any
     actor_spec: ActorSpec | None = None
+    job_id: object | None = None         # last job served (log scoping)
     blocked: bool = False
     env_key: str = ""                    # runtime-env pool identity
     registered: asyncio.Event = field(default_factory=asyncio.Event)
@@ -303,23 +304,34 @@ class NodeManager:
                     continue
                 # keep any trailing partial line for the next pass —
                 # unless the read window is full and newline-free (one
-                # giant line): flush it as-is or the tail would re-read
-                # the same window forever.
+                # giant line): flush the whole window or the tail would
+                # re-read it forever.
                 cut = chunk.rfind(b"\n")
-                if cut < 0:
-                    if len(chunk) < (1 << 20):
-                        continue
-                    cut = len(chunk) - 1
-                offsets[name] = pos + cut + 1
+                if cut >= 0:
+                    advance = cut + 1          # skip the newline
+                elif len(chunk) >= (1 << 20):
+                    cut = advance = len(chunk)  # flush, lose no bytes
+                else:
+                    continue
+                offsets[name] = pos + advance
                 short = name[len("worker-"):-len(".log")]
-                pid = next((h.proc.pid for h in self._workers.values()
-                            if h.worker_id.hex().startswith(short)), None)
+                handle = next((h for h in self._workers.values()
+                               if h.worker_id.hex().startswith(short)),
+                              None)
+                pid = handle.proc.pid if handle else None
+                job = None
+                if handle is not None:
+                    if handle.actor_spec is not None and \
+                            handle.actor_spec.job_id is not None:
+                        job = handle.actor_spec.job_id.hex()
+                    elif handle.job_id is not None:
+                        job = handle.job_id.hex()
                 lines = [ln.decode("utf-8", "replace")
                          for ln in chunk[:cut].split(b"\n")
                          if ln and not ln.startswith(b"[worker ")]
                 if lines:
                     entries.append({"worker": short, "pid": pid,
-                                    "lines": lines})
+                                    "job_id": job, "lines": lines})
             if entries:
                 try:
                     await gcs.call_async(
@@ -612,10 +624,16 @@ class NodeManager:
                 state, ppid = fields[0], int(fields[1])
                 if ppid != my_pid:
                     continue
+                # Session check FIRST, zombies included: a transient
+                # subprocess.run child of a daemon executor thread (a
+                # runtime-env build) shares our session — waitpid'ing
+                # its zombie here would steal the exit status its
+                # spawner is about to collect (ECHILD -> returncode 0,
+                # a failed build reported as success).
+                if os.getsid(pid) == my_sid:
+                    continue
                 if state == "Z":               # orphan already exited
                     os.waitpid(pid, os.WNOHANG)
-                    continue
-                if os.getsid(pid) == my_sid:   # our own transient spawn
                     continue
                 os.kill(pid, signal.SIGKILL)
                 os.waitpid(pid, os.WNOHANG)
@@ -978,6 +996,7 @@ class NodeManager:
                         worker.state = LEASED
                         worker.lease_resources = dict(demand)
                         worker.lease_pg = pg_key
+                        worker.job_id = job_id
                         return {"granted": worker.address,
                                 "worker_id": worker.worker_id}
                 elif pg_key not in self._bundles:
@@ -1042,6 +1061,7 @@ class NodeManager:
                     self._allocate(demand)
                     worker.state = LEASED
                     worker.lease_resources = dict(demand)
+                    worker.job_id = job_id
                     return {"granted": worker.address,
                             "worker_id": worker.worker_id}
             elif not pinned_here and time.monotonic() > spill_deadline:

@@ -75,6 +75,7 @@ def start_gcs(session_dir: str,
     proc = subprocess.Popen(
         [sys.executable, "-m", "ant_ray_tpu._private.gcs",
          "--port", str(port), "--store", store,
+         "--export-dir", os.path.join(session_dir, "export_events"),
          "--monitor-pid", str(os.getpid())],
         stdout=subprocess.PIPE, stderr=_log_file(session_dir, "gcs.err"),
         env=control_plane_env(), start_new_session=True)
