@@ -98,6 +98,17 @@ class Config:
     # gives no explicit block count.
     data_target_block_bytes: int = 32 * 1024 * 1024
 
+    # ---- cgroup v2 isolation (ref: src/ray/common/cgroup2/) ----
+    # Place workers in a sibling cgroup under a delegated cgroup2 tree
+    # (opt-in; silently skipped when the tree isn't writable).
+    enable_cgroups: bool = False
+    # The delegated cgroup2 tree root (tests point this at a fake).
+    cgroup_root: str = "/sys/fs/cgroup"
+    # Collective memory.max for the workers cgroup (bytes; 0 = no cap).
+    cgroup_workers_memory_max: int = 0
+    # cpu.weight for the workers cgroup (0 = kernel default).
+    cgroup_workers_cpu_weight: int = 0
+
     # ---- scheduling ----
     # Workers pre-started per node at boot (-1 = auto: min(2, num_cpus)).
     num_prestart_workers: int = -1

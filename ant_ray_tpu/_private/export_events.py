@@ -155,5 +155,8 @@ class ExportEventRecorder:
                                 continue
                 except OSError:
                     continue
-        out.sort(key=lambda e: e.get("seq", 0))
+        # Order by wall time first: seq restarts at 1 when a head
+        # restarts into the same (append-mode) files, so seq alone
+        # would rank the previous run's events as newest forever.
+        out.sort(key=lambda e: (e.get("timestamp", 0), e.get("seq", 0)))
         return out[-limit:]

@@ -320,6 +320,11 @@ class GcsServer:
         # Drain the store's async write queue: acknowledged mutations
         # must reach the (possibly remote) store before the head exits.
         self._store.close()
+        if self._exporter is not None:
+            # Terminal lifecycle events (node DEAD, worker DIED) queue
+            # milliseconds before shutdown; os._exit in main would drop
+            # them from the JSONL files the pipeline promises.
+            self._exporter.flush(timeout=2.0)
         if graceful:
             self._server.stop()
             self._clients.close_all()
@@ -614,7 +619,7 @@ class GcsServer:
         if self._exporter is not None:
             for ev in events:
                 self._exporter.record("EXPORT_TASK",
-                                      str(ev.get("state", "")).upper(),
+                                      str(ev.get("event", "")).upper(),
                                       ev.get("task_id"), ev)
         return True
 

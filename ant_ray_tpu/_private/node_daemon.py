@@ -200,6 +200,28 @@ class NodeManager:
             self._tasks.append(asyncio.run_coroutine_threadsafe(
                 self._log_stream_loop(), self._io.loop))
         self._subreaper_enabled = _enable_subreaper()
+        # cgroup v2 isolation (opt-in; ref: src/ray/common/cgroup2/ —
+        # workers live in a sibling cgroup with a collective memory cap
+        # so one blow-up can't take the daemon down).
+        self._cgroups = None
+        cfg = global_config()
+        if cfg.enable_cgroups:
+            from ant_ray_tpu._private.cgroup2 import CgroupManager  # noqa: PLC0415
+
+            if CgroupManager.available(cfg.cgroup_root):
+                mgr = CgroupManager(
+                    os.path.basename(self._session_dir.rstrip("/"))
+                    + "_" + self.node_id.hex()[:8],
+                    root=cfg.cgroup_root,
+                    workers_memory_max=cfg.cgroup_workers_memory_max,
+                    workers_cpu_weight=cfg.cgroup_workers_cpu_weight)
+                if mgr.setup():
+                    mgr.add_system_process(os.getpid())
+                    self._cgroups = mgr
+                    logger.info("cgroup2 worker isolation active")
+            else:
+                logger.info("enable_cgroups set but no writable cgroup2 "
+                            "tree; running without isolation")
         if global_config().fs_monitor_interval_s > 0:
             self._tasks.append(asyncio.run_coroutine_threadsafe(
                 self._fs_monitor_loop(), self._io.loop))
@@ -280,6 +302,7 @@ class NodeManager:
         lines (the worker's own `[worker ...]` logging format) stay in
         the file but are not streamed."""
         offsets: dict[str, int] = {}
+        last_job: dict[str, object] = {}
         gcs = self._clients.get(self._gcs_address)
         logs_dir = self._logs_dir()
         while not self._stopping:
@@ -326,6 +349,17 @@ class NodeManager:
                         job = handle.actor_spec.job_id.hex()
                     elif handle.job_id is not None:
                         job = handle.job_id.hex()
+                # A chunk buffered across a lease boundary may hold the
+                # PREVIOUS job's lines: if the worker's job changed
+                # since the last poll, ship this chunk unscoped (every
+                # driver prints it) rather than scope it to the wrong
+                # job and filter it off the right driver's console.
+                prev = last_job.get(name)
+                if prev is not None and job is not None and prev != job:
+                    last_job[name] = job
+                    job = None
+                elif job is not None:
+                    last_job[name] = job
                 lines = [ln.decode("utf-8", "replace")
                          for ln in chunk[:cut].split(b"\n")
                          if ln and not ln.startswith(b"[worker ")]
@@ -487,6 +521,8 @@ class NodeManager:
         for proc in self._retired_procs:
             if proc.poll() is None:
                 proc.kill()
+        if self._cgroups is not None:
+            self._cgroups.cleanup()
         self._clients.close_all()
 
     async def _shutdown_rpc(self, _payload):
@@ -539,6 +575,8 @@ class NodeManager:
         log_file.close()
         handle = WorkerHandle(worker_id, proc, actor_spec=actor_spec,
                               env_key=renv.env_key(runtime_env))
+        if self._cgroups is not None:
+            self._cgroups.add_worker_process(proc.pid)
         self._workers[worker_id] = handle
         return handle
 

@@ -91,7 +91,7 @@ def test_cluster_lifecycle_events_exported():
         assert ("EXPORT_ACTOR", "DEAD") in kinds
         assert ("EXPORT_PLACEMENT_GROUP", "PENDING") in kinds
         assert ("EXPORT_PLACEMENT_GROUP", "REMOVED") in kinds
-        assert any(s == "EXPORT_TASK" for s, _ in kinds)
+        assert ("EXPORT_TASK", "FINISHED") in kinds
 
         # The JSONL files are on disk for external pipelines to tail.
         files = glob.glob(os.path.join(runtime.session_dir,
