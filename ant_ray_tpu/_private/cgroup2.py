@@ -68,22 +68,33 @@ class CgroupManager:
                 self._write(os.path.join(self._base,
                                          "cgroup.subtree_control"),
                             " ".join(f"+{c}" for c in enable))
+            # Per-controller best effort: a host that delegates only
+            # memory still gets memory isolation — a failed cpu.weight
+            # write must not throw away the memory.max already applied.
             if self._workers_memory_max > 0:
-                self._write(os.path.join(self._workers, "memory.max"),
-                            str(self._workers_memory_max))
+                self._try_limit(os.path.join(self._workers, "memory.max"),
+                                str(self._workers_memory_max))
                 # One runaway worker dies alone — group-kill would turn
                 # a single OOM into a whole-node worker massacre.
-                self._write(os.path.join(self._workers,
-                                         "memory.oom.group"), "0")
+                self._try_limit(os.path.join(self._workers,
+                                             "memory.oom.group"), "0")
             if self._workers_cpu_weight > 0:
-                self._write(os.path.join(self._workers, "cpu.weight"),
-                            str(self._workers_cpu_weight))
+                self._try_limit(os.path.join(self._workers, "cpu.weight"),
+                                str(self._workers_cpu_weight))
             self.active = True
             return True
         except OSError as e:
             logger.info("cgroup2 isolation unavailable: %s", e)
             self.active = False
+            self.cleanup()    # never leak a half-built subtree
             return False
+
+    @classmethod
+    def _try_limit(cls, path: str, value: str) -> None:
+        try:
+            cls._write(path, value)
+        except OSError as e:
+            logger.info("cgroup limit %s not applied: %s", path, e)
 
     # ----------------------------------------------------------- placing
 

@@ -73,6 +73,11 @@ class Config:
     # pubsub (ref: log_monitor.py) — `print()` inside a task shows up
     # on the driver as `(worker=.. pid=..) line`.
     log_to_driver: bool = True
+    # Mirror per-task lifecycle events into the export pipeline (ref:
+    # the reference's per-source enable_export_api_write gates).  Off by
+    # default: tasks are the one high-volume source and recording each
+    # event costs real control-plane throughput.
+    export_task_events: bool = False
     # Task lifecycle events (submitted/started/finished) buffered per
     # process and batch-flushed to the GCS — feeds the Chrome-trace
     # timeline and the state API (ref: task_event_buffer.h).
@@ -134,6 +139,11 @@ class Config:
     task_push_pipeline_depth: int = 8
     # Max concurrent LeaseWorker requests parked per scheduling key.
     max_pending_lease_requests: int = 8
+    # Pull-before-grant budget for a lease's plasma args (ref:
+    # LeaseDependencyManager, lease_dependency_manager.h): the daemon
+    # pulls the first queued task's deps node-local before granting,
+    # waiting at most this long.  0 disables.
+    lease_dep_prefetch_timeout_s: float = 10.0
 
     # ---- fault tolerance ----
     task_max_retries_default: int = 3

@@ -1106,6 +1106,14 @@ class ClusterRuntime(CoreRuntime):
                          "job_id": self.job_id,
                          "label_selector": state.label_selector,
                          "strategy": state.strategy}
+        if state.queue:
+            # Head task's plasma deps ride the lease so the serving node
+            # can pull them before the grant (ref:
+            # lease_dependency_manager.h pull-before-grant; later tasks
+            # pipelined onto the same lease fetch at execution).
+            head_pinned = state.queue[0][1]
+            if head_pinned:
+                lease_payload["deps"] = [r.id for r in head_pinned]
         if state.pg is not None:
             node = await self._resolve_bundle_node(*state.pg)
             lease_payload["pg"] = state.pg

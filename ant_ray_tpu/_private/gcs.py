@@ -616,7 +616,12 @@ class GcsServer:
     async def _task_events_add(self, payload):
         events = payload.get("events", ())
         self._task_events.extend(events)
-        if self._exporter is not None:
+        if self._exporter is not None and \
+                global_config().export_task_events:
+            # Off by default, like the reference's per-source
+            # enable_export_api_write gates: task events are the one
+            # high-volume source, and recording each one costs ~40%% of
+            # async task throughput on a small head.
             for ev in events:
                 self._exporter.record("EXPORT_TASK",
                                       str(ev.get("event", "")).upper(),

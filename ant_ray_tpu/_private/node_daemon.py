@@ -134,6 +134,8 @@ class NodeManager:
         # Versioned-sync observability + early-send wakeup (see
         # _heartbeat_loop; ref: ray_syncer resource-view component).
         self.sync_stats = {"beats": 0, "views_sent": 0}
+        # In-flight lease-dep prefetch pulls, coalesced per object.
+        self._prefetching: dict[ObjectID, asyncio.Task] = {}
         self._sync_wakeup = asyncio.Event()
         # Broadcast-serving chunk cache (ref: PushManager chunk dedup,
         # src/ray/object_manager/push_manager.h:28 — redesigned for the
@@ -303,6 +305,9 @@ class NodeManager:
         the file but are not streamed."""
         offsets: dict[str, int] = {}
         last_job: dict[str, object] = {}
+        # name -> file offset below which lines predate the last
+        # observed job switch (ship those unscoped).
+        unscoped_below: dict[str, int] = {}
         gcs = self._clients.get(self._gcs_address)
         logs_dir = self._logs_dir()
         while not self._stopping:
@@ -349,17 +354,21 @@ class NodeManager:
                         job = handle.actor_spec.job_id.hex()
                     elif handle.job_id is not None:
                         job = handle.job_id.hex()
-                # A chunk buffered across a lease boundary may hold the
-                # PREVIOUS job's lines: if the worker's job changed
-                # since the last poll, ship this chunk unscoped (every
-                # driver prints it) rather than scope it to the wrong
-                # job and filter it off the right driver's console.
+                # Lines buffered across a lease boundary may belong to
+                # the PREVIOUS job: on a job switch, everything already
+                # in the file (up to its current size) ships unscoped —
+                # every driver prints it — rather than scoped to the
+                # wrong job and filtered off the right driver's
+                # console.  A backlog larger than one read window stays
+                # unscoped until the offset catches up to the switch
+                # point.
                 prev = last_job.get(name)
                 if prev is not None and job is not None and prev != job:
+                    unscoped_below[name] = size
+                if job is not None:
                     last_job[name] = job
+                if pos < unscoped_below.get(name, 0):
                     job = None
-                elif job is not None:
-                    last_job[name] = job
                 lines = [ln.decode("utf-8", "replace")
                          for ln in chunk[:cut].split(b"\n")
                          if ln and not ln.startswith(b"[worker ")]
@@ -1001,10 +1010,22 @@ class NodeManager:
                               "can satisfy the request"}
 
         runtime_env = payload.get("runtime_env")
+        deps = payload.get("deps") or ()
         env_key = renv.env_key(runtime_env)
         if runtime_env:
             await self._ensure_runtime_env(runtime_env)
         if pg_key is not None:
+            if deps:
+                # Pull-before-grant (ref: LeaseDependencyManager,
+                # src/ray/raylet/lease_dependency_manager.h): the
+                # bundle is reserved here, so the lease WILL be served
+                # on this node — pull the first queued task's plasma
+                # args before a worker is selected.  Awaiting
+                # mid-selection would race another lease onto the same
+                # idle worker; no resources are held during this wait,
+                # so a dep produced by a task that needs this node can
+                # still schedule here.
+                await self._prefetch_deps(deps)
             # Lease against a committed placement-group bundle: resources
             # come out of the reservation, never the general pool.
             while True:
@@ -1078,6 +1099,12 @@ class NodeManager:
                 return {"spill": node.address}
             return {"infeasible": True}
 
+        if deps:
+            # Pull-before-grant for the normal path — AFTER the
+            # disk-full and feasibility redirects: a node about to
+            # spill the lease elsewhere must not absorb the args'
+            # write pressure first.
+            await self._prefetch_deps(deps)
         start = time.monotonic()
         spill_deadline = start + global_config().spillback_timeout_s
         while True:
@@ -1403,10 +1430,45 @@ class NodeManager:
     async def _contains_object(self, payload):
         return self.store.contains(payload["object_id"])
 
+    async def _prefetch_deps(self, deps) -> None:
+        """Pull a pending lease's plasma args node-local before grant
+        (ref: lease_dependency_manager.h — pull-before-grant).  Bounded
+        by lease_dep_prefetch_timeout_s: a missing or slow dep delays
+        the grant at most that long; the executing worker's own fetch
+        stays the authority either way.  Concurrent leases of one
+        scheduling key all carry the head task's deps, so per-object
+        pulls coalesce node-wide — N parallel leases cost ONE transfer,
+        not N.  Tracked in sync_stats for tests/observability."""
+        budget = global_config().lease_dep_prefetch_timeout_s
+        if budget <= 0:
+            return
+        await asyncio.gather(
+            *[self._coalesced_prefetch(oid, budget) for oid in deps])
+
+    def _coalesced_prefetch(self, oid, budget: float):
+        task = self._prefetching.get(oid)
+        if task is None or task.done():
+            task = asyncio.ensure_future(self._prefetch_one(oid, budget))
+            self._prefetching[oid] = task
+            task.add_done_callback(
+                lambda _t, o=oid: self._prefetching.pop(o, None))
+        return asyncio.shield(task)
+
+    async def _prefetch_one(self, oid, budget: float) -> None:
+        try:
+            reply = await self._ensure_local(
+                {"object_id": oid, "timeout": budget, "prefetch": True})
+            if reply.get("ok"):
+                self.sync_stats["dep_prefetches"] = (
+                    self.sync_stats.get("dep_prefetches", 0) + 1)
+        except Exception:  # noqa: BLE001 — prefetch is best-effort
+            pass
+
     async def _ensure_local(self, payload):
         """Make the object local (pull from a holder if needed); reply path
         (ref: PullManager, src/ray/object_manager/pull_manager.h:50)."""
         object_id: ObjectID = payload["object_id"]
+        prefetch = payload.get("prefetch", False)
         deadline = time.monotonic() + payload.get("timeout", 60.0)
         # After this many seconds of continuously-empty holder lists the
         # request fails fast with {"no_holders"} so the owner can start
@@ -1414,8 +1476,18 @@ class NodeManager:
         # (ref: ObjectRecoveryManager, object_recovery_manager.h:98).
         fail_fast_after = payload.get("fail_fast_after")
         pin_ttl = payload.get("pin_ttl")
+
+        def _locate():
+            # Prefetch (lease dependency pulls) wants locality only —
+            # taking a read pin would wedge the slot until a ReadDone
+            # nobody will ever send.
+            if prefetch:
+                return ({"ok": True} if self.store.contains(object_id)
+                        else None)
+            return self._locate_pinned(object_id, pin_ttl)
+
         no_holders_since: float | None = None
-        located = self._locate_pinned(object_id, pin_ttl)
+        located = _locate()
         if located is not None:
             return located
         gcs = self._clients.get(self._gcs_address)
@@ -1423,7 +1495,7 @@ class NodeManager:
         while time.monotonic() < deadline:
             # A colocated producer (or a concurrent EnsureLocal) may have
             # sealed the object since the last iteration.
-            located = self._locate_pinned(object_id, pin_ttl)
+            located = _locate()
             if located is not None:
                 return located
             holders: list[NodeInfo] = await gcs.call_async(
@@ -1435,7 +1507,7 @@ class NodeManager:
                     if no_holders_since is None:
                         no_holders_since = now
                     elif now - no_holders_since >= fail_fast_after:
-                        located = self._locate_pinned(object_id, pin_ttl)
+                        located = _locate()
                         return located if located is not None else {
                             "no_holders": True}
             else:
@@ -1448,7 +1520,7 @@ class NodeManager:
                 try:
                     remote = self._clients.get(holder.address)
                     await self._pull_from(remote, object_id, chunk)
-                    located = self._locate_pinned(object_id, pin_ttl)
+                    located = _locate()
                     if located is not None:
                         await gcs.call_async("ObjectLocationAdd", {
                             "object_id": object_id,

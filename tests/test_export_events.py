@@ -40,9 +40,15 @@ def test_recorder_jsonable_ids(tmp_path):
     assert event["data"]["node_id"] == nid.hex()
 
 
-def test_cluster_lifecycle_events_exported():
+def test_cluster_lifecycle_events_exported(monkeypatch):
     """A live session exports node/job/actor/PG/task lifecycle events
-    as JSONL under the session dir, queryable through the GCS."""
+    as JSONL under the session dir, queryable through the GCS.  Task
+    events are high-volume and so opt-in (ref: the reference's
+    per-source enable_export_api_write gates)."""
+    monkeypatch.setenv("ART_EXPORT_TASK_EVENTS", "1")
+    from ant_ray_tpu._private import config as config_mod
+
+    config_mod._global_config = None
     art.init(num_cpus=2)
     try:
         from ant_ray_tpu.api import global_worker
@@ -81,7 +87,7 @@ def test_cluster_lifecycle_events_exported():
             assert reply["enabled"]
             events = reply["events"]
             kinds = {(e["source_type"], e["event_type"]) for e in events}
-            if any(s == "EXPORT_TASK" for s, _ in kinds) \
+            if ("EXPORT_TASK", "FINISHED") in kinds \
                     or _time.monotonic() > deadline:
                 break
             _time.sleep(0.3)
@@ -99,3 +105,4 @@ def test_cluster_lifecycle_events_exported():
         assert files, "no export files written"
     finally:
         art.shutdown()
+        config_mod._global_config = None

@@ -1,0 +1,61 @@
+"""Lease dependency manager: pull-before-grant of a lease's plasma args
+(ref: src/ray/raylet/lease_dependency_manager.h — the raylet pulls a
+queued lease's dependencies node-local before granting, so the worker
+starts executing against warm args instead of blocking on transfer)."""
+
+import numpy as np
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu._private.protocol import ClientPool
+from ant_ray_tpu.cluster_utils import Cluster
+
+
+@pytest.fixture()
+def two_node_cluster():
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    worker_address = cluster.add_node(num_cpus=1,
+                                      labels={"role": "exec"})
+    cluster.connect()
+    yield worker_address
+    art.shutdown()
+    cluster.shutdown()
+
+
+def test_args_prefetched_before_grant(two_node_cluster):
+    """A big plasma arg headed to a remote node is pulled by that
+    node's DAEMON as part of the lease, before the worker runs."""
+    worker_address = two_node_cluster
+    big = art.put(np.arange(2_000_000, dtype=np.float64))  # 16 MB
+
+    @art.remote
+    def consume(arr):
+        return float(arr[-1])
+
+    out = art.get(consume.options(
+        num_cpus=1, label_selector={"role": "exec"}).remote(big),
+        timeout=120)
+    assert out == 1_999_999.0
+    stats = ClientPool().get(worker_address).call(
+        "GetSyncStats", {}, timeout=10)
+    assert stats.get("dep_prefetches", 0) >= 1, \
+        f"lease deps were never prefetched by the daemon ({stats})"
+
+
+def test_pending_dep_does_not_deadlock(two_node_cluster):
+    """A lease whose dep is another task's (not yet produced) output
+    must still grant and run: the daemon's bounded dep wait holds no
+    resources, so the producer can run anywhere."""
+    @art.remote
+    def produce():
+        return np.ones(500_000, dtype=np.float64)  # 4 MB, plasma
+
+    @art.remote
+    def consume(arr):
+        return float(arr.sum())
+
+    ref = produce.remote()
+    out = art.get(consume.options(
+        num_cpus=1, label_selector={"role": "exec"}).remote(ref),
+        timeout=120)
+    assert out == 500_000.0
