@@ -1110,10 +1110,17 @@ class ClusterRuntime(CoreRuntime):
             # Head task's plasma deps ride the lease so the serving node
             # can pull them before the grant (ref:
             # lease_dependency_manager.h pull-before-grant; later tasks
-            # pipelined onto the same lease fetch at execution).
-            head_pinned = state.queue[0][1]
-            if head_pinned:
-                lease_payload["deps"] = [r.id for r in head_pinned]
+            # pipelined onto the same lease fetch at execution).  ONLY
+            # refs known to be plasma-backed qualify: an inline object
+            # has no cluster locations, so the daemon's pull would poll
+            # an empty holder list for its whole budget and stall every
+            # lease of the key (pending and borrowed refs are likewise
+            # excluded — their storage class is unknown here).
+            deps = [r.id for r in state.queue[0][1]
+                    if (entry := self.memory.get_entry(r.id)) is not None
+                    and entry[0] == "plasma"]
+            if deps:
+                lease_payload["deps"] = deps
         if state.pg is not None:
             node = await self._resolve_bundle_node(*state.pg)
             lease_payload["pg"] = state.pg

@@ -1015,16 +1015,22 @@ class NodeManager:
         if runtime_env:
             await self._ensure_runtime_env(runtime_env)
         if pg_key is not None:
-            if deps:
+            bundle = self._bundles.get(pg_key)
+            if deps and bundle is not None and all(
+                    bundle["resources"].get(k, 0.0) >= v
+                    for k, v in demand.items()):
                 # Pull-before-grant (ref: LeaseDependencyManager,
                 # src/ray/raylet/lease_dependency_manager.h): the
-                # bundle is reserved here, so the lease WILL be served
-                # on this node — pull the first queued task's plasma
-                # args before a worker is selected.  Awaiting
-                # mid-selection would race another lease onto the same
-                # idle worker; no resources are held during this wait,
-                # so a dep produced by a task that needs this node can
-                # still schedule here.
+                # bundle is reserved here with enough capacity, so the
+                # lease WILL be served on this node — pull the first
+                # queued task's plasma args before a worker is
+                # selected.  Awaiting mid-selection would race another
+                # lease onto the same idle worker; no resources are
+                # held during this wait, so a dep produced by a task
+                # that needs this node can still schedule here.  (A
+                # bundle removed/undersized skips the prefetch — the
+                # loop below replies infeasible without paying for a
+                # transfer first.)
                 await self._prefetch_deps(deps)
             # Lease against a committed placement-group bundle: resources
             # come out of the reservation, never the general pool.
@@ -1451,13 +1457,22 @@ class NodeManager:
             task = asyncio.ensure_future(self._prefetch_one(oid, budget))
             self._prefetching[oid] = task
             task.add_done_callback(
-                lambda _t, o=oid: self._prefetching.pop(o, None))
+                lambda _t, o=oid: (self._prefetching.pop(o, None)
+                                   if self._prefetching.get(o) is _t
+                                   else None))
         return asyncio.shield(task)
 
     async def _prefetch_one(self, oid, budget: float) -> None:
         try:
             reply = await self._ensure_local(
-                {"object_id": oid, "timeout": budget, "prefetch": True})
+                {"object_id": oid, "timeout": budget, "prefetch": True,
+                 # A dep with no holders yet (producer still running,
+                 # or eviction raced us) stops costing grant latency
+                 # quickly — the worker's own fetch is the authority.
+                 # Same knob as worker-side fetches so one setting
+                 # tunes the whole no-holders policy.
+                 "fail_fast_after": min(
+                     global_config().pull_no_holders_grace_s, budget)})
             if reply.get("ok"):
                 self.sync_stats["dep_prefetches"] = (
                     self.sync_stats.get("dep_prefetches", 0) + 1)
