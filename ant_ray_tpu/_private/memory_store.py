@@ -24,7 +24,13 @@ class MemoryStore:
         self._loop = loop
         self._entries: dict[ObjectID, tuple] = {}
         self._async_waiters: dict[ObjectID, list[asyncio.Future]] = {}
-        self._lock = threading.Lock()
+        # REENTRANT: any allocation inside the critical sections can
+        # trigger GC, which may run ObjectRef.__del__ -> _refcount_event
+        # -> is_owned() on the SAME thread — a plain Lock self-deadlocks
+        # the io loop there (observed via create_future inside
+        # wait_async; the same class of bug as the reference-counter
+        # RLock in core.py).
+        self._lock = threading.RLock()
 
     def mark_pending(self, object_id: ObjectID) -> None:
         with self._lock:
@@ -59,11 +65,14 @@ class MemoryStore:
     async def wait_async(self, object_id: ObjectID,
                          timeout: float | None = None) -> tuple:
         """Await a terminal entry (must run on the io loop)."""
+        # Allocate the future OUTSIDE the lock: create_future can GC
+        # (see the RLock note above) and fewer allocation points inside
+        # the critical section means fewer reentrant excursions.
+        fut = self._loop.create_future()
         with self._lock:
             entry = self._entries.get(object_id)
             if entry is not None and entry[0] != "pending":
                 return entry
-            fut = self._loop.create_future()
             self._async_waiters.setdefault(object_id, []).append(fut)
         if timeout is None:
             return await fut

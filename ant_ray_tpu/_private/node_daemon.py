@@ -31,6 +31,13 @@ from ant_ray_tpu._private.specs import ACTOR_DEAD, ActorSpec, NodeInfo
 logger = logging.getLogger(__name__)
 
 
+def _bundle_fits(bundle: dict, demand: dict) -> bool:
+    """Whole-demand-within-bundle-capacity (shared by the prefetch gate
+    and the grant/infeasible decision — they must never diverge)."""
+    return all(bundle["resources"].get(k, 0.0) >= v
+               for k, v in demand.items())
+
+
 def _enable_subreaper() -> bool:
     """PR_SET_CHILD_SUBREAPER: a dead worker's user subprocesses
     re-parent to this daemon instead of init, so they can be detected
@@ -1016,9 +1023,8 @@ class NodeManager:
             await self._ensure_runtime_env(runtime_env)
         if pg_key is not None:
             bundle = self._bundles.get(pg_key)
-            if deps and bundle is not None and all(
-                    bundle["resources"].get(k, 0.0) >= v
-                    for k, v in demand.items()):
+            if deps and bundle is not None and \
+                    _bundle_fits(bundle, demand):
                 # Pull-before-grant (ref: LeaseDependencyManager,
                 # src/ray/raylet/lease_dependency_manager.h): the
                 # bundle is reserved here with enough capacity, so the
@@ -1036,9 +1042,8 @@ class NodeManager:
             # come out of the reservation, never the general pool.
             while True:
                 bundle = self._bundles.get(pg_key)
-                if bundle is not None and not all(
-                        bundle["resources"].get(k, 0.0) >= v
-                        for k, v in demand.items()):
+                if bundle is not None and not _bundle_fits(bundle,
+                                                           demand):
                     return {"infeasible": True,
                             "reason": f"demand {demand} exceeds bundle "
                                       f"capacity {bundle['resources']}"}

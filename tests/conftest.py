@@ -22,6 +22,11 @@ os.environ.setdefault("ART_DISABLE_GCE_METADATA", "1")
 # CPU backend.)
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/art_jax_test_cache")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
+# Dashboard boot costs ~0.7s per cluster; most tests never touch it.
+# Suites that DO exercise it (test_ops) re-enable it via
+# art.init(_system_config={"include_dashboard": True}) or
+# ART_INCLUDE_DASHBOARD=1.
+os.environ.setdefault("ART_INCLUDE_DASHBOARD", "0")
 
 from ant_ray_tpu._private.jax_utils import import_jax  # noqa: E402
 

@@ -136,7 +136,7 @@ def test_sort_first_partition_before_full_merge(monkeypatch):
     every partition's merge having completed (merges launch on
     downstream demand with a small lookahead).  A tiny target block
     size forces many partitions despite the small dataset."""
-    monkeypatch.setenv("ART_DATA_TARGET_BLOCK_BYTES", "4096")
+    monkeypatch.setenv("ART_DATA_TARGET_BLOCK_BYTES", "512")
     from ant_ray_tpu._private import config as config_mod
 
     config_mod._global_config = None
@@ -144,14 +144,14 @@ def test_sort_first_partition_before_full_merge(monkeypatch):
     try:
         from ant_ray_tpu.data import executor as ex
 
-        n_blocks = 16
+        n_blocks = 8
         ds = data.from_items(
-            [{"k": (i * 37) % 1000} for i in range(1600)],
+            [{"k": (i * 37) % 500} for i in range(400)],
             parallelism=n_blocks)
         stream = ds.sort(key="k")._iter_result_refs()
         first = next(stream)          # one partition pulled
         # The lazy merge launches at most `lookahead` merges ahead of
-        # demand; with 16 partitions, most merge outputs must not even
+        # demand, so most partitions' merge outputs must not even
         # exist as refs yet.  We can't see executor internals from
         # here, but we can check the first partition is correct and
         # sorted while the stream is still open.

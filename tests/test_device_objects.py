@@ -74,6 +74,7 @@ def test_device_object_driver_get_and_free(device_cluster):
         device_objects.get(ref, timeout=30)
 
 
+@pytest.mark.slow
 def test_driver_side_put(device_cluster):
     import jax.numpy as jnp
 

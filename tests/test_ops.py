@@ -22,7 +22,8 @@ def _get_json(url):
 def dashboard_cluster():
     """One cluster for the whole ops module — dashboard, jobs, and
     runtime-env tests all run against it."""
-    ctx = art.init(num_cpus=2)
+    ctx = art.init(num_cpus=2,
+                   _system_config={"include_dashboard": True})
     assert ctx.dashboard_url, "dashboard did not start"
     yield ctx.dashboard_url
     art.shutdown()

@@ -66,6 +66,7 @@ def test_learner_group_local_bc_learns():
     bc.stop()
 
 
+@pytest.mark.slow
 def test_learner_group_sharded_matches_single(shutdown_only):
     """The DDP invariant: 2 learners on half-batches with gradient
     allreduce produce the SAME params as 1 learner on the full batch."""
@@ -152,6 +153,7 @@ def test_appo_learns_cartpole():
     assert 0.2 < result["learner"]["mean_ratio"] < 5.0
 
 
+@pytest.mark.slow
 def test_ppo_with_learner_group_e2e(shutdown_only):
     """PPO driving a 2-learner group end-to-end in a real cluster: the
     loss falls and weights stay usable by the env runners."""
