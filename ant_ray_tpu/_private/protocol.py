@@ -26,7 +26,14 @@ from ant_ray_tpu._private.config import global_config
 
 logger = logging.getLogger(__name__)
 
-_REQ, _REP, _ERR, _ONEWAY = 0, 1, 2, 3
+_REQ, _REP, _ERR, _ONEWAY, _HELLO, _GOODBYE = 0, 1, 2, 3, 4, 5
+
+# Wire protocol version (ref: protobuf schema versioning — the pickled
+# tuple frames are a fixed contract per version; mixed-version nodes
+# fail fast at connect with a clear error instead of corrupting state
+# mid-RPC).  Bump on any change to frame shapes or payload contracts;
+# see wire_schema.py for the per-method payload registry.
+PROTOCOL_VERSION = 1
 
 _HEADER = 8  # u64 big-endian frame length
 
@@ -187,6 +194,20 @@ class RpcServer:
                     kind, msg_id, method, payload = await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
+                if kind == _HELLO:
+                    peer = (payload or {}).get("proto")
+                    if peer != PROTOCOL_VERSION:
+                        # Version fence: reply GOODBYE (so the client
+                        # fails every call with a clear upgrade message)
+                        # and drop the connection.
+                        self._write_reply(
+                            writer, write_lock,
+                            (_GOODBYE, msg_id, method,
+                             {"proto": PROTOCOL_VERSION,
+                              "reason": f"peer wire protocol v{peer} is "
+                                        f"not v{PROTOCOL_VERSION}"}))
+                        return
+                    continue
                 fast = self._fast_routes.get(method)
                 if fast is not None:
                     self._dispatch_fast(writer, write_lock, kind, msg_id,
@@ -352,12 +373,28 @@ class RpcClient:
                     f"cannot connect to {self.address}: {e}"
                 ) from e
             self._writer = writer
+            # Version handshake: first frame on every connection (ref:
+            # schema versioning — mixed-version peers fail fast with an
+            # actionable error, not a pickle explosion mid-call).
+            # Sentinel id -1: a pre-handshake server would dispatch
+            # "__hello__" as a normal request and reply an error frame —
+            # which must not collide with a real pending msg_id (the
+            # shared counter starts at 0).
+            writer.write(_encode_frame(
+                (_HELLO, -1, "__hello__", {"proto": PROTOCOL_VERSION})))
             _spawn(self._read_loop(reader))
 
     async def _read_loop(self, reader):
+        version_err = None
         try:
             while True:
                 kind, msg_id, _method, payload = await _read_frame(reader)
+                if kind == _GOODBYE:
+                    version_err = RpcError(
+                        f"{self.address} rejected this process: "
+                        f"{(payload or {}).get('reason', 'version fence')}"
+                        " — upgrade the older side")
+                    return
                 fut = self._pending.get(msg_id)
                 if fut is None or fut.done():
                     continue
@@ -375,7 +412,8 @@ class RpcClient:
             # Deferred frames must not survive into a reconnected writer
             # (replaying a stale PushTask double-executes the task).
             self.discard_deferred()
-            err = RpcConnectionError(f"connection to {self.address} lost")
+            err = version_err or RpcConnectionError(
+                f"connection to {self.address} lost")
             for fut in self._pending.values():
                 if not fut.done():
                     fut.set_exception(err)

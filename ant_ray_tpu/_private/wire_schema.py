@@ -1,0 +1,170 @@
+"""Wire-schema registry: the versioned contract behind every RPC method
+(ref: the reference's protobuf schemas, src/ray/protobuf/*.proto — here
+the frames are pickled tuples ``(kind, seq, method, payload)``, so the
+schema lives in this registry instead of .proto files, and the
+connection-level version fence lives in protocol.PROTOCOL_VERSION).
+
+Every method a server registers MUST have an entry here (enforced by
+tests/test_wire_schema.py, which parses the registration blocks of the
+service sources).  An entry records:
+
+* ``service`` — which server exposes it,
+* ``since``  — the protocol version that introduced it,
+* ``payload`` / ``reply`` — one-line field contract.
+
+Evolution rules (the versioning policy):
+
+1. ADDING a method or an OPTIONAL payload key (readers use .get) is
+   allowed within a protocol version — add the entry with the current
+   ``since``.
+2. REMOVING or RENAMING a method/field, or changing a field's meaning,
+   requires bumping protocol.PROTOCOL_VERSION — mixed-version peers
+   then fail fast at connect instead of mis-decoding frames.
+3. Frame-shape changes (the tuple itself) always bump the version.
+"""
+
+from __future__ import annotations
+
+V1 = 1
+
+
+def _m(service: str, payload: str, reply: str, since: int = V1) -> dict:
+    return {"service": service, "since": since,
+            "payload": payload, "reply": reply}
+
+
+METHODS: dict[str, dict] = {
+    # ---- GCS (cluster head) -------------------------------------------
+    "RegisterNode": _m("gcs", "NodeInfo", "bool"),
+    "Heartbeat": _m("gcs", "{node_id, view_version?, view?}",
+                    "{resync?, commands?}"),
+    "GetAllNodes": _m("gcs", "{}", "{node_id: NodeInfo}"),
+    "KVPut": _m("gcs", "{key, value, overwrite?}", "bool"),
+    "KVGet": _m("gcs", "{key}", "bytes|None"),
+    "KVDel": _m("gcs", "{key}", "bool"),
+    "KVTake": _m("gcs", "{key}", "bytes|None (atomic get+del)"),
+    "KVKeys": _m("gcs", "{prefix}", "[key]"),
+    "RegisterJob": _m("gcs", "{job_id, driver_address}", "bool"),
+    "CreateActor": _m("gcs", "ActorSpec", "{actor_id|error}"),
+    "GetActorInfo": _m("gcs", "{actor_id}", "{state, address, ...}"),
+    "WaitActorAlive": _m("gcs", "{actor_id, timeout}",
+                         "{state, address}"),
+    "GetNamedActor": _m("gcs", "{name, namespace}", "{actor_id|None}"),
+    "KillActor": _m("gcs", "{actor_id, no_restart}", "bool"),
+    "ActorStateUpdate": _m("gcs", "{actor_id, state, address?, reason?}",
+                           "bool"),
+    "WorkerDied": _m("gcs", "{node_id, worker_id, actor_id?, reason}",
+                     "bool"),
+    "ObjectLocationAdd": _m("gcs", "{object_id, node_id}", "bool"),
+    "ObjectLocationRemove": _m("gcs", "{object_id, node_id}", "bool"),
+    "ObjectLocationsGet": _m("gcs", "{object_id}", "[NodeInfo]"),
+    "FreeObject": _m("gcs", "{object_id}", "bool (cluster-wide free)"),
+    "SelectNode": _m("gcs",
+                     "{resources, job_id?, label_selector?, strategy?, "
+                     "exclude?}", "NodeInfo|None"),
+    "ResourceDemands": _m("gcs", "{demands: [...]} (from daemons)",
+                          "[{resources|bundles, count, ...}]"),
+    "AutoscalerHeartbeat": _m("gcs", "{}", "bool"),
+    "AutoscalingEnabled": _m("gcs", "{}", "bool"),
+    "ClusterResources": _m("gcs", "{}", "{resource: total}"),
+    "AvailableResources": _m("gcs", "{}", "{resource: available}"),
+    "CreatePlacementGroup": _m(
+        "gcs", "{pg_id, bundles, strategy, name?, job_id?, "
+               "bundle_label_selectors?, same_label?}", "bool"),
+    "GetPlacementGroup": _m("gcs", "{pg_id}", "record dict"),
+    "RemovePlacementGroup": _m("gcs", "{pg_id}", "bool"),
+    "ListPlacementGroups": _m("gcs", "{}", "[record]"),
+    "ListActors": _m("gcs", "{}", "[{actor_id, state, ...}]"),
+    "ListObjects": _m("gcs", "{}", "[{object_id, locations}]"),
+    "MetricRecord": _m("gcs", "{name, tags, value, kind}", "bool"),
+    "MetricsGet": _m("gcs", "{}", "[series]"),
+    "CreateVirtualCluster": _m("gcs", "{vc_id, node_ids, divisible}",
+                               "bool"),
+    "RemoveVirtualCluster": _m("gcs", "{vc_id}", "bool"),
+    "UpdateVirtualCluster": _m("gcs", "{vc_id, node_ids}", "bool"),
+    "ListVirtualClusters": _m("gcs", "{}", "[vc record]"),
+    "SetJobVirtualCluster": _m("gcs", "{job_id, vc_id|None}", "bool"),
+    "GetJobVirtualCluster": _m("gcs", "{job_id}",
+                               "{allowed: [node_id]|None}"),
+    "InsightRecord": _m("gcs", "{events: [...]}", "bool"),
+    "InsightGet": _m("gcs", "{limit?}", "[event]"),
+    "TaskEventsAdd": _m("gcs", "{events: [{task_id, name, event, ...}]}",
+                        "bool"),
+    "TaskEventsGet": _m("gcs", "{limit?, task_id?}", "[event]"),
+    "SubPoll": _m("gcs", "{channels, cursor, timeout}",
+                  "{cursor, events: [(seq, channel, data)]}"),
+    "PublishLogs": _m("gcs", "{node, entries: [{worker, pid, job_id?, "
+                             "lines}]}", "bool"),
+    "ExportEventsGet": _m("gcs", "{source_type?, limit?}",
+                          "{enabled, events}"),
+    "Shutdown": _m("gcs|node", "{}", "bool"),
+
+    # ---- node daemon (raylet) -----------------------------------------
+    "LeaseWorker": _m("node",
+                      "{resources, job_id?, label_selector?, strategy?, "
+                      "pg?, runtime_env?, deps?, routed?}",
+                      "{granted, worker_id}|{spill}|{infeasible, reason}"),
+    "ReturnWorker": _m("node", "{worker_id}", "bool"),
+    "RegisterWorker": _m("node", "{worker_id, address, pid}",
+                         "{ok}|{error}"),
+    "StartActorWorker": _m("node", "{spec, pg?}", "{ok}|{infeasible}"),
+    "KillActorWorker": _m("node", "{worker_id|actor_id}", "bool"),
+    "WorkerBlocked": _m("node", "{worker_id}", "bool (cpu released)"),
+    "WorkerUnblocked": _m("node", "{worker_id}", "bool"),
+    "PrepareBundle": _m("node", "{pg_id, bundle_index, resources}",
+                        "bool (2-phase commit phase 1)"),
+    "CommitBundle": _m("node", "{pg_id, bundle_index}", "bool"),
+    "ReturnBundle": _m("node", "{pg_id, bundle_index}", "bool"),
+    "CreateBuffer": _m("node", "{object_id, size}",
+                       "{path, offset} write grant"),
+    "SealBuffer": _m("node", "{object_id}", "bool"),
+    "SealObject": _m("node", "{object_id, data}", "bool"),
+    "DeleteObject": _m("node", "{object_id}", "bool"),
+    "ContainsObject": _m("node", "{object_id}", "bool"),
+    "LocateObject": _m("node", "{object_id}",
+                       "{size, ...}|None (transfer source probe)"),
+    "ReadChunk": _m("node", "{object_id, offset, length}", "bytes"),
+    "EnsureLocal": _m("node",
+                      "{object_id, timeout, fail_fast_after?, pin_ttl?, "
+                      "prefetch?}",
+                      "{path, offset, size, pinned?, pin_token?}|"
+                      "{no_holders}|{timeout}|{ok}"),
+    "ReadDone": _m("node", "{object_id, pin_token}", "bool"),
+    "RenewPins": _m("node", "{pins: [(oid, token)], ttl}", "{gone: []}"),
+    "GetNodeInfo": _m("node", "{}", "NodeInfo"),
+    "GetNodeMetrics": _m("node", "{}", "{gauges}"),
+    "GetStoreStats": _m("node", "{}", "{used, capacity, spilled}"),
+    "GetSyncStats": _m("node", "{}", "{beats, views_sent, ...}"),
+    "GetTransferStats": _m("node", "{}", "{quota_waits, ...}"),
+    "ListLogs": _m("node", "{}", "[{filename, size}]"),
+    "ReadLog": _m("node", "{filename, offset?, tail?, max_bytes?}",
+                  "{data, next_offset, eof}|{error}"),
+
+    # ---- worker / owner (core runtime) --------------------------------
+    "PushTask": _m("worker", "TaskSpec (fast route)", "result payload"),
+    "InstantiateActor": _m("worker", "ActorSpec", "bool"),
+    "Ping": _m("worker|store", "{}", "'pong'"),
+    "GetObject": _m("worker", "{object_id, timeout}",
+                    "(kind, payload) owned-object fetch"),
+    "GetObjectStatus": _m("worker", "{object_id}",
+                          "'ready'|'pending'|'unknown'"),
+    "GetObjectInfo": _m("worker", "{object_id}", "{status, size}"),
+    "BorrowAdd": _m("worker", "{object_id}", "bool"),
+    "BorrowRemove": _m("worker", "{object_id}", "bool"),
+    "ReconstructObject": _m("worker", "{object_id}",
+                            "bool (lineage re-execution)"),
+    "StreamItem": _m("worker", "{task_id, index, payload|done}", "bool"),
+    "DeviceTensorFetch": _m("worker", "{token}", "host tensor bytes"),
+    "DeviceTensorFree": _m("worker", "{token}", "bool"),
+
+    # ---- store service (shared-store HA) ------------------------------
+    "StorePut": _m("store", "{table, key, value}", "bool"),
+    "StoreGet": _m("store", "{table, key}", "bytes|None"),
+    "StoreDelete": _m("store", "{table, key}", "bool"),
+    "StoreLoadTable": _m("store", "{table}", "{key: value}"),
+    "LeaseAcquire": _m("store", "{name, owner, ttl}",
+                       "bool (HA leader lease)"),
+    "LeaseRenew": _m("store", "{name, owner, ttl}", "bool"),
+    "LeaseRelease": _m("store", "{name, owner}", "bool"),
+    "LeaseInfo": _m("store", "{name}", "{owner, expires_at}|None"),
+}
