@@ -73,6 +73,11 @@ class Config:
     # pubsub (ref: log_monitor.py) — `print()` inside a task shows up
     # on the driver as `(worker=.. pid=..) line`.
     log_to_driver: bool = True
+    # Spawn a per-node agent process (runtime-env builds, log serving,
+    # OS metrics) supervised by the daemon (ref: agent_manager.h + the
+    # dashboard/runtime-env agents).  Builds fall back in-process while
+    # the agent is down.
+    enable_node_agent: bool = True
     # Mirror per-task lifecycle events into the export pipeline (ref:
     # the reference's per-source enable_export_api_write gates).  Off by
     # default: tasks are the one high-volume source and recording each

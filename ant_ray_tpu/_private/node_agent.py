@@ -1,0 +1,171 @@
+"""Per-node agent process (ref: the reference's per-node agents —
+dashboard agent `dashboard/agent.py:24`, runtime-env agent
+`runtime_env/agent/runtime_env_agent.py:167`, metrics agent
+`_private/metrics_agent.py` — spawned and supervised by the raylet's
+AgentManager, `src/ray/raylet/agent_manager.h`).
+
+One process covers the three agent roles:
+
+* **runtime-env builds** — package extraction and pip/uv/conda env
+  materialization run HERE, so a slow or crashing build can never take
+  the node daemon's event loop or process down with it;
+* **log serving** — ListLogs/ReadLog over the session dir (the daemon
+  keeps its own copies of these routes for back-compat; the dashboard
+  may talk to either);
+* **node metrics** — OS-level gauges (load, memory, disk) for the
+  head's metrics aggregation.
+
+The daemon restarts a dead agent with backoff and falls back to
+in-process builds while the agent is down — agents are an isolation
+upgrade, never a single point of failure.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+
+from ant_ray_tpu._private.config import global_config
+from ant_ray_tpu._private.protocol import ClientPool, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class NodeAgent:
+    def __init__(self, session_dir: str, gcs_address: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._session_dir = session_dir
+        self._gcs_address = gcs_address
+        self._server = RpcServer(host, port)
+        self._clients = ClientPool()
+        self.stats = {"env_builds": 0, "env_build_failures": 0,
+                      "log_reads": 0, "started_at": time.time()}
+        self.address = ""
+
+    def start(self) -> str:
+        self._server.routes({
+            "BuildRuntimeEnv": self._build_runtime_env,
+            "AgentListLogs": self._list_logs,
+            "AgentReadLog": self._read_log,
+            "AgentMetrics": self._metrics,
+            "AgentStats": self._get_stats,
+            "Ping": self._ping,
+        })
+        self.address = self._server.start()
+        return self.address
+
+    def stop(self) -> None:
+        self._server.stop()
+        self._clients.close_all()
+
+    async def _ping(self, _payload):
+        return "pong"
+
+    async def _get_stats(self, _payload):
+        return dict(self.stats)
+
+    # ---------------------------------------------------- runtime envs
+
+    async def _build_runtime_env(self, payload):
+        """Materialize a runtime env in THIS process — the daemon
+        delegates here so builds are isolated from its event loop (ref:
+        runtime_env_agent.py:167).  The build sequence itself is the
+        shared runtime_env.materialize (identical to the daemon's
+        in-process fallback)."""
+        from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
+
+        gcs = self._clients.get(self._gcs_address)
+
+        async def kv_get(key):
+            return await gcs.call_async("KVGet", {"key": key},
+                                        timeout=60)
+
+        try:
+            await renv.materialize(payload.get("wire"),
+                                   self._session_dir, kv_get)
+            self.stats["env_builds"] += 1
+            return {"ok": True}
+        except Exception as e:  # noqa: BLE001 — reported to the daemon
+            self.stats["env_build_failures"] += 1
+            return {"ok": False, "error": f"{type(e).__name__}: {e}"}
+
+    # ------------------------------------------------------------ logs
+
+    async def _list_logs(self, _payload):
+        from ant_ray_tpu._private import log_serving  # noqa: PLC0415
+
+        return log_serving.list_logs(self._session_dir)
+
+    async def _read_log(self, payload):
+        from ant_ray_tpu._private import log_serving  # noqa: PLC0415
+
+        self.stats["log_reads"] += 1
+        return log_serving.read_log(self._session_dir, payload)
+
+    # --------------------------------------------------------- metrics
+
+    async def _metrics(self, _payload):
+        """OS-level node gauges (the metrics-agent role)."""
+        gauges: dict[str, float] = {}
+        try:
+            load1, load5, load15 = os.getloadavg()
+            gauges.update({"load_1m": load1, "load_5m": load5,
+                           "load_15m": load15})
+        except OSError:
+            pass
+        try:
+            fields = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    key, _, rest = line.partition(":")
+                    fields[key.strip()] = int(rest.strip().split()[0])
+            gauges["mem_total_kb"] = float(fields.get("MemTotal", 0))
+            gauges["mem_available_kb"] = float(
+                fields.get("MemAvailable", 0))
+        except (OSError, ValueError, IndexError):
+            pass
+        try:
+            stat = os.statvfs(self._session_dir)
+            gauges["disk_free_bytes"] = float(stat.f_bavail * stat.f_frsize)
+        except OSError:
+            pass
+        return gauges
+
+
+def main():  # pragma: no cover — exercised via subprocess in tests
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--gcs-address", required=True)
+    parser.add_argument("--monitor-pid", type=int, default=0)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=global_config().log_level,
+        format="[agent %(levelname)s %(asctime)s] %(message)s")
+    agent = NodeAgent(args.session_dir, args.gcs_address)
+    agent.start()
+    print(f"AGENT_READY {agent.address}", flush=True)
+
+    stop = False
+
+    def _term(*_a):
+        nonlocal stop
+        stop = True
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    while not stop:
+        time.sleep(0.2)
+        if args.monitor_pid and not os.path.exists(
+                f"/proc/{args.monitor_pid}"):
+            break
+    agent.stop()
+    os._exit(0)
+
+
+if __name__ == "__main__":
+    main()

@@ -191,6 +191,7 @@ class NodeManager:
             "ContainsObject": self._contains_object,
             "GetNodeInfo": self._get_node_info,
             "GetSyncStats": self._get_sync_stats,
+            "GetAgentInfo": self._get_agent_info,
             "GetStoreStats": self._get_store_stats,
             "GetNodeMetrics": self._get_node_metrics,
             "GetTransferStats": self._get_transfer_stats,
@@ -209,6 +210,7 @@ class NodeManager:
             self._tasks.append(asyncio.run_coroutine_threadsafe(
                 self._log_stream_loop(), self._io.loop))
         self._subreaper_enabled = _enable_subreaper()
+        self._start_agent()
         # cgroup v2 isolation (opt-in; ref: src/ray/common/cgroup2/ —
         # workers live in a sibling cgroup with a collective memory cap
         # so one blow-up can't take the daemon down).
@@ -269,40 +271,19 @@ class NodeManager:
     # debugging worker N never needs ssh.)
 
     def _logs_dir(self) -> str:
-        return os.path.join(self._session_dir, "logs")
+        from ant_ray_tpu._private import log_serving  # noqa: PLC0415
+
+        return log_serving.logs_dir(self._session_dir)
 
     async def _list_logs(self, _payload):
-        logs_dir = self._logs_dir()
-        if not os.path.isdir(logs_dir):
-            return []
-        out = []
-        for name in sorted(os.listdir(logs_dir)):
-            path = os.path.join(logs_dir, name)
-            try:
-                out.append({"filename": name,
-                            "size": os.path.getsize(path)})
-            except OSError:
-                continue
-        return out
+        from ant_ray_tpu._private import log_serving  # noqa: PLC0415
+
+        return log_serving.list_logs(self._session_dir)
 
     async def _read_log(self, payload):
-        name = os.path.basename(payload["filename"])  # no traversal
-        path = os.path.join(self._logs_dir(), name)
-        max_bytes = min(int(payload.get("max_bytes", 65536)), 4 << 20)
-        tail = payload.get("tail")
-        try:
-            size = os.path.getsize(path)
-            offset = int(payload.get("offset", 0))
-            if tail is not None:  # last N bytes
-                offset = max(0, size - int(tail))
-            with open(path, "rb") as f:
-                f.seek(offset)
-                data = f.read(max_bytes)
-            return {"data": data, "offset": offset,
-                    "next_offset": offset + len(data),
-                    "eof": offset + len(data) >= size}
-        except OSError as e:
-            return {"error": str(e)}
+        from ant_ray_tpu._private import log_serving  # noqa: PLC0415
+
+        return log_serving.read_log(self._session_dir, payload)
 
     async def _log_stream_loop(self):
         """Tail worker logs and fan new USER lines out to drivers via
@@ -396,6 +377,12 @@ class NodeManager:
 
     async def _get_sync_stats(self, _payload):
         return dict(self.sync_stats)
+
+    async def _get_agent_info(self, _payload):
+        proc = getattr(self, "_agent_proc", None)
+        return {"address": getattr(self, "_agent_address", None),
+                "alive": proc is not None and proc.poll() is None,
+                "restarts": getattr(self, "_agent_restarts", 0)}
 
     async def _get_store_stats(self, _payload):
         return {"used": self.store.used,
@@ -537,6 +524,9 @@ class NodeManager:
         for proc in self._retired_procs:
             if proc.poll() is None:
                 proc.kill()
+        agent = getattr(self, "_agent_proc", None)
+        if agent is not None and agent.poll() is None:
+            agent.terminate()
         if self._cgroups is not None:
             self._cgroups.cleanup()
         self._clients.close_all()
@@ -624,6 +614,7 @@ class NodeManager:
                 self._retired_procs = [p for p in self._retired_procs
                                        if p.poll() is None]
             now = time.monotonic()
+            self._supervise_agent()
             if self._subreaper_enabled and now - last_orphan_sweep > 2.0:
                 last_orphan_sweep = now
                 self._reap_orphans()
@@ -656,6 +647,11 @@ class NodeManager:
         src/ray/util/subreaper.h kill-unknown-children policy)."""
         known = {h.proc.pid for h in self._workers.values()}
         known |= {p.pid for p in self._retired_procs}
+        agent = getattr(self, "_agent_proc", None)
+        if agent is not None:
+            # The node agent is a daemon child in its own session — the
+            # foreign-session heuristic would reap it every sweep.
+            known.add(agent.pid)
         my_pid = os.getpid()
         try:
             my_sid = os.getsid(0)
@@ -837,29 +833,94 @@ class NodeManager:
         self._lease_event.set()
         self._sync_wakeup.set()
 
+    # ---------------------------------------------------- agent manager
+    # (ref: src/ray/raylet/agent_manager.h — the raylet spawns and
+    #  supervises per-node agent processes; runtime-env builds run in
+    #  the agent so a slow/crashing build can't take the daemon down)
+
+    def _start_agent(self) -> None:
+        if not global_config().enable_node_agent:
+            return
+        from ant_ray_tpu._private import services  # noqa: PLC0415
+
+        os.makedirs(os.path.join(self._session_dir, "logs"),
+                    exist_ok=True)
+        self._agent_proc = subprocess.Popen(
+            [sys.executable, "-m", "ant_ray_tpu._private.node_agent",
+             "--session-dir", self._session_dir,
+             "--gcs-address", self._gcs_address,
+             "--monitor-pid", str(os.getpid())],
+            stdout=subprocess.PIPE,
+            stderr=open(os.path.join(self._session_dir, "logs",
+                                     "agent.err"), "ab"),
+            env=services.control_plane_env(), start_new_session=True)
+        self._agent_address = None
+
+        def _wait_ready(proc=self._agent_proc):
+            # Off-thread READY wait: daemon boot never blocks on the
+            # agent; builds fall back in-process until it reports in.
+            try:
+                for line in proc.stdout:
+                    text = line.decode(errors="replace").strip()
+                    if text.startswith("AGENT_READY"):
+                        if self._agent_proc is proc:
+                            self._agent_address = text.split(" ", 1)[1]
+                        return
+            except Exception:  # noqa: BLE001
+                pass
+
+        import threading  # noqa: PLC0415
+
+        threading.Thread(target=_wait_ready, daemon=True).start()
+
+    def _supervise_agent(self) -> None:
+        """Restart a dead agent (called from the worker-monitor loop)
+        with a simple backoff."""
+        proc = getattr(self, "_agent_proc", None)
+        if proc is None or proc.poll() is None:
+            return
+        now = time.monotonic()
+        if now < getattr(self, "_agent_backoff_until", 0.0):
+            return
+        self._agent_backoff_until = now + min(
+            2.0 * (getattr(self, "_agent_restarts", 0) + 1), 30.0)
+        self._agent_restarts = getattr(self, "_agent_restarts", 0) + 1
+        # Clear the dead address NOW — during the backoff window every
+        # build would otherwise dial it first and pay a failed connect.
+        self._agent_address = None
+        logger.warning("node agent died (exit %s); restarting",
+                       proc.returncode)
+        self._start_agent()
+
     async def _ensure_runtime_env(self, wire: dict | None):
         """Prefetch + extract a runtime env's packages (working_dir +
         py_modules) and build its pip venv, so the (sync) worker spawn
-        only touches local paths."""
+        only touches local paths.  Delegated to the node agent when one
+        is serving (build isolation, ref: runtime_env_agent.py:167);
+        falls back in-process while the agent is down/booting."""
         from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
 
-        wire = wire or {}
-        keys = ([wire["working_dir_key"]] if wire.get("working_dir_key")
-                else []) + list(wire.get("py_modules_keys") or ())
+        if not wire or renv.is_ready(wire, self._session_dir):
+            return  # fully materialized: no RPC, no executor hop
+        agent_addr = getattr(self, "_agent_address", None)
+        if agent_addr:
+            try:
+                reply = await self._clients.get(agent_addr).call_async(
+                    "BuildRuntimeEnv", {"wire": wire}, timeout=1800)
+                if reply.get("ok"):
+                    return
+                raise RuntimeError(reply.get("error", "agent build failed"))
+            except RuntimeError:
+                raise
+            except Exception as e:  # noqa: BLE001 — agent died mid-build
+                logger.warning("agent env build unavailable (%s); "
+                               "building in-process", e)
         gcs = self._clients.get(self._gcs_address)
-        for key in keys:
-            if renv.is_extracted(key, self._session_dir):
-                continue
-            blob = await gcs.call_async("KVGet", {"key": key}, timeout=60)
-            if blob is None:
-                raise RuntimeError(
-                    f"runtime_env package {key} missing from GCS KV")
-            renv.extract(key, blob, self._session_dir)
-        if any(wire.get(f) for f in ("pip", "uv", "conda", "container")):
-            # Env materialization is slow (subprocess pip/uv/conda) —
-            # off the event loop.
-            await asyncio.get_running_loop().run_in_executor(
-                None, renv.ensure_env_ready, wire, self._session_dir)
+
+        async def kv_get(key):
+            return await gcs.call_async("KVGet", {"key": key}, timeout=60)
+
+        await renv.materialize(wire, self._session_dir, kv_get)
 
     async def _job_allowed_here(self, job_id) -> bool:
         """Virtual-cluster membership of this node for a job, cached

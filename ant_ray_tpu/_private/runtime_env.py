@@ -403,6 +403,79 @@ def venv_python(wire: dict | None, session_dir: str) -> str | None:
     return None
 
 
+import contextlib as _contextlib
+
+
+@_contextlib.contextmanager
+def _disk_build_lock(session_dir: str, tag: str):
+    """Cross-PROCESS build serialization: the daemon's in-process
+    fallback and the node agent can race to build the same env (the
+    agent comes up mid-build); an flock on a session-local lockfile
+    makes the loser wait and then see the winner's ready marker.
+    In-process threads are already serialized by _venv_build_locks."""
+    import fcntl  # noqa: PLC0415
+
+    locks_dir = os.path.join(session_dir, ".build_locks")
+    os.makedirs(locks_dir, exist_ok=True)
+    with open(os.path.join(locks_dir, tag), "w") as f:
+        fcntl.flock(f, fcntl.LOCK_EX)
+        try:
+            yield
+        finally:
+            fcntl.flock(f, fcntl.LOCK_UN)
+
+
+def is_ready(wire: dict | None, session_dir: str) -> bool:
+    """Cheap LOCAL readiness probe — the daemon's fast path: when
+    everything is already materialized, worker spawn skips both the
+    agent RPC and the executor hop."""
+    wire = wire or {}
+    keys = ([wire["working_dir_key"]] if wire.get("working_dir_key")
+            else []) + list(wire.get("py_modules_keys") or ())
+    if any(not is_extracted(k, session_dir) for k in keys):
+        return False
+    if wire.get("pip"):
+        return os.path.exists(os.path.join(
+            venv_dir(wire["pip"], session_dir, "pip"), ".art_ready"))
+    if wire.get("uv"):
+        return os.path.exists(os.path.join(
+            venv_dir(wire["uv"], session_dir, "uv"), ".art_ready"))
+    if wire.get("conda"):
+        try:
+            return conda_env_name(wire["conda"]) in _conda_python_cache
+        except Exception:  # noqa: BLE001 — malformed spec: not ready
+            return False
+    if wire.get("container"):
+        return False      # containers are gated node-side every time
+    return True
+
+
+async def materialize(wire: dict | None, session_dir: str,
+                      kv_get) -> None:
+    """The ONE build sequence (the node agent and the daemon's
+    in-process fallback both run exactly this): fetch + extract staged
+    packages via ``await kv_get(key)``, then build the interpreter
+    layer (pip/uv/conda/container) off the event loop."""
+    import asyncio  # noqa: PLC0415
+
+    wire = wire or {}
+    keys = ([wire["working_dir_key"]] if wire.get("working_dir_key")
+            else []) + list(wire.get("py_modules_keys") or ())
+    for key in keys:
+        if is_extracted(key, session_dir):
+            continue
+        blob = await kv_get(key)
+        if blob is None:
+            raise RuntimeError(
+                f"runtime_env package {key} missing from GCS KV")
+        extract(key, blob, session_dir)
+    if any(wire.get(f) for f in ("pip", "uv", "conda", "container")):
+        # Env materialization is slow (subprocess pip/uv/conda) — off
+        # the event loop.
+        await asyncio.get_running_loop().run_in_executor(
+            None, ensure_env_ready, wire, session_dir)
+
+
 def ensure_venv(pip: list, session_dir: str, tool: str = "pip") -> str:
     """Build (once) the content-addressed venv for a requirement set.
 
@@ -423,7 +496,10 @@ def ensure_venv(pip: list, session_dir: str, tool: str = "pip") -> str:
     with lock:
         if os.path.exists(ready):
             return target
-        return _build_venv(pip, target, tool)
+        with _disk_build_lock(session_dir, os.path.basename(target)):
+            if os.path.exists(ready):   # another PROCESS built it
+                return target
+            return _build_venv(pip, target, tool)
 
 
 def ensure_env_ready(wire: dict, session_dir: str) -> None:
@@ -442,29 +518,30 @@ def ensure_env_ready(wire: dict, session_dir: str) -> None:
         if isinstance(conda, dict):
             exe = _conda_exe()
             name = conda_env_name(conda)
-            probe = subprocess.run(
-                [exe, "env", "list"], capture_output=True, text=True,
-                timeout=120)
-            existing = set()
-            for line in probe.stdout.splitlines():
-                if line and not line.startswith("#"):
-                    first = line.split()[0]
-                    existing.add(os.path.basename(first))
-            if name not in existing:
-                spec = dict(conda, name=name)
-                spec_path = os.path.join(session_dir,
-                                         f"conda_{name}.yml")
-                import yaml as _yaml  # noqa: PLC0415
+            with _disk_build_lock(session_dir, f"conda_{name}"):
+                probe = subprocess.run(
+                    [exe, "env", "list"], capture_output=True, text=True,
+                    timeout=120)
+                existing = set()
+                for line in probe.stdout.splitlines():
+                    if line and not line.startswith("#"):
+                        first = line.split()[0]
+                        existing.add(os.path.basename(first))
+                if name not in existing:
+                    spec = dict(conda, name=name)
+                    spec_path = os.path.join(session_dir,
+                                             f"conda_{name}.yml")
+                    import yaml as _yaml  # noqa: PLC0415
 
-                with open(spec_path, "w") as f:
-                    _yaml.safe_dump(spec, f)
-                proc = subprocess.run(
-                    [exe, "env", "create", "-f", spec_path],
-                    capture_output=True, text=True, timeout=1800)
-                if proc.returncode != 0:
-                    raise RuntimeError(
-                        f"conda env create failed:"
-                        f"\n{proc.stderr[-2000:]}")
+                    with open(spec_path, "w") as f:
+                        _yaml.safe_dump(spec, f)
+                    proc = subprocess.run(
+                        [exe, "env", "create", "-f", spec_path],
+                        capture_output=True, text=True, timeout=1800)
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            f"conda env create failed:"
+                            f"\n{proc.stderr[-2000:]}")
         conda_python(conda)   # resolve + CACHE now (executor thread),
         #                       so the spawn path is pure dict lookup
     elif wire.get("container"):

@@ -157,6 +157,15 @@ METHODS: dict[str, dict] = {
     "DeviceTensorFetch": _m("worker", "{token}", "host tensor bytes"),
     "DeviceTensorFree": _m("worker", "{token}", "bool"),
 
+    # ---- per-node agent (ref: agent_manager.h + runtime_env_agent) ----
+    "BuildRuntimeEnv": _m("agent", "{wire}", "{ok}|{ok: False, error}"),
+    "AgentListLogs": _m("agent", "{}", "[{filename, size}]"),
+    "AgentReadLog": _m("agent", "{filename, offset?, tail?, max_bytes?}",
+                       "{data, next_offset, eof}|{error}"),
+    "AgentMetrics": _m("agent", "{}", "{os gauges}"),
+    "AgentStats": _m("agent", "{}", "{env_builds, log_reads, ...}"),
+    "GetAgentInfo": _m("node", "{}", "{address, alive, restarts}"),
+
     # ---- store service (shared-store HA) ------------------------------
     "StorePut": _m("store", "{table, key, value}", "bool"),
     "StoreGet": _m("store", "{table, key}", "bytes|None"),

@@ -27,6 +27,9 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.3")
 # art.init(_system_config={"include_dashboard": True}) or
 # ART_INCLUDE_DASHBOARD=1.
 os.environ.setdefault("ART_INCLUDE_DASHBOARD", "0")
+# Same for the per-node agent process (runtime-env builds fall back
+# in-process); test_node_agent re-enables it explicitly.
+os.environ.setdefault("ART_ENABLE_NODE_AGENT", "0")
 
 from ant_ray_tpu._private.jax_utils import import_jax  # noqa: E402
 

@@ -1,0 +1,105 @@
+"""Per-node agent processes (ref: src/ray/raylet/agent_manager.h +
+dashboard/agent.py:24 + runtime_env/agent/runtime_env_agent.py:167 —
+the daemon spawns/supervises an agent that builds runtime envs, serves
+logs, and exports OS metrics; builds fall back in-process while the
+agent is down)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu._private.protocol import ClientPool
+
+
+@pytest.fixture()
+def agent_cluster(monkeypatch):
+    monkeypatch.setenv("ART_ENABLE_NODE_AGENT", "1")
+    from ant_ray_tpu._private import config as config_mod
+
+    config_mod._global_config = None
+    art.init(num_cpus=1)
+    from ant_ray_tpu.api import global_worker
+
+    yield global_worker.runtime.node_address
+    art.shutdown()
+    config_mod._global_config = None
+
+
+def _agent_info(node_address, timeout=15):
+    node = ClientPool().get(node_address)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        info = node.call("GetAgentInfo", {}, timeout=5)
+        if info["alive"] and info["address"]:
+            return info
+        time.sleep(0.2)
+    raise AssertionError(f"agent never came up: {info}")
+
+
+def test_agent_spawned_and_serving(agent_cluster):
+    info = _agent_info(agent_cluster)
+    agent = ClientPool().get(info["address"])
+    assert agent.call("Ping", {}, timeout=10) == "pong"
+    metrics = agent.call("AgentMetrics", {}, timeout=10)
+    assert "load_1m" in metrics or "mem_total_kb" in metrics
+    logs = agent.call("AgentListLogs", {}, timeout=10)
+    assert any(e["filename"].startswith("worker-") for e in logs)
+
+
+def test_agent_builds_runtime_env(agent_cluster):
+    """A working_dir env staged through the GCS is extracted BY THE
+    AGENT (delegated build), and the task sees the staged files."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as wd:
+        with open(os.path.join(wd, "payload.txt"), "w") as f:
+            f.write("agent-built")
+
+        @art.remote
+        def read_payload():
+            with open("payload.txt") as fh:
+                return fh.read()
+
+        out = art.get(read_payload.options(
+            runtime_env={"working_dir": wd}).remote(), timeout=60)
+        assert out == "agent-built"
+
+    info = _agent_info(agent_cluster)
+    stats = ClientPool().get(info["address"]).call(
+        "AgentStats", {}, timeout=10)
+    assert stats["env_builds"] >= 1, \
+        f"env build was not delegated to the agent ({stats})"
+
+
+def test_agent_restarts_after_crash(agent_cluster):
+    info = _agent_info(agent_cluster)
+    first_address = info["address"]
+    # Find and kill the agent process.  Match the EXACT NUL-separated
+    # argv pair ("-m", "ant_ray_tpu._private.node_agent") — a substring
+    # match on "node_agent" would also hit any shell/pytest process
+    # whose command line merely mentions this test file.
+    node = ClientPool().get(agent_cluster)
+    killed = False
+    for pid in [int(p) for p in os.listdir("/proc") if p.isdigit()]:
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                argv = f.read().split(b"\0")
+        except OSError:
+            continue
+        if b"-m" in argv and b"ant_ray_tpu._private.node_agent" in argv:
+            os.kill(pid, signal.SIGKILL)
+            killed = True
+    assert killed, "agent process not found"
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        info = node.call("GetAgentInfo", {}, timeout=5)
+        if info["alive"] and info["address"] and \
+                info["address"] != first_address:
+            break
+        time.sleep(0.3)
+    assert info["restarts"] >= 1, f"agent never restarted: {info}"
+    agent = ClientPool().get(info["address"])
+    assert agent.call("Ping", {}, timeout=10) == "pong"

@@ -20,6 +20,7 @@ _SERVICE_SOURCES = (
     "ant_ray_tpu/_private/core.py",
     "ant_ray_tpu/_private/worker_main.py",
     "ant_ray_tpu/_private/store_server.py",
+    "ant_ray_tpu/_private/node_agent.py",
 )
 
 
