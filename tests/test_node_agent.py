@@ -54,6 +54,9 @@ def test_agent_builds_runtime_env(agent_cluster):
     AGENT (delegated build), and the task sees the staged files."""
     import tempfile
 
+    # The daemon falls back to in-process builds until the agent
+    # reports in — wait for it so this test observes the delegation.
+    _agent_info(agent_cluster)
     with tempfile.TemporaryDirectory() as wd:
         with open(os.path.join(wd, "payload.txt"), "w") as f:
             f.write("agent-built")
