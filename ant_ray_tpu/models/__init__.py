@@ -1,6 +1,7 @@
 """Model family implementations (functional JAX, sharding-rule driven)."""
 
-from ant_ray_tpu.models import llama
+from ant_ray_tpu.models import gpt2, llama
+from ant_ray_tpu.models.gpt2 import Gpt2Config
 from ant_ray_tpu.models.llama import LlamaConfig
 
-__all__ = ["LlamaConfig", "llama"]
+__all__ = ["Gpt2Config", "LlamaConfig", "gpt2", "llama"]

@@ -1,0 +1,264 @@
+"""GPT-2 model family — functional JAX, second model family next to
+Llama (ref: the reference serves arbitrary HF model families through
+its vLLM engines; here the engine-facing contract is the same
+functional shape as models/llama.py — config dataclass, param pytree +
+logical dims, ``forward``/``loss_fn`` — so Train/Serve/LLM layers work
+with either family unchanged).
+
+Architecture (GPT-2): learned positional embeddings, pre-LayerNorm
+blocks, fused-qkv multi-head attention, GELU MLP (4x), tied LM head.
+``from_hf_state_dict`` converts a HuggingFace ``GPT2LMHeadModel``
+state dict (Conv1D convention: weights stored (in, out)) so real
+checkpoints load; numerical parity vs the HF torch implementation is
+pinned by tests/test_gpt2.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ant_ray_tpu.ops.attention import attention
+
+
+@dataclasses.dataclass(frozen=True)
+class Gpt2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        return 4 * self.dim
+
+    def num_params(self) -> int:
+        per_layer = (12 * self.dim * self.dim  # qkv + proj + mlp
+                     + 13 * self.dim)          # biases + LN params
+        return (self.vocab_size * self.dim + self.n_positions * self.dim
+                + self.n_layers * per_layer + 2 * self.dim)
+
+
+CONFIGS = {
+    "gpt2": Gpt2Config(),
+    "gpt2-medium": Gpt2Config(dim=1024, n_layers=24, n_heads=16),
+    "gpt2-large": Gpt2Config(dim=1280, n_layers=36, n_heads=20),
+    "tiny": Gpt2Config(vocab_size=257, n_positions=128, dim=64,
+                       n_layers=2, n_heads=4),
+}
+
+
+def param_shapes(config: Gpt2Config) -> dict:
+    d, L = config.dim, config.n_layers
+    return {
+        "wte": (config.vocab_size, d),
+        "wpe": (config.n_positions, d),
+        "layers": {
+            # stacked on the leading axis, executed with lax.scan
+            "ln1_g": (L, d), "ln1_b": (L, d),
+            "qkv_w": (L, d, 3 * d), "qkv_b": (L, 3 * d),
+            "proj_w": (L, d, d), "proj_b": (L, d),
+            "ln2_g": (L, d), "ln2_b": (L, d),
+            "fc_w": (L, d, config.mlp_dim), "fc_b": (L, config.mlp_dim),
+            "out_w": (L, config.mlp_dim, d), "out_b": (L, d),
+        },
+        "lnf_g": (d,), "lnf_b": (d,),
+    }
+
+
+def param_logical_dims(config: Gpt2Config) -> dict:
+    """Logical axis names per parameter (see parallel/sharding.py):
+    TP splits attention heads and the MLP hidden dim; FSDP shards the
+    embedding/model dim."""
+    return {
+        "wte": ("vocab", "embed"),
+        "wpe": (None, "embed"),
+        "layers": {
+            "ln1_g": ("layer", None), "ln1_b": ("layer", None),
+            "qkv_w": ("layer", "embed", "heads"),
+            "qkv_b": ("layer", "heads"),
+            "proj_w": ("layer", "heads", "embed"),
+            "proj_b": ("layer", None),
+            "ln2_g": ("layer", None), "ln2_b": ("layer", None),
+            "fc_w": ("layer", "embed", "mlp"),
+            "fc_b": ("layer", "mlp"),
+            "out_w": ("layer", "mlp", "embed"),
+            "out_b": ("layer", None),
+        },
+        "lnf_g": (None,), "lnf_b": (None,),
+    }
+
+
+def gpt2_rules() -> dict:
+    """Logical-axis → mesh-axis rules: TP splits heads and the MLP
+    hidden dim; FSDP shards the embedding dim; layer axis is scanned,
+    never sharded."""
+    return {"vocab": None, "embed": "fsdp", "heads": "tp",
+            "mlp": "tp", "layer": None, "batch": ("dp", "fsdp"),
+            "seq": "sp"}
+
+
+def param_shardings(config: Gpt2Config, mesh) -> dict:
+    """NamedSharding pytree for jit in_shardings / device_put."""
+    from ant_ray_tpu.parallel.sharding import named_sharding  # noqa: PLC0415
+
+    rules = gpt2_rules()
+
+    def _walk(node):
+        if isinstance(node, dict):
+            return {k: _walk(v) for k, v in node.items()}
+        return named_sharding(mesh, node, rules)
+
+    return _walk(param_logical_dims(config))
+
+
+def init_params(config: Gpt2Config, key) -> dict:
+    """GPT-2 init: N(0, 0.02) weights, zero biases, unit LN gains."""
+    shapes = param_shapes(config)
+    names, leaves = [], []
+
+    def _collect(node, prefix):
+        for k, v in node.items():
+            if isinstance(v, dict):
+                _collect(v, prefix + (k,))
+            else:
+                names.append(prefix + (k,))
+                leaves.append(v)
+
+    _collect(shapes, ())
+    keys = jax.random.split(key, len(leaves))
+
+    def _init(name, shape, k):
+        leaf = name[-1]
+        if leaf.endswith("_b"):
+            return jnp.zeros(shape, config.dtype)
+        if leaf.endswith("_g"):
+            return jnp.ones(shape, config.dtype)
+        return (0.02 * jax.random.normal(k, shape)).astype(config.dtype)
+
+    params: dict = {}
+    for name, shape, k in zip(names, leaves, keys):
+        node = params
+        for part in name[:-1]:
+            node = node.setdefault(part, {})
+        node[name[-1]] = _init(name, shape, k)
+    return params
+
+
+def _layernorm(x, g, b, eps):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * lax.rsqrt(var + eps) * g + b
+
+
+
+
+def _block(layer: dict, x, config: Gpt2Config):
+    B, T, D = x.shape
+    H, hd = config.n_heads, config.head_dim
+    h = _layernorm(x, layer["ln1_g"], layer["ln1_b"], config.norm_eps)
+    qkv = h @ layer["qkv_w"] + layer["qkv_b"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, H, hd)
+    k = k.reshape(B, T, H, hd)
+    v = v.reshape(B, T, H, hd)
+    att = attention(q, k, v, causal=True).reshape(B, T, D)
+    x = x + att @ layer["proj_w"] + layer["proj_b"]
+    h = _layernorm(x, layer["ln2_g"], layer["ln2_b"], config.norm_eps)
+    # GPT-2 uses the tanh GELU approximation (HF "gelu_new").
+    h = jax.nn.gelu(h @ layer["fc_w"] + layer["fc_b"], approximate=True)
+    x = x + h @ layer["out_w"] + layer["out_b"]
+    return x
+
+
+def forward(params: dict, tokens, config: Gpt2Config) -> jax.Array:
+    """Logits for a [B, T] int32 token batch."""
+    B, T = tokens.shape
+    x = params["wte"][tokens] + params["wpe"][:T]
+
+    def body(carry, layer):
+        return jax.checkpoint(
+            lambda c, la: _block(la, c, config))(carry, layer), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = _layernorm(x, params["lnf_g"], params["lnf_b"], config.norm_eps)
+    return x @ params["wte"].T          # tied LM head
+
+
+def loss_fn(params: dict, batch: dict, config: Gpt2Config) -> jax.Array:
+    """Next-token loss; same batch contract as llama.loss_fn — an
+    optional ``mask`` excludes padding positions."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], config)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(
+        logp, targets[..., None], axis=-1)[..., 0]
+    mask = batch.get("mask")
+    if mask is not None:
+        mask = mask[:, 1:]
+        return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1)
+    return jnp.mean(nll)
+
+
+def from_hf_state_dict(state: dict, config: Gpt2Config) -> dict:
+    """Convert a HuggingFace ``GPT2LMHeadModel.state_dict()`` (torch
+    tensors or numpy arrays) to this module's param pytree.  HF's
+    Conv1D stores weights as (in_features, out_features) — the same
+    orientation this model multiplies with, so weights pass through
+    unchanged; only the per-layer tensors are stacked on the leading
+    layer axis for lax.scan."""
+    import numpy as np
+
+    def _np(t):
+        return np.asarray(t.detach().cpu().numpy()
+                          if hasattr(t, "detach") else t)
+
+    def stack(fmt):
+        return jnp.asarray(np.stack(
+            [_np(state[fmt.format(i)]) for i in range(config.n_layers)]
+        ), config.dtype)
+
+    return {
+        "wte": jnp.asarray(_np(state["transformer.wte.weight"]),
+                           config.dtype),
+        "wpe": jnp.asarray(_np(state["transformer.wpe.weight"]),
+                           config.dtype),
+        "layers": {
+            "ln1_g": stack("transformer.h.{}.ln_1.weight"),
+            "ln1_b": stack("transformer.h.{}.ln_1.bias"),
+            "qkv_w": stack("transformer.h.{}.attn.c_attn.weight"),
+            "qkv_b": stack("transformer.h.{}.attn.c_attn.bias"),
+            "proj_w": stack("transformer.h.{}.attn.c_proj.weight"),
+            "proj_b": stack("transformer.h.{}.attn.c_proj.bias"),
+            "fc_w": stack("transformer.h.{}.mlp.c_fc.weight"),
+            "fc_b": stack("transformer.h.{}.mlp.c_fc.bias"),
+            "out_w": stack("transformer.h.{}.mlp.c_proj.weight"),
+            "out_b": stack("transformer.h.{}.mlp.c_proj.bias"),
+            "ln2_g": stack("transformer.h.{}.ln_2.weight"),
+            "ln2_b": stack("transformer.h.{}.ln_2.bias"),
+        },
+        "lnf_g": jnp.asarray(_np(state["transformer.ln_f.weight"]),
+                             config.dtype),
+        "lnf_b": jnp.asarray(_np(state["transformer.ln_f.bias"]),
+                             config.dtype),
+    }
+
+
+def flops_per_token(config: Gpt2Config, seq_len: int) -> float:
+    """6*N matmul FLOPs + attention term (same accounting as
+    llama.flops_per_token)."""
+    n = config.num_params()
+    attn = 12 * config.n_layers * config.dim * seq_len
+    return 6.0 * n + attn
