@@ -1357,12 +1357,36 @@ class GcsServer:
             except Exception:  # noqa: BLE001
                 pass
         del self._placement_groups[payload["pg_id"]]
+        # Actors placed on the group die with it (ref: the reference's
+        # remove_placement_group kills actors using the PG) — the
+        # bundles' resources must actually come free, not stay held by
+        # leases the dead reservation granted.  Kills run CONCURRENTLY:
+        # a wedged node must not serialize the handler 10s per actor.
+        doomed = [actor_rec for actor_rec in self._actors.values()
+                  if actor_rec.state != ACTOR_DEAD
+                  and actor_rec.spec.placement_group_id == payload["pg_id"]]
+
+        async def _kill_quietly(actor_rec):
+            try:
+                await self._kill_actor({
+                    "actor_id": actor_rec.spec.actor_id,
+                    "no_restart": True})
+            except Exception:  # noqa: BLE001 — actor already dying
+                pass
+
+        if doomed:
+            await asyncio.gather(*[_kill_quietly(a) for a in doomed])
         return True
 
     async def _list_placement_groups(self, _payload):
         return {
             pg_id.hex(): {"state": r["state"], "strategy": r["strategy"],
                           "name": r["name"],
+                          # hex, not the raw JobID — this reply feeds
+                          # the dashboard's JSON endpoint directly
+                          "job_id": (r["job_id"].hex()
+                                     if r.get("job_id") is not None
+                                     else None),
                           "bundles": r["bundles"]}
             for pg_id, r in self._placement_groups.items()
         }

@@ -57,15 +57,55 @@ class CheckpointManager:
     """Controller-side retention of reported checkpoints (top-K by
     recency; ref: CheckpointManager keeps top-K)."""
 
-    def __init__(self, storage_path: str, num_to_keep: int | None = None):
+    def __init__(self, storage_path: str, num_to_keep: int | None = None,
+                 restore: bool = False):
         self._storage_path = storage_path
         self._num_to_keep = num_to_keep
         self._checkpoints: list[Checkpoint] = []
         os.makedirs(storage_path, exist_ok=True)
+        if restore:
+            # Restore from disk — OPT-IN (a recreated controller after
+            # controller death).  Safe to adopt everything present
+            # because the fresh incarnation below cleared the dir, so
+            # whatever exists was written by THIS fit.
+            for name in sorted(os.listdir(storage_path)):
+                path = os.path.join(storage_path, name)
+                if name.startswith("checkpoint_") and os.path.isdir(path):
+                    try:
+                        int(name.rsplit("_", 1)[1])
+                    except (ValueError, IndexError):
+                        continue
+                    self._checkpoints.append(
+                        Checkpoint.from_directory(path))
+        else:
+            # Fresh run: the storage path belongs to this run — clear
+            # leftover checkpoint dirs from a previous same-named run
+            # so (a) this run never half-overwrites a stale series and
+            # (b) a later controller-death restore can't adopt a
+            # foreign run's weights.  (Anonymous runs get unique names,
+            # so this only affects deliberate name reuse, which already
+            # overwrote checkpoints progressively.)
+            for name in os.listdir(storage_path):
+                path = os.path.join(storage_path, name)
+                if name.startswith("checkpoint_") and os.path.isdir(path):
+                    shutil.rmtree(path, ignore_errors=True)
 
     @property
     def latest(self) -> Checkpoint | None:
         return self._checkpoints[-1] if self._checkpoints else None
+
+    @property
+    def next_index(self) -> int:
+        """First unused checkpoint index (monotonic across controller
+        incarnations — derived from the highest on-disk index, not the
+        in-memory count, which retention prunes)."""
+        if not self._checkpoints:
+            return 0
+        tail = os.path.basename(self._checkpoints[-1].path)
+        try:
+            return int(tail.rsplit("_", 1)[1]) + 1
+        except (ValueError, IndexError):
+            return len(self._checkpoints)
 
     def register(self, checkpoint: Checkpoint) -> None:
         self._checkpoints.append(checkpoint)

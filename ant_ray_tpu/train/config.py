@@ -41,6 +41,10 @@ class ScalingConfig:
 @dataclasses.dataclass
 class FailureConfig:
     max_failures: int = 0               # worker-group restarts allowed
+    # Controller recreations after the controller ACTOR itself dies
+    # (node loss); a separate budget — multiplying it into max_failures
+    # would turn 2 worker retries into 9 gang launches.
+    max_controller_failures: int = 1
 
 
 @dataclasses.dataclass
@@ -62,6 +66,12 @@ class RunConfig:
             tempfile.gettempdir(), "art_train")
         name = self.name or "run"
         return os.path.join(base, name)
+
+    def pg_name(self) -> str:
+        """The run's placement-group name — ONE definition shared by
+        gang reservation (controller) and leaked-group cleanup
+        (trainer); a drifted copy would silently stop matching."""
+        return f"train-{self.name or 'run'}"
 
 
 @dataclasses.dataclass

@@ -95,17 +95,20 @@ class TrainController:
     """Detached driving actor of one training run."""
 
     def __init__(self, loop_fn, loop_config, scaling: ScalingConfig,
-                 run_config: RunConfig):
+                 run_config: RunConfig, resume: bool = False):
         self._loop_fn = loop_fn
         self._loop_config = loop_config
         self._scaling = scaling
         self._run_config = run_config
         self._storage_path = run_config.resolved_storage_path()
         self._ckpt_manager = CheckpointManager(
-            self._storage_path, run_config.checkpoint_config.num_to_keep)
+            self._storage_path, run_config.checkpoint_config.num_to_keep,
+            restore=resume)
         self._metrics_history: list[dict] = []
         self._latest_metrics: dict = {}
-        self._report_index = 0
+        # Resume past any on-disk checkpoints (a recreated controller
+        # must not reuse their directories).
+        self._report_index = self._ckpt_manager.next_index
         self._lock = threading.Lock()
 
     # ---- called by workers (concurrently with run())
@@ -245,7 +248,7 @@ class TrainController:
                      if k != "TPU"}
             slice_pg = slice_placement_group(
                 scaling.topology, scaling.accelerator_type,
-                name=f"train-{self._run_config.name or 'run'}",
+                name=self._run_config.pg_name(),
                 bundle_extra=extra)
             if scaling.num_workers != slice_pg.num_hosts:
                 slice_pg.remove()
@@ -266,7 +269,7 @@ class TrainController:
             [scaling.worker_resources()
              for _ in range(world)],
             strategy=scaling.placement_strategy,
-            name=f"train-{self._run_config.name or 'run'}")
+            name=self._run_config.pg_name())
         # Elastic groups fail reservations fast — a shrunken cluster
         # should trigger a resize within seconds, not after a two-minute
         # stall on an unplaceable gang.

@@ -41,6 +41,17 @@ class JaxTrainer:
         self._loop_config = train_loop_config
         self._scaling = scaling_config or ScalingConfig()
         self._run_config = run_config or RunConfig()
+        if not self._run_config.name:
+            # Anonymous runs get a per-trainer unique name: two
+            # concurrent fits in one job must not share a PG name (the
+            # leaked-group cleanup would remove the healthy run's
+            # reservation) or a checkpoint dir (a fresh run would
+            # clobber the previous anonymous run's checkpoints).
+            import dataclasses as _dc  # noqa: PLC0415
+            import uuid as _uuid  # noqa: PLC0415
+
+            self._run_config = _dc.replace(
+                self._run_config, name=f"run-{_uuid.uuid4().hex[:6]}")
 
     def fit(self) -> Result:
         import ant_ray_tpu as art  # noqa: PLC0415
@@ -48,21 +59,115 @@ class JaxTrainer:
 
         if not art.is_initialized():
             art.init()
-        controller_cls = art.remote(TrainController).options(
-            max_concurrency=8, num_cpus=0)
-        controller = controller_cls.remote(
-            self._loop, self._loop_config, self._scaling, self._run_config)
+        # Soft-pin the controller to the driver's node: the controller
+        # must survive worker-node loss to run the elastic restart, and
+        # the driver's node is the head for every local flow — an
+        # owned cluster's node_address IS the spawned head, and a
+        # connecting driver gets the first-registered (head) node from
+        # services.find_local_node.  The pin is SOFT (falls back to
+        # DEFAULT if that node is gone) and the controller-death retry
+        # below covers the residual mis-pin cases (e.g. a head that
+        # re-registered after a restart).  Ref: the reference runs its
+        # TrainController where the driver entrypoint lives.
+        strategy = None
         try:
-            result: Result = art.get(
-                controller.run.remote(controller), timeout=None)
-        finally:
+            from ant_ray_tpu.api import global_worker  # noqa: PLC0415
+            from ant_ray_tpu.util.scheduling_strategies import (  # noqa: PLC0415
+                NodeAffinitySchedulingStrategy,
+            )
+
+            runtime = global_worker.runtime
+            my_address = getattr(runtime, "node_address", None)
+            if my_address:
+                node_id = next(
+                    (n["NodeID"] for n in art.nodes()
+                     if n["Alive"] and n["Address"] == my_address), None)
+                if node_id is not None:
+                    strategy = NodeAffinitySchedulingStrategy(
+                        node_id, soft=True)
+        except Exception as e:  # noqa: BLE001 — cluster state probe
+            logger.warning("controller node pin unavailable (%s); "
+                           "using DEFAULT placement", e)
+        controller_cls = art.remote(TrainController).options(
+            max_concurrency=8, num_cpus=0, scheduling_strategy=strategy)
+        # The controller itself can die with a node (the soft pin only
+        # covers owned-cluster drivers) — recreate it up to
+        # max_failures times; run() resumes from the latest persisted
+        # checkpoint, so a controller loss costs the current interval,
+        # not the run (ref: Trainer.restore semantics).
+        from ant_ray_tpu.exceptions import ActorDiedError  # noqa: PLC0415
+
+        retries = max(
+            0, self._run_config.failure_config.max_controller_failures)
+        for attempt in range(retries + 1):
+            controller = controller_cls.remote(
+                self._loop, self._loop_config, self._scaling,
+                self._run_config, attempt > 0)
             try:
-                art.kill(controller)
-            except Exception:  # noqa: BLE001
-                pass
+                result: Result = art.get(
+                    controller.run.remote(controller), timeout=None)
+                break
+            except ActorDiedError:
+                if attempt == retries:
+                    # Final failure still must not leak the gang: the
+                    # dead controller never ran its PG release, and the
+                    # PG removal also kills the orphaned workers.
+                    self._release_leaked_groups(art)
+                    raise
+                logger.warning(
+                    "train controller died (attempt %d/%d); recreating "
+                    "— resumes from the latest checkpoint IN "
+                    "storage_path (%s); node-local paths restart from "
+                    "scratch after node loss",
+                    attempt + 1, retries + 1,
+                    self._run_config.resolved_storage_path())
+                self._release_leaked_groups(art)
+            finally:
+                try:
+                    art.kill(controller)
+                except Exception:  # noqa: BLE001
+                    pass
         if result.error is not None:
             raise result.error
         return result
+
+
+    def _release_leaked_groups(self, art) -> None:
+        """A controller that died with its node never ran its PG
+        release — remove this run's leftover reservations so the
+        recreated controller's gang can actually place (there is no
+        GCS owner-fate-sharing for placement groups)."""
+        from ant_ray_tpu._private.ids import PlacementGroupID  # noqa: PLC0415
+        from ant_ray_tpu.util.placement_group import (  # noqa: PLC0415
+            PlacementGroup,
+            placement_group_table,
+            remove_placement_group,
+        )
+
+        pg_name = self._run_config.pg_name()
+        try:
+            from ant_ray_tpu.api import global_worker  # noqa: PLC0415
+
+            my_job = getattr(global_worker.runtime, "job_id", None)
+            my_job_hex = my_job.hex() if my_job is not None else None
+            for pg_hex, rec in placement_group_table().items():
+                if rec.get("name") != pg_name or \
+                        rec.get("state") == "REMOVED":
+                    continue
+                # Scope by job: another driver's same-named run must
+                # not lose its live reservation to our cleanup.  (Runs
+                # within one job are disambiguated by the unique
+                # anonymous-run names assigned in __init__.)
+                if rec.get("job_id") is not None \
+                        and my_job_hex is not None \
+                        and rec["job_id"] != my_job_hex:
+                    continue
+                remove_placement_group(PlacementGroup(
+                    id=PlacementGroupID.from_hex(pg_hex),
+                    bundles=tuple(rec.get("bundles", ())),
+                    strategy=rec.get("strategy", "PACK")))
+        except Exception as e:  # noqa: BLE001 — best-effort cleanup
+            logger.warning("leaked placement-group cleanup failed: %s", e)
 
 
 # Alias mirroring the reference's generic data-parallel trainer name.
