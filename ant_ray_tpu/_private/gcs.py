@@ -942,6 +942,8 @@ class GcsServer:
                 "state": r.state,
                 "address": r.address,
                 "name": r.spec.name,
+                "job_id": (r.spec.job_id.hex()
+                           if r.spec.job_id is not None else None),
                 "death_reason": r.death_reason,
             }
             for r in self._actors.values()

@@ -57,7 +57,11 @@ def gpipe_kernel(stage_fn, stage_params, microbatches, *, axis_name: str,
 
     # The carry becomes pp-varying after the first ppermute; mark the
     # initial value accordingly (microbatches are replicated over pp).
-    pending0 = lax.pvary(jnp.zeros_like(microbatches[0]), axis_name)
+    zeros0 = jnp.zeros_like(microbatches[0])
+    if hasattr(lax, "pcast"):          # jax >= the pvary deprecation
+        pending0 = lax.pcast(zeros0, axis_name, to="varying")
+    else:
+        pending0 = lax.pvary(zeros0, axis_name)
     _, stage_outs = lax.scan(tick, pending0, jnp.arange(ticks))
 
     # Microbatch j leaves the last stage at tick j + axis_size - 1;

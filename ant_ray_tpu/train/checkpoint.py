@@ -57,17 +57,37 @@ class CheckpointManager:
     """Controller-side retention of reported checkpoints (top-K by
     recency; ref: CheckpointManager keeps top-K)."""
 
+    # Per-fit token file: <storage>/.run_token names the CURRENT fit;
+    # each registered checkpoint carries a copy inside its dir.  A
+    # controller-death restore adopts ONLY token-matching checkpoints,
+    # so a previous same-named run's leftovers are never resumed from —
+    # and nothing is ever deleted up front (a relaunch that crashes
+    # before its first checkpoint must not have destroyed the old ones).
+    _TOKEN_FILE = ".run_token"
+
     def __init__(self, storage_path: str, num_to_keep: int | None = None,
-                 restore: bool = False):
+                 restore: bool = False, run_token: str | None = None):
+        import uuid  # noqa: PLC0415
+
         self._storage_path = storage_path
         self._num_to_keep = num_to_keep
         self._checkpoints: list[Checkpoint] = []
         os.makedirs(storage_path, exist_ok=True)
+        token_path = os.path.join(storage_path, self._TOKEN_FILE)
+        # The fit's token comes from the TRAINER when it drives the
+        # controller (one token for every incarnation of one fit, so a
+        # pre-first-checkpoint controller death can't resurrect a
+        # previous run's token); standalone use generates one.
+        self._token = run_token or ""
         if restore:
-            # Restore from disk — OPT-IN (a recreated controller after
-            # controller death).  Safe to adopt everything present
-            # because the fresh incarnation below cleared the dir, so
-            # whatever exists was written by THIS fit.
+            if not self._token:
+                try:
+                    with open(token_path) as f:
+                        self._token = f.read().strip()
+                except OSError:
+                    self._token = ""
+            # Restore — OPT-IN (a recreated controller after controller
+            # death): adopt this fit's checkpoints, identified by token.
             for name in sorted(os.listdir(storage_path)):
                 path = os.path.join(storage_path, name)
                 if name.startswith("checkpoint_") and os.path.isdir(path):
@@ -75,20 +95,22 @@ class CheckpointManager:
                         int(name.rsplit("_", 1)[1])
                     except (ValueError, IndexError):
                         continue
-                    self._checkpoints.append(
-                        Checkpoint.from_directory(path))
+                    if self._token and self._read_token(path) == \
+                            self._token:
+                        self._checkpoints.append(
+                            Checkpoint.from_directory(path))
         else:
-            # Fresh run: the storage path belongs to this run — clear
-            # leftover checkpoint dirs from a previous same-named run
-            # so (a) this run never half-overwrites a stale series and
-            # (b) a later controller-death restore can't adopt a
-            # foreign run's weights.  (Anonymous runs get unique names,
-            # so this only affects deliberate name reuse, which already
-            # overwrote checkpoints progressively.)
-            for name in os.listdir(storage_path):
-                path = os.path.join(storage_path, name)
-                if name.startswith("checkpoint_") and os.path.isdir(path):
-                    shutil.rmtree(path, ignore_errors=True)
+            self._token = self._token or uuid.uuid4().hex
+            with open(token_path, "w") as f:
+                f.write(self._token)
+
+    @classmethod
+    def _read_token(cls, checkpoint_dir: str) -> str | None:
+        try:
+            with open(os.path.join(checkpoint_dir, cls._TOKEN_FILE)) as f:
+                return f.read().strip()
+        except OSError:
+            return None
 
     @property
     def latest(self) -> Checkpoint | None:
@@ -96,18 +118,37 @@ class CheckpointManager:
 
     @property
     def next_index(self) -> int:
-        """First unused checkpoint index (monotonic across controller
-        incarnations — derived from the highest on-disk index, not the
-        in-memory count, which retention prunes)."""
-        if not self._checkpoints:
-            return 0
-        tail = os.path.basename(self._checkpoints[-1].path)
+        """First unused checkpoint index: highest existing index ON
+        DISK + 1 (adopted or not — a restore that declines foreign
+        dirs must not start overwriting them either), monotonic across
+        controller incarnations."""
+        best = -1
         try:
-            return int(tail.rsplit("_", 1)[1]) + 1
-        except (ValueError, IndexError):
-            return len(self._checkpoints)
+            for name in os.listdir(self._storage_path):
+                if name.startswith("checkpoint_"):
+                    try:
+                        best = max(best, int(name.rsplit("_", 1)[1]))
+                    except (ValueError, IndexError):
+                        continue
+        except OSError:
+            pass
+        return best + 1
 
     def register(self, checkpoint: Checkpoint) -> None:
+        try:
+            # Stamp the fit's token into the dir (see _TOKEN_FILE note).
+            with open(os.path.join(checkpoint.path,
+                                   self._TOKEN_FILE), "w") as f:
+                f.write(self._token)
+        except OSError as e:
+            # An unstamped checkpoint is invisible to a controller-
+            # death restore — losable progress deserves a breadcrumb.
+            import logging  # noqa: PLC0415
+
+            logging.getLogger(__name__).warning(
+                "could not stamp run token into %s (%s); this "
+                "checkpoint will not be adopted by a restore",
+                checkpoint.path, e)
         self._checkpoints.append(checkpoint)
         if self._num_to_keep is not None:
             while len(self._checkpoints) > self._num_to_keep:

@@ -95,7 +95,8 @@ class TrainController:
     """Detached driving actor of one training run."""
 
     def __init__(self, loop_fn, loop_config, scaling: ScalingConfig,
-                 run_config: RunConfig, resume: bool = False):
+                 run_config: RunConfig, resume: bool = False,
+                 run_token: str | None = None):
         self._loop_fn = loop_fn
         self._loop_config = loop_config
         self._scaling = scaling
@@ -103,7 +104,7 @@ class TrainController:
         self._storage_path = run_config.resolved_storage_path()
         self._ckpt_manager = CheckpointManager(
             self._storage_path, run_config.checkpoint_config.num_to_keep,
-            restore=resume)
+            restore=resume, run_token=run_token)
         self._metrics_history: list[dict] = []
         self._latest_metrics: dict = {}
         # Resume past any on-disk checkpoints (a recreated controller
@@ -186,9 +187,17 @@ class TrainController:
             base_opts = {"resources": scaling.worker_resources(),
                          "num_cpus": 0}
             worker_cls = remote(TrainWorker)
+            import uuid as _uuid  # noqa: PLC0415
+
+            # Unique per-incarnation names: the trainer's leaked-worker
+            # cleanup after a controller death finds survivors by the
+            # "<pg_name>-w" prefix (a PG-less world<=1 run has no
+            # placement group whose removal would kill them).
+            tag = _uuid.uuid4().hex[:4]
             workers = [
                 worker_cls.options(
                     **base_opts,
+                    name=f"{self._run_config.pg_name()}-w{rank}-{tag}",
                     placement_group=pg,
                     # Rank r on bundle r: with a slice PG this pins rank
                     # r to the slice host with tpu-worker-id == r (ICI

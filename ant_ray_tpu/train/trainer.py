@@ -99,10 +99,17 @@ class JaxTrainer:
 
         retries = max(
             0, self._run_config.failure_config.max_controller_failures)
+        # The trainer OWNS the fit's checkpoint run-token: a retry then
+        # adopts only checkpoints this fit stamped, even if an earlier
+        # controller died before writing any token (a stale .run_token
+        # from a previous same-named run can never match).
+        import uuid as _uuid  # noqa: PLC0415
+
+        run_token = _uuid.uuid4().hex
         for attempt in range(retries + 1):
             controller = controller_cls.remote(
                 self._loop, self._loop_config, self._scaling,
-                self._run_config, attempt > 0)
+                self._run_config, attempt > 0, run_token)
             try:
                 result: Result = art.get(
                     controller.run.remote(controller), timeout=None)
@@ -113,6 +120,7 @@ class JaxTrainer:
                     # dead controller never ran its PG release, and the
                     # PG removal also kills the orphaned workers.
                     self._release_leaked_groups(art)
+                    self._kill_leaked_workers(art)
                     raise
                 logger.warning(
                     "train controller died (attempt %d/%d); recreating "
@@ -122,6 +130,7 @@ class JaxTrainer:
                     attempt + 1, retries + 1,
                     self._run_config.resolved_storage_path())
                 self._release_leaked_groups(art)
+                self._kill_leaked_workers(art)
             finally:
                 try:
                     art.kill(controller)
@@ -168,6 +177,36 @@ class JaxTrainer:
                     strategy=rec.get("strategy", "PACK")))
         except Exception as e:  # noqa: BLE001 — best-effort cleanup
             logger.warning("leaked placement-group cleanup failed: %s", e)
+
+    def _kill_leaked_workers(self, art) -> None:
+        """Kill this run's surviving TrainWorker actors by their
+        "<pg_name>-w" name prefix — a PG-less run (world<=1, no TPU)
+        has no placement group whose removal would take them down, so
+        they would otherwise hold their resources until job teardown."""
+        from ant_ray_tpu._private.ids import ActorID  # noqa: PLC0415
+        from ant_ray_tpu.api import global_worker  # noqa: PLC0415
+
+        prefix = f"{self._run_config.pg_name()}-w"
+        try:
+            runtime = global_worker.runtime
+            my_job = getattr(runtime, "job_id", None)
+            my_job_hex = my_job.hex() if my_job is not None else None
+            gcs = runtime._gcs
+            for rec in gcs.call("ListActors", retries=3):
+                if not (rec.get("name") or "").startswith(prefix) or \
+                        rec.get("state") == "DEAD":
+                    continue
+                # Job-scoped, like the PG cleanup: another job's
+                # same-named run keeps its workers.
+                if rec.get("job_id") is not None \
+                        and my_job_hex is not None \
+                        and rec["job_id"] != my_job_hex:
+                    continue
+                gcs.call("KillActor", {
+                    "actor_id": ActorID.from_hex(rec["actor_id"]),
+                    "no_restart": True}, retries=3)
+        except Exception as e:  # noqa: BLE001 — best-effort cleanup
+            logger.warning("leaked worker cleanup failed: %s", e)
 
 
 # Alias mirroring the reference's generic data-parallel trainer name.

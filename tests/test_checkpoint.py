@@ -184,3 +184,36 @@ def test_head_split_metadata_rejects_mismatch(tmp_path):
     checkpoint.load_params(path, cfg_a)
     with pytest.raises(ValueError, match="head split"):
         checkpoint.load_params(path, cfg_b)
+
+
+def test_restore_adopts_only_this_fits_checkpoints(tmp_path):
+    """Token-scoped restore: a recreated controller (restore=True) must
+    adopt the CURRENT fit's checkpoints, never a previous same-named
+    run's leftovers — and a fresh manager must not delete them."""
+    import os
+
+    from ant_ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+
+    storage = str(tmp_path / "exp1")
+    # Run A writes two checkpoints.
+    mgr_a = CheckpointManager(storage, restore=False)
+    for i in range(2):
+        path = mgr_a.next_checkpoint_dir(i)
+        os.makedirs(path)
+        mgr_a.register(Checkpoint.from_directory(path))
+    # Run B starts fresh on the same path: the old dirs SURVIVE...
+    mgr_b = CheckpointManager(storage, restore=False)
+    assert os.path.isdir(mgr_a.next_checkpoint_dir(0))
+    assert mgr_b.latest is None
+    # ...and a controller-death restore during run B adopts nothing of
+    # run A's.
+    restored_early = CheckpointManager(storage, restore=True)
+    assert restored_early.latest is None
+    # Run B writes one checkpoint; a later restore adopts exactly it.
+    path_b = mgr_b.next_checkpoint_dir(5)
+    os.makedirs(path_b)
+    mgr_b.register(Checkpoint.from_directory(path_b))
+    restored = CheckpointManager(storage, restore=True)
+    assert restored.latest is not None
+    assert restored.latest.path == os.path.abspath(path_b)
+    assert restored.next_index == 6
