@@ -151,9 +151,15 @@ class CheckpointManager:
                 checkpoint.path, e)
         self._checkpoints.append(checkpoint)
         if self._num_to_keep is not None:
+            # Normalized containment check: checkpoint paths are
+            # abspathed, so a relative storage_path would never prefix-
+            # match (silently disabling retention), and a bare prefix
+            # without the trailing separator could cross sibling dirs
+            # ("/a/exp10" startswith "/a/exp1").
+            root = os.path.abspath(self._storage_path) + os.sep
             while len(self._checkpoints) > self._num_to_keep:
                 stale = self._checkpoints.pop(0)
-                if stale.path.startswith(self._storage_path):
+                if stale.path.startswith(root):
                     shutil.rmtree(stale.path, ignore_errors=True)
 
     def next_checkpoint_dir(self, index: int) -> str:

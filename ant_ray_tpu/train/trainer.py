@@ -155,21 +155,12 @@ class JaxTrainer:
 
         pg_name = self._run_config.pg_name()
         try:
-            from ant_ray_tpu.api import global_worker  # noqa: PLC0415
-
-            my_job = getattr(global_worker.runtime, "job_id", None)
-            my_job_hex = my_job.hex() if my_job is not None else None
+            my_job_hex = self._my_job_hex()
             for pg_hex, rec in placement_group_table().items():
                 if rec.get("name") != pg_name or \
                         rec.get("state") == "REMOVED":
                     continue
-                # Scope by job: another driver's same-named run must
-                # not lose its live reservation to our cleanup.  (Runs
-                # within one job are disambiguated by the unique
-                # anonymous-run names assigned in __init__.)
-                if rec.get("job_id") is not None \
-                        and my_job_hex is not None \
-                        and rec["job_id"] != my_job_hex:
+                if self._foreign_job(rec, my_job_hex):
                     continue
                 remove_placement_group(PlacementGroup(
                     id=PlacementGroupID.from_hex(pg_hex),
@@ -177,6 +168,20 @@ class JaxTrainer:
                     strategy=rec.get("strategy", "PACK")))
         except Exception as e:  # noqa: BLE001 — best-effort cleanup
             logger.warning("leaked placement-group cleanup failed: %s", e)
+
+    @staticmethod
+    def _my_job_hex() -> str | None:
+        from ant_ray_tpu.api import global_worker  # noqa: PLC0415
+
+        my_job = getattr(global_worker.runtime, "job_id", None)
+        return my_job.hex() if my_job is not None else None
+
+    @staticmethod
+    def _foreign_job(rec: dict, my_job_hex: str | None) -> bool:
+        """Cleanup scope: another job's same-named run keeps its
+        reservations and workers (one rule for both cleanups)."""
+        return (rec.get("job_id") is not None and my_job_hex is not None
+                and rec["job_id"] != my_job_hex)
 
     def _kill_leaked_workers(self, art) -> None:
         """Kill this run's surviving TrainWorker actors by their
@@ -188,19 +193,13 @@ class JaxTrainer:
 
         prefix = f"{self._run_config.pg_name()}-w"
         try:
-            runtime = global_worker.runtime
-            my_job = getattr(runtime, "job_id", None)
-            my_job_hex = my_job.hex() if my_job is not None else None
-            gcs = runtime._gcs
+            my_job_hex = self._my_job_hex()
+            gcs = global_worker.runtime._gcs
             for rec in gcs.call("ListActors", retries=3):
                 if not (rec.get("name") or "").startswith(prefix) or \
                         rec.get("state") == "DEAD":
                     continue
-                # Job-scoped, like the PG cleanup: another job's
-                # same-named run keeps its workers.
-                if rec.get("job_id") is not None \
-                        and my_job_hex is not None \
-                        and rec["job_id"] != my_job_hex:
+                if self._foreign_job(rec, my_job_hex):
                     continue
                 gcs.call("KillActor", {
                     "actor_id": ActorID.from_hex(rec["actor_id"]),

@@ -183,9 +183,15 @@ class PopulationBasedTraining(TrialScheduler):
         t = result.get(self._time_attr, 0)
         if t - self._last_perturb.get(trial_id, 0) < self._interval:
             return CONTINUE
-        self._last_perturb[trial_id] = t
         lower, upper = self._quantiles()
-        if trial_id not in lower or not upper:
+        if not upper or len(self._scores) < 2:
+            # Population not comparable yet (peers haven't reported a
+            # score): DEFER — consuming the boundary here would burn
+            # this trial's perturbation chance on a race it didn't
+            # lose, postponing the exploit by a whole interval.
+            return CONTINUE
+        self._last_perturb[trial_id] = t
+        if trial_id not in lower:
             return CONTINUE
         source = self._rng.choice(upper)
         new_config = self._explore(self._configs[source])
@@ -199,7 +205,21 @@ class PopulationBasedTraining(TrialScheduler):
         self._configs[trial_id] = dict(config)
 
     def on_trial_complete(self, trial_id: str, result: dict | None) -> None:
-        self._scores.pop(trial_id, None)
+        # A COMPLETED trial stays in the population: it remains both a
+        # comparison baseline and an exploitation source (the tuner
+        # snapshots its final checkpoint) — popping it here made a
+        # slow-starting peer's population permanently incomparable, so
+        # the peer could finish its whole run unexploited (ref: PBT
+        # keeps trial state for the life of the run, pbt.py:315).
+        # An ERRORED trial (result None) leaves: a crashed trial has no
+        # snapshot to exploit, and its stale score would skew quantiles
+        # as a phantom source forever.
+        if result is None:
+            self._scores.pop(trial_id, None)
+            return
+        value = _metric_value(result, self._metric, self._mode)
+        if value is not None and not math.isnan(value):
+            self._scores[trial_id] = value
 
     # -------------------------------------------------------- internals
 

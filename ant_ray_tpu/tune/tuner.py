@@ -272,8 +272,22 @@ class Tuner:
             step_refs[actor.step.remote()] = tid
             return True
 
+        final_states: dict[str, object] = {}  # tid -> checkpoint state
+        pbt_active = isinstance(scheduler, _sched.PopulationBasedTraining)
+
         def _finish(trial: _Trial, *, error: Exception | None = None):
             trials.pop(trial.id, None)
+            if error is None and pbt_active:
+                # Snapshot the final checkpoint BEFORE killing the
+                # actor: a still-running PBT peer may exploit this
+                # completed trial later.  Only PBT reads these — for
+                # ASHA/FIFO sweeps a per-trial full-state snapshot
+                # would be pure driver-memory bloat.
+                try:
+                    final_states[trial.id] = art.get(
+                        trial.actor.save.remote())
+                except Exception:  # noqa: BLE001 — actor already gone
+                    pass
             scheduler.on_trial_complete(trial.id,
                                         None if error else trial.last)
             searcher.on_trial_complete(trial.id,
@@ -329,9 +343,13 @@ class Tuner:
                     continue
                 if isinstance(decision, _sched.Exploit):
                     source = trials.get(decision.source_trial_id)
-                    if source is not None:
-                        try:
+                    cached = final_states.get(decision.source_trial_id)
+                    try:
+                        if source is not None:
                             state = art.get(source.actor.save.remote())
+                        else:
+                            state = cached  # completed source (or None)
+                        if state is not None:
                             art.get(trial.actor.restore.remote(
                                 state, decision.config))
                             trial.config = decision.config
@@ -339,10 +357,10 @@ class Tuner:
                                               "on_exploit_applied", None)
                             if applied is not None:
                                 applied(tid, decision.config)
-                        except Exception as e:  # noqa: BLE001
-                            logger.warning(
-                                "PBT exploit of %s from %s failed "
-                                "(%r); trial continues unperturbed",
-                                tid, decision.source_trial_id, e)
+                    except Exception as e:  # noqa: BLE001
+                        logger.warning(
+                            "PBT exploit of %s from %s failed "
+                            "(%r); trial continues unperturbed",
+                            tid, decision.source_trial_id, e)
                 step_refs[trial.actor.step.remote()] = tid
         return ResultGrid(results)

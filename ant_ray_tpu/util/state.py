@@ -31,6 +31,7 @@ class ActorState:
     address: str
     name: str
     death_reason: str
+    job_id: str | None = None
 
 
 def list_nodes() -> list[NodeState]:

@@ -9,6 +9,17 @@ from ant_ray_tpu import tune
 @pytest.fixture(scope="module")
 def cluster():
     art.init(num_cpus=4, num_tpus=0)
+
+    @art.remote
+    def _warm(i):
+        return i
+
+    # Warm the worker pool: async PBT only exploits while the
+    # population overlaps — on a cold pool one trial can finish before
+    # its peer's actor even starts (same property as the reference's
+    # synch=False PBT), which turns the exploitation test into a coin
+    # flip on worker-spawn order.
+    art.get([_warm.remote(i) for i in range(4)])
     yield None
     art.shutdown()
 
