@@ -189,6 +189,9 @@ class ClusterRuntime(CoreRuntime):
         self.memory = MemoryStore(self._io.loop)
         self.server = RpcServer()
         self.server.routes({
+            # Liveness probe (the node daemon's lease-owner sweep pings
+            # lessees; an unroutable Ping would read as "owner dead").
+            "Ping": self._handle_ping,
             "GetObject": self._handle_get_object,
             "GetObjectStatus": self._handle_get_object_status,
             "GetObjectInfo": self._handle_get_object_info,
@@ -197,6 +200,7 @@ class ClusterRuntime(CoreRuntime):
             "ReconstructObject": self._handle_reconstruct_object,
             "DeviceTensorFetch": self._handle_device_tensor_fetch,
             "DeviceTensorFree": self._handle_device_tensor_free,
+            "DeviceTensorSendVia": self._handle_device_tensor_send_via,
             "StreamItem": self._handle_stream_item,
         })
         self._streams: dict[TaskID, _StreamState] = {}
@@ -454,6 +458,9 @@ class ClusterRuntime(CoreRuntime):
                 pass
 
         asyncio.run_coroutine_threadsafe(_send(), self._io.loop)
+
+    async def _handle_ping(self, _payload):
+        return "pong"
 
     async def _handle_borrow_add(self, payload):
         with self._ref_lock:
@@ -1104,6 +1111,9 @@ class ClusterRuntime(CoreRuntime):
         lease_payload = {"resources": state.resources,
                          "runtime_env": state.runtime_env,
                          "job_id": self.job_id,
+                         # Lessee identity: the daemon reclaims this
+                         # lease if the owner dies before ReturnWorker.
+                         "owner": self.address,
                          "label_selector": state.label_selector,
                          "strategy": state.strategy}
         if state.queue:
@@ -1553,6 +1563,27 @@ class ClusterRuntime(CoreRuntime):
 
     async def _handle_device_tensor_free(self, payload):
         self._device_objects.pop(payload["token"], None)
+        return True
+
+    async def _handle_device_tensor_send_via(self, payload):
+        """Collective-transport trigger: push the sharded array's
+        shards to the requesting consumer over the collective group
+        (ref capability: collective_tensor_transport's sender side).
+        Replies immediately with whether the token exists — the reply
+        is the consumer's go/no-go BEFORE it parks in recv (a missing
+        token must surface as ObjectLost, not a recv hang); the sends
+        themselves run in an executor, blocking until the consumer's
+        recvs match."""
+        array = self._device_objects.get(payload["token"])
+        if array is None:
+            return False
+        from ant_ray_tpu.experimental.tensor_transport import (  # noqa: PLC0415
+            send_shards,
+        )
+
+        asyncio.get_running_loop().run_in_executor(
+            None, send_shards, array, payload["dst_rank"],
+            payload["group"])
         return True
 
     def _fetch_device_tensor(self, holder: str, token: str,

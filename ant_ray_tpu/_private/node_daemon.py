@@ -25,7 +25,13 @@ from dataclasses import dataclass, field
 from ant_ray_tpu._private.config import global_config
 from ant_ray_tpu._private.ids import NodeID, ObjectID, WorkerID
 from ant_ray_tpu._private.object_store import ObjectStore, default_store_capacity
-from ant_ray_tpu._private.protocol import ClientPool, IoThread, RpcServer
+from ant_ray_tpu._private.protocol import (
+    ClientPool,
+    IoThread,
+    RpcConnectionError,
+    RpcServer,
+    RpcTimeoutError,
+)
 from ant_ray_tpu._private.specs import ACTOR_DEAD, ActorSpec, NodeInfo
 
 logger = logging.getLogger(__name__)
@@ -69,6 +75,7 @@ class WorkerHandle:
     state: str = STARTING
     lease_resources: dict[str, float] = field(default_factory=dict)
     lease_pg: tuple | None = None        # (pg_id, bundle_index) if any
+    lease_owner: str = ""                # lessee's core-service address
     actor_spec: ActorSpec | None = None
     job_id: object | None = None         # last job served (log scoping)
     blocked: bool = False
@@ -190,6 +197,7 @@ class NodeManager:
             "DeleteObject": self._delete_object,
             "ContainsObject": self._contains_object,
             "GetNodeInfo": self._get_node_info,
+            "DebugResources": self._debug_resources,
             "GetSyncStats": self._get_sync_stats,
             "GetAgentInfo": self._get_agent_info,
             "GetStoreStats": self._get_store_stats,
@@ -374,6 +382,30 @@ class NodeManager:
 
     async def _get_node_info(self, _payload):
         return self._node_info()
+
+    async def _debug_resources(self, _payload):
+        """Resource-ledger dump for `art stack`-style debugging: who
+        holds what, which workers are blocked, and each bundle pool."""
+        return {
+            "available": dict(self._available),
+            "bundles": {f"{k[0].hex() if hasattr(k[0], 'hex') else k[0]}"
+                        f"#{k[1]}": {"capacity": dict(b["resources"]),
+                                     "available": dict(b["available"])}
+                        for k, b in self._bundles.items()},
+            "workers": [{
+                "worker_id": wid.hex() if hasattr(wid, "hex") else str(wid),
+                "state": h.state,
+                "blocked": h.blocked,
+                "lease": dict(h.lease_resources or {}),
+                "actor": (h.actor_spec.class_name
+                          if h.actor_spec is not None and
+                          hasattr(h.actor_spec, "class_name")
+                          else (h.actor_spec.actor_id.hex()
+                                if h.actor_spec is not None else None)),
+                "actor_resources": (dict(h.actor_spec.resources)
+                                    if h.actor_spec is not None else None),
+            } for wid, h in self._workers.items()],
+        }
 
     async def _get_sync_stats(self, _payload):
         return dict(self.sync_stats)
@@ -618,10 +650,14 @@ class NodeManager:
             if self._subreaper_enabled and now - last_orphan_sweep > 2.0:
                 last_orphan_sweep = now
                 self._reap_orphans()
+            self._sweep_lease_owners(now)
             for worker_id, handle in list(self._workers.items()):
                 if handle.proc.poll() is None:
                     continue
                 del self._workers[worker_id]
+                # A dead worker may itself be a lessee (nested task
+                # submission): reclaim whatever it still leased.
+                self._reclaim_leases_of(handle.address)
                 if handle.state == LEASED and not handle.blocked:
                     if handle.lease_pg is not None:
                         self._bundle_release(handle.lease_pg,
@@ -629,7 +665,8 @@ class NodeManager:
                     else:
                         self._release(handle.lease_resources)
                 if handle.state == ACTOR and handle.actor_spec is not None:
-                    self._release_actor_resources(handle.actor_spec)
+                    if not handle.blocked:  # blocked already released
+                        self._release_actor_resources(handle.actor_spec)
                     # Death reports must survive a GCS restart window —
                     # fire-and-forget here loses the actor forever
                     # (restored as ALIVE on resync with no one to
@@ -1127,6 +1164,7 @@ class NodeManager:
                         worker.state = LEASED
                         worker.lease_resources = dict(demand)
                         worker.lease_pg = pg_key
+                        worker.lease_owner = payload.get("owner") or ""
                         worker.job_id = job_id
                         return {"granted": worker.address,
                                 "worker_id": worker.worker_id}
@@ -1198,6 +1236,7 @@ class NodeManager:
                     self._allocate(demand)
                     worker.state = LEASED
                     worker.lease_resources = dict(demand)
+                    worker.lease_owner = payload.get("owner") or ""
                     worker.job_id = job_id
                     return {"granted": worker.address,
                             "worker_id": worker.worker_id}
@@ -1236,33 +1275,128 @@ class NodeManager:
             handle.blocked = False
             handle.lease_resources = {}
             handle.lease_pg = None
+            handle.lease_owner = ""
             handle.state = IDLE
             self._lease_event.set()
         return True
 
+    def _sweep_lease_owners(self, now: float) -> None:
+        """Periodic lessee liveness check for owners NOT on this node
+        (drivers, remote workers): a dead owner's lease can't be
+        reclaimed by the local worker-death path above."""
+        if now - getattr(self, "_last_owner_sweep", 0.0) < 3.0 or \
+                getattr(self, "_owner_sweep_running", False):
+            return
+        self._last_owner_sweep = now
+        local = {h.address for h in self._workers.values() if h.address}
+        owners = {h.lease_owner for h in self._workers.values()
+                  if h.state == LEASED and h.lease_owner
+                  and h.lease_owner not in local}
+        if not owners:
+            return
+
+        fails: dict = getattr(self, "_owner_ping_fails", None)
+        if fails is None:
+            fails = self._owner_ping_fails = {}
+        for stale in [a for a in fails if a not in owners]:
+            del fails[stale]   # else a later re-lease inherits old strikes
+
+        async def _sweep():
+            self._owner_sweep_running = True
+            try:
+                for addr in owners:
+                    try:
+                        await self._clients.get(addr).call_async(
+                            "Ping", {}, timeout=2)
+                        fails.pop(addr, None)
+                    except (RpcConnectionError, RpcTimeoutError):
+                        # Both refusals and black holes (established
+                        # connection, no reply) count; one miss can be
+                        # a loaded-but-alive owner, so reclaim only
+                        # after two consecutive failures.
+                        fails[addr] = fails.get(addr, 0) + 1
+                        if fails[addr] >= 2:
+                            fails.pop(addr, None)
+                            self._reclaim_leases_of(addr)
+                    except Exception:  # noqa: BLE001 — reachable but
+                        fails.pop(addr, None)  # erroring owner is alive
+            finally:
+                self._owner_sweep_running = False
+
+        asyncio.ensure_future(_sweep())
+
+    def _reclaim_leases_of(self, owner_address: str) -> None:
+        """Reclaim leases whose lessee died (ref: the raylet cancels
+        leases on owner death — a dead owner can never send
+        ReturnWorker, so its leases would pin resources forever; this
+        is exactly the data-ingest leak where a killed train worker's
+        read-task lease pool held CPUs for the rest of the session)."""
+        if not owner_address:
+            return
+        for h in list(self._workers.values()):
+            if h.state != LEASED or h.lease_owner != owner_address:
+                continue
+            logger.info("reclaiming lease of worker %s: owner %s died",
+                        h.worker_id.hex()[:8], owner_address)
+            if not h.blocked:
+                if h.lease_pg is not None:
+                    self._bundle_release(h.lease_pg, h.lease_resources)
+                else:
+                    self._release(h.lease_resources)
+            h.blocked = False
+            h.lease_resources = {}
+            h.lease_pg = None
+            h.lease_owner = ""
+            # The worker may still be executing (or wedged on) the dead
+            # owner's task — terminate rather than re-lease a busy
+            # process (the monitor loop reaps the handle; the pool
+            # respawns on demand).
+            self._terminate_worker(h)
+        self._lease_event.set()
+
     async def _worker_blocked(self, payload):
         """Worker blocked in get(): release its cpu so nested tasks can run
-        (ref: raylet releases resources for blocked workers)."""
+        (ref: raylet releases resources for blocked workers).  Applies to
+        ACTOR workers too — a worker-group of actors that all block in
+        get() must not starve the tasks they are waiting on (the
+        data-ingest deadlock: train workers hold every CPU while the
+        dataset's read tasks wait for one)."""
         handle = self._workers.get(payload["worker_id"])
-        if handle is not None and handle.state == LEASED and not handle.blocked:
+        if handle is None or handle.blocked:
+            return True
+        if handle.state == LEASED:
             handle.blocked = True
             if handle.lease_pg is not None:
                 self._bundle_release(handle.lease_pg, handle.lease_resources)
             else:
                 self._release(handle.lease_resources)
+        elif handle.state == ACTOR and handle.actor_spec is not None:
+            handle.blocked = True
+            self._release_actor_resources(handle.actor_spec)
         return True
 
     async def _worker_unblocked(self, payload):
         handle = self._workers.get(payload["worker_id"])
-        if handle is not None and handle.state == LEASED and handle.blocked:
+        if handle is None or not handle.blocked:
+            return True
+        # Re-acquire even if it drives availability negative: the worker
+        # already holds the lease; balance restores at return.
+        if handle.state == LEASED:
             handle.blocked = False
-            # Re-acquire even if it drives availability negative: the worker
-            # already holds the lease; balance restores at return.
             if handle.lease_pg is not None:
                 self._bundle_allocate(handle.lease_pg,
                                       handle.lease_resources)
             else:
                 self._allocate(handle.lease_resources)
+        elif handle.state == ACTOR and handle.actor_spec is not None:
+            handle.blocked = False
+            spec = handle.actor_spec
+            if spec.placement_group_id is not None:
+                self._bundle_allocate(
+                    (spec.placement_group_id,
+                     spec.placement_group_bundle_index), spec.resources)
+            else:
+                self._allocate(spec.resources)
         return True
 
     # ------------------------------------------------------------ bundles
@@ -1309,7 +1443,14 @@ class NodeManager:
             bundle["available"].get(k, 0.0) >= v for k, v in demand.items())
 
     def _bundle_allocate(self, key, demand):
-        bundle = self._bundles[key]
+        bundle = self._bundles.get(key)
+        if bundle is None:
+            # Bundle returned/removed while the holder was blocked: its
+            # (released) share went back to the general pool with the
+            # bundle, so re-acquire from the pool (mirror of the
+            # _bundle_release fallback).
+            self._allocate(demand)
+            return
         for k, v in demand.items():
             bundle["available"][k] = bundle["available"].get(k, 0.0) - v
 
@@ -1357,7 +1498,9 @@ class NodeManager:
                 spec = handle.actor_spec
                 handle.actor_spec = None
                 handle.state = STARTING
-                self._release_actor_resources(spec)
+                if not handle.blocked:  # blocked already released
+                    self._release_actor_resources(spec)
+                handle.blocked = False
                 self._terminate_worker(handle)
                 return True
         return False

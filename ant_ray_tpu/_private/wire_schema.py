@@ -132,6 +132,8 @@ METHODS: dict[str, dict] = {
     "ReadDone": _m("node", "{object_id, pin_token}", "bool"),
     "RenewPins": _m("node", "{pins: [(oid, token)], ttl}", "{gone: []}"),
     "GetNodeInfo": _m("node", "{}", "NodeInfo"),
+    "DebugResources": _m("node", "{}",
+                         "{available, bundles, workers} ledger dump"),
     "GetNodeMetrics": _m("node", "{}", "{gauges}"),
     "GetStoreStats": _m("node", "{}", "{used, capacity, spilled}"),
     "GetSyncStats": _m("node", "{}", "{beats, views_sent, ...}"),
@@ -156,6 +158,9 @@ METHODS: dict[str, dict] = {
     "StreamItem": _m("worker", "{task_id, index, payload|done}", "bool"),
     "DeviceTensorFetch": _m("worker", "{token}", "host tensor bytes"),
     "DeviceTensorFree": _m("worker", "{token}", "bool"),
+    "DeviceTensorSendVia": _m("worker", "{token, group, dst_rank}",
+                              "bool (shards pushed over the collective "
+                              "group, mesh order)"),
 
     # ---- per-node agent (ref: agent_manager.h + runtime_env_agent) ----
     "BuildRuntimeEnv": _m("agent", "{wire}", "{ok}|{ok: False, error}"),

@@ -337,6 +337,16 @@ def main():  # pragma: no cover — exercised via subprocess in tests
     logging.basicConfig(
         level=global_config().log_level,
         format="[worker %(levelname)s %(asctime)s] %(message)s")
+    # `kill -USR1 <worker pid>` dumps all thread stacks to the worker's
+    # stderr log (the reference's `ray stack` equivalent for debugging
+    # a wedged worker).
+    import faulthandler  # noqa: PLC0415
+    import signal  # noqa: PLC0415
+
+    try:
+        faulthandler.register(signal.SIGUSR1, all_threads=True)
+    except (AttributeError, ValueError):  # non-posix / no signal here
+        pass
 
     _pin = os.environ.get("ART_JAX_PLATFORM")
     if _pin and (os.environ.get("PALLAS_AXON_POOL_IPS")

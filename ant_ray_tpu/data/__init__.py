@@ -17,11 +17,13 @@ from ant_ray_tpu.data.dataset import (
     read_parquet,
 )
 from ant_ray_tpu.data.datasource import Datasource, ReadTask
+from ant_ray_tpu.data.iterator import DataIterator
 
 range = range_  # noqa: A001 — mirrors ray.data.range
 
 __all__ = [
     "Count",
+    "DataIterator",
     "Dataset",
     "Datasource",
     "GroupedData",

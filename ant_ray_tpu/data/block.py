@@ -171,6 +171,49 @@ def concat_blocks(blocks: list):
     return pa.concat_tables(tables, promote_options="default")
 
 
+def batches_from_blocks(blocks: Iterable, batch_size: int,
+                        batch_format: str = "default",
+                        drop_last: bool = False):
+    """Re-batch a block stream into fixed-size batches.  Batches
+    assemble by block slice + concat, never round-tripping rows through
+    Python, so Arrow dtypes survive (this is the TPU ingest path:
+    batch_format="numpy" → dict of numpy columns → jnp.asarray).
+    Shared by Dataset.iter_batches and every DataIterator."""
+    pending: list = []     # [accessor, start offset] pieces
+    pending_rows = 0
+    for block in blocks:
+        accessor = BlockAccessor.for_block(block)
+        if accessor.num_rows() == 0:
+            continue
+        pending.append([accessor, 0])
+        pending_rows += accessor.num_rows()
+        while pending_rows >= batch_size:
+            yield _assemble_batch(pending, batch_size, batch_format)
+            pending_rows -= batch_size
+    if pending_rows and not drop_last:
+        yield _assemble_batch(pending, pending_rows, batch_format)
+
+
+def _assemble_batch(pending: list, n: int, batch_format: str):
+    pieces = []
+    taken = 0
+    while taken < n:
+        accessor, start = pending[0]
+        available = accessor.num_rows() - start
+        use = min(available, n - taken)
+        pieces.append(accessor.slice(start, start + use))
+        taken += use
+        if use == available:
+            pending.pop(0)
+        else:
+            pending[0][1] = start + use
+    batch_block = concat_blocks(pieces)
+    if batch_format == "default" and isinstance(batch_block, list):
+        return batch_block
+    return BlockAccessor.for_block(batch_block).to_batch(
+        "numpy" if batch_format in ("default", "numpy") else batch_format)
+
+
 def rows_to_block(rows: list, like) -> Any:
     """Rebuild a block of the same kind as ``like`` from rows.
 

@@ -17,7 +17,7 @@ from typing import Any, Callable, Iterable, Iterator
 
 from ant_ray_tpu.data import aggregate as agg
 from ant_ray_tpu.data import logical as L
-from ant_ray_tpu.data.block import BlockAccessor, concat_blocks
+from ant_ray_tpu.data.block import BlockAccessor
 from ant_ray_tpu.data.datasource import (
     CSVDatasource,
     Datasource,
@@ -148,47 +148,16 @@ class Dataset:
             yield from BlockAccessor.for_block(block).to_rows()
 
     def iter_batches(self, batch_size: int = 256,
-                     batch_format: str = "default") -> Iterator:
+                     batch_format: str = "default",
+                     drop_last: bool = False) -> Iterator:
         """Stream batches; for Arrow blocks with batch_format="numpy"
         this is the TPU ingest path (dict of numpy columns →
         jnp.asarray).  Batches assemble by block slice + concat, never
         round-tripping rows through Python, so Arrow dtypes survive."""
-        pending: list = []     # (accessor, start offset) pieces
-        pending_rows = 0
-        for block in self._iter_result_blocks():
-            accessor = BlockAccessor.for_block(block)
-            if accessor.num_rows() == 0:
-                continue
-            pending.append([accessor, 0])
-            pending_rows += accessor.num_rows()
-            while pending_rows >= batch_size:
-                yield self._assemble_batch(pending, batch_size,
-                                           batch_format)
-                pending_rows -= batch_size
-        if pending_rows:
-            yield self._assemble_batch(pending, pending_rows,
-                                       batch_format)
+        from ant_ray_tpu.data.block import batches_from_blocks  # noqa: PLC0415
 
-    @staticmethod
-    def _assemble_batch(pending: list, n: int, batch_format: str):
-        pieces = []
-        taken = 0
-        while taken < n:
-            accessor, start = pending[0]
-            available = accessor.num_rows() - start
-            use = min(available, n - taken)
-            pieces.append(accessor.slice(start, start + use))
-            taken += use
-            if use == available:
-                pending.pop(0)
-            else:
-                pending[0][1] = start + use
-        batch_block = concat_blocks(pieces)
-        if batch_format == "default" and isinstance(batch_block, list):
-            return batch_block
-        return BlockAccessor.for_block(batch_block).to_batch(
-            "numpy" if batch_format in ("default", "numpy") else
-            batch_format)
+        yield from batches_from_blocks(self._iter_result_blocks(),
+                                       batch_size, batch_format, drop_last)
 
     def take(self, n: int = 20) -> list:
         out: list = []
@@ -238,6 +207,25 @@ class Dataset:
         for i, ref in enumerate(ds._block_refs):
             shards[i % n].append(ref)
         return [Dataset(refs) for refs in shards]
+
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None, name: str = ""):
+        """n coordinated streaming iterators over ONE execution of the
+        plan per epoch — nothing materializes (ref: dataset.py:1881).
+        ``equal=True`` gives every iterator exactly the same row count
+        per epoch (what SPMD training needs).  All n iterators must be
+        consumed together: each epoch starts at a barrier."""
+        from ant_ray_tpu.data.iterator import make_streaming_split  # noqa: PLC0415
+
+        del locality_hints  # single-store-per-node runtime: no-op hint
+        return make_streaming_split(self, n, equal=equal, name=name)
+
+    def iterator(self):
+        """Single-consumer DataIterator over the plan (one execution
+        per pass — ref: Dataset.iterator())."""
+        from ant_ray_tpu.data.iterator import PlanIterator  # noqa: PLC0415
+
+        return PlanIterator(self)
 
     # ---------------------------------------------------------- writers
 

@@ -3,6 +3,7 @@
 from ant_ray_tpu.train.checkpoint import Checkpoint, load_pytree, save_pytree
 from ant_ray_tpu.train.config import (
     CheckpointConfig,
+    DataConfig,
     FailureConfig,
     Result,
     RunConfig,
@@ -11,6 +12,7 @@ from ant_ray_tpu.train.config import (
 from ant_ray_tpu.train.session import (
     get_checkpoint,
     get_context,
+    get_dataset_shard,
     get_world_rank,
     get_world_size,
     report,
@@ -20,6 +22,7 @@ from ant_ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TpuTraine
 __all__ = [
     "Checkpoint",
     "CheckpointConfig",
+    "DataConfig",
     "DataParallelTrainer",
     "FailureConfig",
     "JaxTrainer",
@@ -29,6 +32,7 @@ __all__ = [
     "TpuTrainer",
     "get_checkpoint",
     "get_context",
+    "get_dataset_shard",
     "get_world_rank",
     "get_world_size",
     "load_pytree",

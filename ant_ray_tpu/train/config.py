@@ -48,6 +48,29 @@ class FailureConfig:
 
 
 @dataclasses.dataclass
+class DataConfig:
+    """How the trainer's ``datasets=`` feed the workers (ref:
+    train/_internal/data_config.py — DataConfig.configure).
+
+    Datasets named in ``datasets_to_split`` ("all" = every dataset) are
+    streaming_split across ranks with ``equal=True`` (every rank gets
+    the same row count per epoch — SPMD lockstep must not starve a
+    rank); the rest are broadcast whole to every worker (e.g. a small
+    validation set)."""
+
+    datasets_to_split: "str | list[str]" = "all"
+    equal: bool = True
+
+    def splits(self, name: str) -> bool:
+        if self.datasets_to_split == "all":
+            return True
+        wanted = self.datasets_to_split
+        if isinstance(wanted, str):   # a single name, not a char match
+            wanted = [wanted]
+        return name in wanted
+
+
+@dataclasses.dataclass
 class CheckpointConfig:
     num_to_keep: int | None = None      # None = keep all
 

@@ -68,7 +68,7 @@ class TrainWorker:
             return False
 
     def run(self, loop_fn, loop_config, controller, latest_checkpoint,
-            attempt: int = 0):
+            attempt: int = 0, dataset_shards: dict | None = None):
         ctx = TrainContext(
             world_rank=self._rank,
             world_size=self._world_size,
@@ -78,6 +78,7 @@ class TrainWorker:
             controller=controller,
             latest_checkpoint=latest_checkpoint,
             attempt=attempt,
+            dataset_shards=dataset_shards or {},
         )
         _set_context(ctx)
         try:
@@ -96,11 +97,14 @@ class TrainController:
 
     def __init__(self, loop_fn, loop_config, scaling: ScalingConfig,
                  run_config: RunConfig, resume: bool = False,
-                 run_token: str | None = None):
+                 run_token: str | None = None, datasets: dict | None = None,
+                 data_config=None):
         self._loop_fn = loop_fn
         self._loop_config = loop_config
         self._scaling = scaling
         self._run_config = run_config
+        self._datasets = datasets or {}
+        self._data_config = data_config
         self._storage_path = run_config.resolved_storage_path()
         self._ckpt_manager = CheckpointManager(
             self._storage_path, run_config.checkpoint_config.num_to_keep,
@@ -218,10 +222,11 @@ class TrainController:
             art.get([w.setup_distributed.remote(coordinator)
                      for w in workers])
             latest = self._ckpt_manager.latest
+            shards = self._make_dataset_shards(art, world)
             run_refs = [
                 w.run.remote(self._loop_fn, self._loop_config,
-                             self_handle, latest, attempt)
-                for w in workers
+                             self_handle, latest, attempt, shards[rank])
+                for rank, w in enumerate(workers)
             ]
             # Fail FAST on the first rank failure (ref: worker_group
             # poll_status aborts the group on any error) — a plain
@@ -239,6 +244,44 @@ class TrainController:
                 except Exception:  # noqa: BLE001
                     pass
             self._release_gang()
+            self._kill_data_coordinators(art)
+
+    def _make_dataset_shards(self, art, world: int) -> list:
+        """Per-rank {name: DataIterator} from the trainer's datasets=.
+        Fresh coordinators every attempt: a restarted (possibly
+        resized) gang re-splits the stream across the NEW world size —
+        a dead rank's unconsumed shard is thereby reassigned (ref:
+        DataConfig.configure runs per worker-group start,
+        train/v2/api/data_parallel_trainer.py:83)."""
+        if not self._datasets:
+            return [None] * world
+        from ant_ray_tpu.data.iterator import make_streaming_split  # noqa: PLC0415
+        from ant_ray_tpu.train.config import DataConfig  # noqa: PLC0415
+
+        cfg = self._data_config or DataConfig()
+        self._kill_data_coordinators(art)   # previous attempt's actors
+        coords = []
+        shards: list[dict] = [dict() for _ in range(world)]
+        for name, ds in self._datasets.items():
+            if cfg.splits(name):
+                its = make_streaming_split(ds, world, equal=cfg.equal,
+                                           name=name)
+                coords.append(its[0]._coord)
+                for rank in range(world):
+                    shards[rank][name] = its[rank]
+            else:
+                for rank in range(world):
+                    shards[rank][name] = ds.iterator()
+        self._data_coords = coords
+        return shards
+
+    def _kill_data_coordinators(self, art) -> None:
+        for coord in getattr(self, "_data_coords", ()):
+            try:
+                art.kill(coord)
+            except Exception:  # noqa: BLE001 — teardown is best-effort
+                pass
+        self._data_coords = []
 
     def _reserve_gang(self, scaling, world: int | None = None):
         """Gang-reserve the worker group's resources before spawning any

@@ -22,6 +22,8 @@ class TrainContext:
     # derive attempt-unique rendezvous names so a restarted gang never
     # collides with its predecessor's collective group.
     attempt: int = 0
+    # name -> DataIterator for this rank (from the trainer's datasets=).
+    dataset_shards: dict = field(default_factory=dict)
     _report_lock: threading.Lock = field(default_factory=threading.Lock)
 
 
@@ -51,6 +53,21 @@ def report(metrics: dict, checkpoint=None) -> None:
     with ctx._report_lock:
         art.get(ctx.controller.report_from_worker.remote(
             ctx.world_rank, dict(metrics), checkpoint))
+
+
+def get_dataset_shard(name: str = "train"):
+    """This rank's streaming DataIterator for the trainer's
+    ``datasets={name: ds}`` (ref: train/_internal/session.py:1134).
+    Split datasets are coordinated streaming shards (one pass of the
+    plan per epoch, shared across ranks); broadcast datasets return a
+    full-dataset iterator."""
+    ctx = get_context()
+    shard = ctx.dataset_shards.get(name)
+    if shard is None:
+        raise KeyError(
+            f"no dataset {name!r} was passed to the trainer "
+            f"(have: {sorted(ctx.dataset_shards)})")
+    return shard
 
 
 def get_checkpoint():

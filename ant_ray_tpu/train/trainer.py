@@ -36,11 +36,18 @@ class JaxTrainer:
     def __init__(self, train_loop_per_worker: Callable,
                  *, train_loop_config: dict | None = None,
                  scaling_config: ScalingConfig | None = None,
-                 run_config: RunConfig | None = None):
+                 run_config: RunConfig | None = None,
+                 datasets: dict | None = None,
+                 dataset_config=None):
         self._loop = train_loop_per_worker
         self._loop_config = train_loop_config
         self._scaling = scaling_config or ScalingConfig()
         self._run_config = run_config or RunConfig()
+        # datasets={"train": ds}: each worker pulls its coordinated
+        # streaming shard via train.get_dataset_shard("train") (ref:
+        # api/data_parallel_trainer.py:83, datasets= + DataConfig).
+        self._datasets = datasets or {}
+        self._dataset_config = dataset_config
         if not self._run_config.name:
             # Anonymous runs get a per-trainer unique name: two
             # concurrent fits in one job must not share a PG name (the
@@ -109,7 +116,8 @@ class JaxTrainer:
         for attempt in range(retries + 1):
             controller = controller_cls.remote(
                 self._loop, self._loop_config, self._scaling,
-                self._run_config, attempt > 0, run_token)
+                self._run_config, attempt > 0, run_token,
+                self._datasets, self._dataset_config)
             try:
                 result: Result = art.get(
                     controller.run.remote(controller), timeout=None)
