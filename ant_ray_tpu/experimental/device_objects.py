@@ -12,11 +12,12 @@ big tensors never transit plasma unless fetched.
     ref = device_objects.put(hbm_array)        # metadata ObjectRef
     arr = device_objects.get(ref)              # zero-copy if local
 
-Same-process gets return the identical buffer (no copy at all).  An
-in-slice ICI transport (XLA collective send/recv between jitted mesh
-programs) is the planned fast path for sharded arrays; the DMA path is
-the general fallback exactly like the reference's object-store
-transport.
+Same-process gets return the identical buffer (no copy at all).
+Sharded arrays additionally move over the pluggable COLLECTIVE
+transport (tensor_transport.py — shard-by-shard over the actors'
+collective group, ICI on hardware; selected automatically from the
+sharding metadata recorded at put()); the DMA path is the general
+fallback exactly like the reference's object-store transport.
 """
 
 from __future__ import annotations
@@ -36,14 +37,23 @@ def _runtime():
     return runtime
 
 
-def put(array: Any) -> "object":
+def put(array: Any, *, transport: str = "auto",
+        group_name: str = "default") -> "object":
     """Register a device array in this worker's device-object store;
     returns an ObjectRef whose payload is just metadata.
 
     The metadata carries a holder token (not the ObjectRef id): when the
     ref is passed as a task arg, the arg resolves to the metadata dict —
     which remains a fetchable handle, exactly like the reference's
-    deserialized GPU-object values."""
+    deserialized GPU-object values.
+
+    ``transport`` mirrors the reference's per-object transport choice
+    (ref: gpu_object_manager.py put(..., tensor_transport=...)):
+    "auto" records collective-transport metadata when the array is
+    sharded AND this process is in collective group ``group_name`` —
+    consumers in the group then pull shard-by-shard over it (ICI on
+    hardware); everyone else falls back to the DMA path.  "dma" skips
+    the probe; "collective" requires it to apply."""
     import uuid  # noqa: PLC0415
 
     runtime = _runtime()
@@ -55,6 +65,28 @@ def put(array: Any) -> "object":
         "shape": tuple(getattr(array, "shape", ())),
         "dtype": str(getattr(array, "dtype", "")),
     }
+    if transport in ("auto", "collective"):
+        from ant_ray_tpu.experimental.tensor_transport import (  # noqa: PLC0415
+            shard_layout,
+        )
+
+        layout = shard_layout(array)
+        recorded = False
+        if layout is not None:
+            from ant_ray_tpu.util.collective import collective as col  # noqa: PLC0415
+
+            if col.is_group_initialized(group_name):
+                meta["layout"] = layout
+                meta["collective"] = {
+                    "group": group_name,
+                    "src_rank": col.get_rank(group_name)}
+                recorded = True
+        if transport == "collective" and not recorded:
+            raise ValueError(
+                "transport='collective' needs a sharded array and an "
+                f"initialized collective group {group_name!r}")
+    elif transport != "dma":
+        raise ValueError(f"unknown transport {transport!r}")
     ref = runtime.put(meta)
     runtime._device_objects[token] = array
     # Payload lifetime rides the metadata object's refcount: when the
@@ -75,27 +107,15 @@ def get(ref_or_meta, timeout: float | None = None) -> Any:
     to host, bytes travel by RPC, and the result is `device_put` here.
     """
     runtime = _runtime()
-    from ant_ray_tpu import exceptions  # noqa: PLC0415
-
     meta = _resolve_meta(runtime, ref_or_meta, timeout)
     local = runtime._device_objects.get(meta["token"])
     if local is not None:
         return local
-    try:
-        host = runtime._fetch_device_tensor(meta["holder"], meta["token"],
-                                            timeout)
-    except Exception as e:  # noqa: BLE001 — holder died / unreachable
-        raise exceptions.ObjectLostError(
-            None, f"holder of device object {meta['token'][:12]} is "
-            f"unreachable: {e}") from e
-    if host is None:
-        raise exceptions.ObjectLostError(
-            None, f"holder no longer has device object "
-            f"{meta['token'][:12]}")
-    from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
+    from ant_ray_tpu.experimental.tensor_transport import (  # noqa: PLC0415
+        select_transport,
+    )
 
-    jax = import_jax()
-    return jax.device_put(host)
+    return select_transport(meta, runtime).fetch(meta, runtime, timeout)
 
 
 def free(ref_or_meta) -> None:

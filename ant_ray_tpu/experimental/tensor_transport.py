@@ -1,0 +1,337 @@
+"""Pluggable tensor transports for device objects.
+
+Capability mirror of the reference's tensor-transport plane (ref:
+python/ray/experimental/gpu_object_manager/tensor_transport_manager.py:14
+— the ABC each transport implements — and
+collective_tensor_transport.py:36 / nixl_tensor_transport.py:41, the
+collective-group and one-sided implementations), re-designed for TPU:
+
+* **dma** (default, always works): holder DMAs device→host, bytes ride
+  the RPC plane, consumer ``device_put``s — the object-store transport
+  equivalent.
+* **collective**: a *sharded* ``jax.Array`` moves SHARD BY SHARD over
+  a ``ray.util.collective``-style group the two actors both joined —
+  no single host buffer ever materializes.  On TPU hardware the xla
+  backend's sends ride ICI; in tests the gloo backend carries the same
+  per-shard protocol on CPU.  The receiver reassembles the array on
+  its own mesh (same grid shape, its own devices) with
+  ``jax.make_array_from_single_device_arrays``.
+
+Selection is automatic from the metadata the producer recorded at
+``device_objects.put`` time (sharding grid + collective group): a
+consumer inside the group uses the collective path, anyone else falls
+back to dma — mirroring how the reference picks a transport from the
+tensor's recorded transport metadata.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+# One transfer at a time per (group, peer): p2p channels are ordered —
+# interleaving two multi-shard transfers on one pair would cross wires.
+_fetch_locks: dict = {}
+_fetch_locks_guard = threading.Lock()
+# Pairs whose recv watchdog expired: their p2p channel may hold a
+# dangling recv, so further collective fetches from them fall back to
+# dma instead of deadlocking behind the poisoned channel.
+_poisoned_pairs: set = set()
+# Watchdog for the recv phase when the caller gave no timeout.  The
+# holder acked before any recv starts, so a healthy transfer progresses
+# immediately; this only bounds a transfer whose sender died mid-way.
+_RECV_DEADLINE_S = 300.0
+
+
+def _pair_lock(group: str, peer: int) -> threading.Lock:
+    with _fetch_locks_guard:
+        return _fetch_locks.setdefault((group, peer), threading.Lock())
+
+
+def shards_in_mesh_order(array: Any) -> list:
+    """Addressable shards sorted by their device's flat position in the
+    mesh grid — the canonical wire order for shard-by-shard transfers
+    (sender and receiver must agree; this IS the agreement)."""
+    import numpy as np  # noqa: PLC0415
+
+    devices = list(np.asarray(array.sharding.mesh.devices).flatten())
+    pos = {id(d): i for i, d in enumerate(devices)}
+    return sorted(array.addressable_shards,
+                  key=lambda s: pos.get(id(s.device), 1 << 30))
+
+
+_send_lock = threading.Lock()
+
+
+def send_shards(array: Any, dst_rank: int, group: str) -> None:
+    """Holder side of the collective transport: push each shard in mesh
+    order over the p2p channel (called from the DeviceTensorSendVia
+    RPC, off the io loop).  Failures are logged, not raised — the RPC
+    already acked; the consumer's recv watchdog turns a dead transfer
+    into ObjectLost + pair poisoning on its side."""
+    import numpy as np  # noqa: PLC0415
+
+    from ant_ray_tpu.util.collective import collective as col  # noqa: PLC0415
+
+    try:
+        with _send_lock:  # one outbound transfer at a time: p2p order
+            for shard in shards_in_mesh_order(array):
+                col.send(np.asarray(shard.data), dst_rank, group)
+    except Exception:  # noqa: BLE001 — surfaced on the consumer side
+        logger.exception("collective shard send to rank %d over %r "
+                         "failed", dst_rank, group)
+
+
+def shard_layout(array: Any) -> dict | None:
+    """Producer-side transport metadata for a sharded jax.Array: the
+    mesh grid, the partition spec, and each shard's (flat mesh
+    position, shape) — everything a receiver needs to pre-allocate
+    recv buffers and rebuild the sharding on its own devices (the
+    reference's extract_tensor_transport_metadata equivalent)."""
+    sharding = getattr(array, "sharding", None)
+    mesh = getattr(sharding, "mesh", None)
+    spec = getattr(sharding, "spec", None)
+    if mesh is None or spec is None:
+        return None
+    try:
+        import numpy as np  # noqa: PLC0415
+
+        devices = list(np.asarray(mesh.devices).flatten())
+        pos = {id(d): i for i, d in enumerate(devices)}
+        shards = shards_in_mesh_order(array)
+        if len(shards) <= 1 or len(shards) != len(devices):
+            return None            # single-shard or multi-host: use dma
+        return {
+            "mesh_shape": tuple(mesh.devices.shape),
+            "axis_names": tuple(mesh.axis_names),
+            "spec": tuple(None if p is None else p for p in spec),
+            "shards": [{
+                "pos": pos[id(s.device)],
+                "shape": tuple(s.data.shape),
+                "dtype": str(s.data.dtype),
+            } for s in shards],
+        }
+    except Exception:  # noqa: BLE001 — layout probing is best-effort
+        return None
+
+
+class TensorTransport:
+    """One way of moving a device tensor holder→consumer (ref:
+    tensor_transport_manager.py:14 TensorTransportManager)."""
+
+    name = "base"
+
+    @staticmethod
+    def can_fetch(meta: dict, runtime) -> bool:
+        raise NotImplementedError
+
+    @staticmethod
+    def fetch(meta: dict, runtime, timeout: float | None) -> Any:
+        raise NotImplementedError
+
+
+class DmaTransport(TensorTransport):
+    """device→host DMA + RPC + host→device (the always-available
+    object-store-style fallback)."""
+
+    name = "dma"
+
+    @staticmethod
+    def can_fetch(meta: dict, runtime) -> bool:
+        return True
+
+    @staticmethod
+    def fetch(meta: dict, runtime, timeout: float | None) -> Any:
+        from ant_ray_tpu import exceptions  # noqa: PLC0415
+
+        try:
+            host = runtime._fetch_device_tensor(
+                meta["holder"], meta["token"], timeout)
+        except Exception as e:  # noqa: BLE001 — holder died/unreachable
+            raise exceptions.ObjectLostError(
+                None, f"holder of device object {meta['token'][:12]} is "
+                f"unreachable: {e}") from e
+        if host is None:
+            raise exceptions.ObjectLostError(
+                None, f"holder no longer has device object "
+                f"{meta['token'][:12]}")
+        from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
+
+        return import_jax().device_put(host)
+
+
+class CollectiveTransport(TensorTransport):
+    """Shard-by-shard transfer over the collective group both actors
+    joined (ref: collective_tensor_transport.py:36).  The consumer
+    triggers the holder (oneway RPC), then receives each shard in mesh
+    order and reassembles on a local mesh of the same grid shape."""
+
+    name = "collective"
+
+    @staticmethod
+    def can_fetch(meta: dict, runtime) -> bool:
+        xfer = meta.get("collective")
+        if not xfer or not meta.get("layout"):
+            return False
+        from ant_ray_tpu.util.collective import collective as col  # noqa: PLC0415
+
+        group = xfer["group"]
+        if not col.is_group_initialized(group):
+            return False
+        if (group, xfer["src_rank"]) in _poisoned_pairs:
+            return False               # dangling recv on this channel
+        return col.get_rank(group) != xfer["src_rank"]
+
+    @staticmethod
+    def fetch(meta: dict, runtime, timeout: float | None) -> Any:
+        import numpy as np  # noqa: PLC0415
+
+        from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
+        from ant_ray_tpu.util.collective import collective as col  # noqa: PLC0415
+
+        jax = import_jax()
+        xfer = meta["collective"]
+        layout = meta["layout"]
+        group, src = xfer["group"], xfer["src_rank"]
+        from ant_ray_tpu import exceptions  # noqa: PLC0415
+
+        my_rank = col.get_rank(group)
+        with _pair_lock(group, src):
+            # Kick the holder's send loop and wait for its ack BEFORE
+            # parking in recv: a freed token or dead holder must raise
+            # ObjectLost (like the dma path), not hang a recv that
+            # nothing will ever match.
+            client = runtime._clients.get(meta["holder"])
+            try:
+                ok = runtime._io.run_coro(client.call_async(
+                    "DeviceTensorSendVia",
+                    {"token": meta["token"], "group": group,
+                     "dst_rank": my_rank}, timeout=30))
+            except Exception as e:  # noqa: BLE001 — holder unreachable
+                raise exceptions.ObjectLostError(
+                    None, f"holder of device object {meta['token'][:12]} "
+                    f"is unreachable: {e}") from e
+            if not ok:
+                raise exceptions.ObjectLostError(
+                    None, f"holder no longer has device object "
+                    f"{meta['token'][:12]}")
+
+            def _recv_all() -> list:
+                out = []
+                for shard in layout["shards"]:
+                    buf = np.zeros(shard["shape"],
+                                   dtype=_np_dtype(shard["dtype"]))
+                    out.append(col.recv(buf, src, group))
+                return out
+
+            # Watchdog: recv has no native timeout; a sender that died
+            # mid-transfer would otherwise hang this consumer (and the
+            # pair lock) forever.  On expiry the pair is poisoned —
+            # later fetches from it use dma.
+            import concurrent.futures as cf  # noqa: PLC0415
+
+            deadline = timeout if timeout is not None else _RECV_DEADLINE_S
+            pool = cf.ThreadPoolExecutor(max_workers=1)
+            fut = pool.submit(_recv_all)
+            try:
+                host_shards = fut.result(deadline)
+            except cf.TimeoutError:
+                _poisoned_pairs.add((group, src))
+                raise exceptions.ObjectLostError(
+                    None, f"collective transfer of "
+                    f"{meta['token'][:12]} from rank {src} over "
+                    f"{group!r} stalled for {deadline:.0f}s; pair "
+                    "poisoned, future fetches fall back to dma"
+                ) from None
+            finally:
+                # wait=False: on expiry the recv thread is parked in an
+                # uninterruptible recv — joining it would re-hang us.
+                pool.shutdown(wait=False)
+        # Reassemble on THIS process's devices: same grid, local mesh.
+        mesh_shape = tuple(layout["mesh_shape"])
+        n = int(np.prod(mesh_shape))
+        devices = jax.local_devices()[:n]
+        if len(devices) < n:
+            # Consumer has fewer devices than the grid: concatenate on
+            # host instead (still shard-wise transfer, degraded
+            # placement).
+            return jax.device_put(
+                _host_assemble(np, layout, host_shards, meta))
+        mesh = jax.sharding.Mesh(
+            np.asarray(devices).reshape(mesh_shape),
+            layout["axis_names"])
+        sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec(*layout["spec"]))
+        flat = list(np.asarray(mesh.devices).flatten())
+        arrays = [jax.device_put(np.asarray(data), flat[s["pos"]])
+                  for s, data in zip(layout["shards"], host_shards)]
+        return jax.make_array_from_single_device_arrays(
+            tuple(meta["shape"]), sharding, arrays)
+
+
+def _np_dtype(name: str):
+    import numpy as np  # noqa: PLC0415
+
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # noqa: PLC0415
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _host_assemble(np, layout: dict, host_shards: list, meta: dict):
+    """Degraded path: rebuild the full array host-side from shards
+    (consumer lacks the device grid).  Uses the addressable-shard
+    slices implied by an even partition spec."""
+    out = np.zeros(tuple(meta["shape"]), dtype=host_shards[0].dtype)
+    # Recover each shard's slice from its position in the mesh grid.
+    mesh_shape = tuple(layout["mesh_shape"])
+    axis_names = layout["axis_names"]
+    spec = layout["spec"]
+    for s, data in zip(layout["shards"], host_shards):
+        coords = np.unravel_index(s["pos"], mesh_shape)
+        index = []
+        for dim, p in enumerate(spec):
+            dim_len = out.shape[dim]
+            if p is None:
+                index.append(slice(None))
+                continue
+            names = (p,) if isinstance(p, str) else tuple(p)
+            stride = dim_len
+            start = 0
+            for name in names:
+                k = mesh_shape[axis_names.index(name)]
+                stride //= k
+                start += coords[axis_names.index(name)] * stride
+            index.append(slice(start, start + data.shape[dim]))
+        out[tuple(index)] = data
+    return out
+
+
+# Ordered by preference: first transport whose can_fetch passes wins.
+TRANSPORTS: list[type[TensorTransport]] = [CollectiveTransport,
+                                           DmaTransport]
+
+
+def register_transport(transport: type[TensorTransport],
+                       prepend: bool = True) -> None:
+    """Plug in a custom transport (the reference's registry,
+    tensor_transport_manager.py — e.g. a DCN bulk mover)."""
+    if prepend:
+        TRANSPORTS.insert(0, transport)
+    else:
+        TRANSPORTS.append(transport)
+
+
+def select_transport(meta: dict, runtime) -> type[TensorTransport]:
+    for transport in TRANSPORTS:
+        try:
+            if transport.can_fetch(meta, runtime):
+                return transport
+        except Exception:  # noqa: BLE001 — a broken plugin must not
+            logger.exception("transport %s probe failed", transport.name)
+    return DmaTransport
