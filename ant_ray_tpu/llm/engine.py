@@ -70,7 +70,14 @@ class LLMEngine:
 
     def __init__(self, model="tiny", params=None, *, slots: int = 8,
                  max_seq: int | None = None, tokenizer=None,
-                 seed: int = 0):
+                 seed: int = 0, tensor_parallel_size: int = 1,
+                 mesh=None):
+        """``tensor_parallel_size > 1`` makes the ENGINE build a tp mesh
+        over this process's local devices and shard params + KV slabs
+        itself (ref: vllm_models.py:222 tensor_parallel_size — serving
+        an 8B on a slice needs no caller-side sharding).  ``mesh``
+        overrides it with a prebuilt mesh (e.g. tp×sp for long-prompt
+        prefill via ring attention — forward() switches on sp>1)."""
         from ant_ray_tpu._private.jax_utils import import_jax
 
         self._jax = jax = import_jax()
@@ -108,8 +115,17 @@ class LLMEngine:
             params = (loaded if loaded is not None
                       else llama.init_params(self.config,
                                              jax.random.PRNGKey(seed)))
+        self.mesh = mesh
+        if tensor_parallel_size > 1 and mesh is None:
+            from ant_ray_tpu.parallel.mesh import build_mesh  # noqa: PLC0415
+
+            self.mesh = build_mesh(
+                devices=jax.local_devices()[:tensor_parallel_size],
+                tp=tensor_parallel_size)
         self.params = params
         self.cache = llama.init_kv_cache(self.config, slots, self.max_seq)
+        if self.mesh is not None:
+            self._shard_state()
         # Host-side mirror of each slot's most recent token: mutated in
         # numpy and uploaded once per decode call, so the scheduling
         # loop costs one host→device transfer per step instead of one
@@ -123,10 +139,11 @@ class LLMEngine:
         self._base_key = jax.random.PRNGKey(seed ^ 0x5EED)
 
         cfg = self.config
+        eng_mesh = self.mesh
 
         def _prefill(params, cache, tokens, slot, length):
             return llama.prefill_into_cache(params, tokens, cache, slot,
-                                            length, cfg)
+                                            length, cfg, mesh=eng_mesh)
 
         def _decode(params, cache, last_tokens):
             return llama.decode_step(params, last_tokens, cache, cfg)
@@ -135,6 +152,32 @@ class LLMEngine:
         self._prefill_jit = jax.jit(_prefill, donate_argnums=(1,))
         self._decode_jit = jax.jit(_decode, donate_argnums=(1,))
         self._sample_jit = jax.jit(self._sample_batch)
+
+    def _shard_state(self):
+        """Distribute params and KV slabs over the engine's mesh: params
+        by the model's logical-axis rules (heads/mlp over tp), slabs by
+        kv-head over tp — decode attention then runs fully sharded with
+        XLA inserting the one all-reduce per block (ref capability:
+        vLLM tensor_parallel_size, engine-owned sharding)."""
+        jax = self._jax
+        from jax.sharding import NamedSharding, PartitionSpec as P  # noqa: PLC0415
+
+        mesh = self.mesh
+        tp = mesh.shape.get("tp", 1)
+        if self.config.n_kv_heads % tp or self.config.n_heads % tp:
+            raise ValueError(
+                f"tensor_parallel_size={tp} must divide n_heads="
+                f"{self.config.n_heads} and n_kv_heads="
+                f"{self.config.n_kv_heads}")
+        shardings = self._llama.param_shardings(self.config, mesh)
+        self.params = jax.device_put(self.params, shardings)
+        kv = NamedSharding(mesh, P(None, None, None, "tp", None))
+        rep = NamedSharding(mesh, P())
+        self.cache = {
+            "k": jax.device_put(self.cache["k"], kv),
+            "v": jax.device_put(self.cache["v"], kv),
+            "length": jax.device_put(self.cache["length"], rep),
+        }
 
     # ------------------------------------------------------------ public
 

@@ -19,35 +19,38 @@ class LLMServer:
 
     def __init__(self, model="tiny", *, slots: int = 8,
                  max_seq: int | None = None, tokenizer_name: str | None =
-                 None, seed: int = 0):
+                 None, seed: int = 0, tensor_parallel_size: int = 1):
         import threading  # noqa: PLC0415
 
         from ant_ray_tpu.llm.tokenizer import get_tokenizer  # noqa: PLC0415
 
         self.engine = LLMEngine(
             model, slots=slots, max_seq=max_seq,
-            tokenizer=get_tokenizer(tokenizer_name), seed=seed)
+            tokenizer=get_tokenizer(tokenizer_name), seed=seed,
+            tensor_parallel_size=tensor_parallel_size)
         # The engine mutates shared slot/cache state; replicas may run
         # requests on overlapping threads (max_concurrency > 1), so all
         # engine access serializes here.
         self._engine_lock = threading.Lock()
 
+    @staticmethod
+    def _is_chat(request: dict) -> bool:
+        path = request.get("__route_path__", "")
+        return "messages" in request or path.endswith("/chat/completions")
+
     def __call__(self, request: dict) -> dict:
-        """OpenAI-completions-shaped request: {"prompt": str|list,
-        "max_tokens", "temperature", "top_k", "top_p", "stop_token_ids"}.
-        """
+        """OpenAI-shaped request.  Completions: {"prompt": ...} →
+        choices[].text.  Chat (/v1/chat/completions or a "messages"
+        key): templated through the tokenizer's chat template →
+        choices[].message (ref: the OpenAI-compatible serving surface,
+        llm/_internal/serve/deployments/llm/llm_server.py)."""
+        if self._is_chat(request):
+            return self._chat(request)
         prompts = request.get("prompt", "")
         many = isinstance(prompts, list) and prompts and not isinstance(
             prompts[0], int)
         batch = prompts if many else [prompts]
-        sampling = SamplingParams(
-            max_tokens=int(request.get("max_tokens", 64)),
-            temperature=float(request.get("temperature", 0.0)),
-            top_k=int(request.get("top_k", 0)),
-            top_p=float(request.get("top_p", 1.0)),
-            stop_token_ids=tuple(request.get("stop_token_ids", ())),
-            seed=request.get("seed"),
-        )
+        sampling = self._sampling(request)
         with self._engine_lock:
             outs = self.engine.generate(batch, sampling)
         return {
@@ -60,16 +63,32 @@ class LLMServer:
             ],
         }
 
-    def stream(self, request: dict):
-        """Token-streaming completion: a generator of OpenAI-chunk-shaped
-        dicts, consumed through the object plane as a streaming actor
-        call (num_returns="streaming") and exposed over SSE by the HTTP
-        proxy (ref: serve streaming responses, serve/_private/replica.py
-        streaming path)."""
-        prompts = request.get("prompt", "")
-        prompt = prompts[0] if isinstance(prompts, list) and prompts \
-            and not isinstance(prompts[0], int) else prompts
-        sampling = SamplingParams(
+    def _chat(self, request: dict) -> dict:
+        from ant_ray_tpu.llm.chat import render_chat  # noqa: PLC0415
+
+        token_ids = render_chat(self.engine.tokenizer,
+                                request.get("messages", []))
+        sampling = self._sampling(request)
+        with self._engine_lock:
+            out = self.engine.generate([token_ids], sampling)[0]
+        return {
+            "object": "chat.completion",
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant", "content": out.text},
+                "finish_reason": out.finish_reason,
+            }],
+            "usage": {
+                "prompt_tokens": len(out.prompt_token_ids),
+                "completion_tokens": len(out.token_ids),
+                "total_tokens": (len(out.prompt_token_ids)
+                                 + len(out.token_ids)),
+            },
+        }
+
+    @staticmethod
+    def _sampling(request: dict) -> SamplingParams:
+        return SamplingParams(
             max_tokens=int(request.get("max_tokens", 64)),
             temperature=float(request.get("temperature", 0.0)),
             top_k=int(request.get("top_k", 0)),
@@ -77,13 +96,33 @@ class LLMServer:
             stop_token_ids=tuple(request.get("stop_token_ids", ())),
             seed=request.get("seed"),
         )
+
+    def stream(self, request: dict):
+        """Token-streaming completion: a generator of OpenAI-chunk-shaped
+        dicts, consumed through the object plane as a streaming actor
+        call (num_returns="streaming") and exposed over SSE by the HTTP
+        proxy (ref: serve streaming responses, serve/_private/replica.py
+        streaming path)."""
+        chat = self._is_chat(request)
+        if chat:
+            from ant_ray_tpu.llm.chat import render_chat  # noqa: PLC0415
+
+            prompt = render_chat(self.engine.tokenizer,
+                                 request.get("messages", []))
+        else:
+            prompts = request.get("prompt", "")
+            prompt = prompts[0] if isinstance(prompts, list) and prompts \
+                and not isinstance(prompts[0], int) else prompts
+        sampling = self._sampling(request)
         # The lock spans the generator's whole life (tokens must stream
         # while generation runs, and no other request may touch the
         # engine mid-stream); the finally releases it even if the
         # consumer abandons the generator (GeneratorExit).
         self._engine_lock.acquire()
         try:
-            yield from self._chunks(self.engine.stream(prompt, sampling))
+            deltas = self.engine.stream(prompt, sampling)
+            yield from (self._chat_chunks(deltas) if chat
+                        else self._chunks(deltas))
         finally:
             self._engine_lock.release()
 
@@ -102,6 +141,22 @@ class LLMServer:
                                     "finish_reason": None}],
                        "done": False}
 
+    def _chat_chunks(self, deltas):
+        for delta in deltas:
+            if delta["finished"]:
+                yield {"object": "chat.completion.chunk",
+                       "choices": [{"index": 0, "delta": {},
+                                    "finish_reason":
+                                        delta["finish_reason"]}],
+                       "done": True}
+            else:
+                yield {"object": "chat.completion.chunk",
+                       "choices": [{"index": 0,
+                                    "delta": {"role": "assistant",
+                                              "content": delta["text"]},
+                                    "finish_reason": None}],
+                       "done": False}
+
     def health(self):
         return "ok"
 
@@ -110,12 +165,16 @@ def build_llm_deployment(model="tiny", *, name: str = "llm",
                          num_replicas: int = 1, slots: int = 8,
                          max_seq: int | None = None,
                          tokenizer_name: str | None = None,
-                         route_prefix: str | None = "/v1/completions"):
-    """Application for ``serve.run`` exposing the engine."""
+                         tensor_parallel_size: int = 1,
+                         route_prefix: str | None = "/v1"):
+    """Application for ``serve.run`` exposing the engine under the
+    OpenAI surface: POST /v1/completions and /v1/chat/completions
+    (+ streaming via {"stream": true})."""
     from ant_ray_tpu import serve  # noqa: PLC0415
 
     dep = serve.deployment(
         LLMServer, name=name, num_replicas=num_replicas,
         route_prefix=route_prefix)
     return dep.bind(model, slots=slots, max_seq=max_seq,
-                    tokenizer_name=tokenizer_name)
+                    tokenizer_name=tokenizer_name,
+                    tensor_parallel_size=tensor_parallel_size)

@@ -883,6 +883,12 @@ class HttpProxy:
             handle = resolve_handle(path)
             if handle is None:
                 return {"error": f"no route for {path}"}, 404
+            if isinstance(body, dict):
+                # Deployments that serve several REST endpoints under
+                # one prefix (e.g. /v1/completions + /v1/chat/...)
+                # dispatch on the request path (ref: proxy passes the
+                # scope through to the replica).
+                body.setdefault("__route_path__", path)
             return {"result": art.get(handle.remote(body))}, 200
 
         def stream_start(path: str, body):
@@ -892,6 +898,8 @@ class HttpProxy:
             handle = resolve_handle(path)
             if handle is None:
                 return None
+            if isinstance(body, dict):
+                body.setdefault("__route_path__", path)
             return handle.options(method_name="stream",
                                   stream=True).remote(body)
 
