@@ -60,6 +60,10 @@ class Deployment:
     init_args: tuple = ()
     init_kwargs: dict = field(default_factory=dict)
     autoscaling_config: AutoscalingConfig | None = None
+    # Redeploys replace replicas version-by-version, at most this many
+    # extra replicas alive at once (ref: deployment_state.py:2597
+    # rolling updates + max surge).
+    rolling_max_surge: int = 1
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)
@@ -68,6 +72,7 @@ class Deployment:
                 route_prefix: str | None = None,
                 name: str | None = None,
                 autoscaling_config: AutoscalingConfig | dict | None = None,
+                rolling_max_surge: int | None = None,
                 ) -> "Deployment":
         if isinstance(autoscaling_config, dict):
             autoscaling_config = AutoscalingConfig(**autoscaling_config)
@@ -82,6 +87,9 @@ class Deployment:
             init_kwargs=dict(self.init_kwargs),
             autoscaling_config=(autoscaling_config
                                 or self.autoscaling_config),
+            rolling_max_surge=(rolling_max_surge
+                               if rolling_max_surge is not None
+                               else self.rolling_max_surge),
         )
 
 
@@ -623,19 +631,8 @@ class ServeController:
         return replicas
 
     def deploy(self, deployment: Deployment, args, kwargs) -> dict:
-        art = _art()
-        existing = self._deployments.get(deployment.name)
-        # Versions survive redeploys: listeners hold the OLD entry's
-        # version, and a counter restarting below it would never wake
-        # them (they'd route to the killed replicas until the fallback
-        # TTL).
-        base_version = existing.get("version", 0) if existing else 0
-        if existing is not None:
-            for r in existing["replicas"]:
-                try:
-                    art.kill(r)
-                except Exception:  # noqa: BLE001
-                    pass
+        if self._deployments.get(deployment.name) is not None:
+            return self._rolling_redeploy(deployment, args, kwargs)
         n = deployment.num_replicas
         if deployment.autoscaling_config is not None:
             n = deployment.autoscaling_config.min_replicas
@@ -649,11 +646,78 @@ class ServeController:
                 "route_prefix": deployment.route_prefix,
                 "ongoing": [0] * len(replicas),
                 "low_streak": 0,
-                "version": base_version,
+                "version": 0,
             }
             self._deployments[deployment.name] = entry
             self._bump_version_locked(entry)
         return {"name": deployment.name}
+
+    def _rolling_redeploy(self, deployment: Deployment, args,
+                          kwargs) -> dict:
+        """Replace an existing deployment's replicas version-by-version
+        with at most ``rolling_max_surge`` extra replicas alive at a
+        time (ref: deployment_state.py:2597 rolling updates).  Each new
+        replica passes its readiness gate BEFORE a predecessor starts
+        draining, so the serving count never dips below target and no
+        request is dropped: handles learn each swap via the long-poll
+        version push while the replaced replica drains in-flight work
+        on the old code before dying."""
+        art = _art()
+        name = deployment.name
+        with self._lock:
+            entry = self._deployments.get(name)
+            if entry is None:    # raced a delete: fresh deploy
+                return {"name": name}
+            entry["deployment"] = deployment
+            entry["args"] = args
+            entry["kwargs"] = kwargs
+            entry["route_prefix"] = deployment.route_prefix
+            remaining = collections.deque(entry["replicas"])
+        surge = max(1, deployment.rolling_max_surge)
+        while remaining:
+            doomed = [remaining.popleft()
+                      for _ in range(min(surge, len(remaining)))]
+            fresh = self._make_replicas(deployment, args, kwargs,
+                                        len(doomed))
+            swapped = []
+            with self._lock:
+                entry = self._deployments.get(name)
+                if entry is None:          # deleted mid-roll
+                    for r in fresh:
+                        try:
+                            art.kill(r)
+                        except Exception:  # noqa: BLE001
+                            pass
+                    return {"name": name}
+                for old_r, new_r in zip(doomed, fresh):
+                    try:
+                        idx = entry["replicas"].index(old_r)
+                    except ValueError:     # autoscaler removed it mid-roll
+                        entry["replicas"].append(new_r)
+                        entry["ongoing"].append(0)
+                        continue
+                    entry["replicas"][idx] = new_r
+                    entry["ongoing"][idx] = 0
+                    swapped.append(old_r)
+                self._bump_version_locked(entry)
+            for replica in swapped:
+                threading.Thread(target=self._drain_then_kill,
+                                 args=(replica,), daemon=True).start()
+        # Converge to the new target size (autoscaling keeps its current
+        # count clamped to the new bounds; fixed deployments resize).
+        with self._lock:
+            entry = self._deployments.get(name)
+            current = len(entry["replicas"]) if entry else 0
+        if entry is not None:
+            cfg = deployment.autoscaling_config
+            target = (max(cfg.min_replicas,
+                          min(current, cfg.max_replicas)) if cfg
+                      else deployment.num_replicas)
+            if target > current:
+                self._scale_up(name, target - current)
+            elif target < current:
+                self._scale_down(name, current - target)
+        return {"name": name}
 
     def get_handle_info(self, name: str):
         with self._lock:
@@ -803,6 +867,16 @@ class ServeController:
             if e["route_prefix"]
         }
 
+    def start_grpc_proxy(self, port: int) -> int:
+        art = _art()
+        if getattr(self, "_grpc_proxy", None) is None:
+            proxy_cls = art.remote(GrpcProxy).options(
+                max_concurrency=32, num_cpus=0)
+            controller = art.get_actor(CONTROLLER_NAME,
+                                       namespace="_serve")
+            self._grpc_proxy = proxy_cls.remote(controller)
+        return art.get(self._grpc_proxy.start.remote(port))
+
     def start_http_proxy(self, port: int) -> int:
         art = _art()
         if self._proxy is None:
@@ -827,11 +901,12 @@ class ServeController:
             # deleted, so listener threads exit instead of waiting out
             # the poll window against a dead controller.
             self._version_cv.notify_all()
-        if self._proxy is not None:
-            try:
-                art.kill(self._proxy)
-            except Exception:  # noqa: BLE001
-                pass
+        for proxy in (self._proxy, getattr(self, "_grpc_proxy", None)):
+            if proxy is not None:
+                try:
+                    art.kill(proxy)
+                except Exception:  # noqa: BLE001
+                    pass
         self._deployments.clear()
         return True
 
@@ -968,6 +1043,119 @@ class HttpProxy:
         return self._port
 
 
+class GrpcProxy:
+    """gRPC ingress alongside HTTP (ref: serve/_private/proxy.py:533
+    ``class gRPCProxy``).
+
+    Redesigned without per-user proto codegen: ONE generic service,
+    ``antray.serve.Ingress``, speaks JSON-over-gRPC —
+
+      rpc Call(bytes)   returns (bytes)          # unary
+      rpc Stream(bytes) returns (stream bytes)   # server streaming
+
+    Request bytes are UTF-8 JSON ``{"route": "/prefix/...", "request":
+    {...}}``; the reply is the deployment's JSON response.  Clients
+    need only ``grpc.Channel.unary_unary`` with identity serializers —
+    no generated stubs."""
+
+    def __init__(self, controller):
+        self._controller = controller
+        self._server = None
+        self._handles: dict[str, DeploymentHandle] = {}
+        self._handles_lock = threading.Lock()
+
+    def _resolve_handle(self, path: str) -> "DeploymentHandle | None":
+        art = _art()
+        routes = art.get(self._controller.routes.remote())
+        for prefix, name in routes.items():
+            if path.startswith(prefix):
+                with self._handles_lock:
+                    handle = self._handles.get(name)
+                    if handle is None:
+                        info = art.get(
+                            self._controller.get_handle_info.remote(name))
+                        handle = DeploymentHandle(
+                            name, info["replicas"],
+                            controller=self._controller)
+                        self._handles[name] = handle
+                return handle
+        return None
+
+    @staticmethod
+    def _parse(request_bytes, context):
+        import json  # noqa: PLC0415
+
+        import grpc  # noqa: PLC0415
+
+        try:
+            payload = json.loads(request_bytes.decode("utf-8"))
+            route = payload["route"]
+        except Exception:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          'want JSON {"route": ..., "request": {...}}')
+        body = payload.get("request", {})
+        if isinstance(body, dict):
+            body.setdefault("__route_path__", route)
+        return route, body
+
+    def _call(self, request_bytes, context):
+        import json  # noqa: PLC0415
+
+        import grpc  # noqa: PLC0415
+
+        art = _art()
+        route, body = self._parse(request_bytes, context)
+        handle = self._resolve_handle(route)
+        if handle is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no route for {route}")
+        try:
+            result = art.get(handle.remote(body))
+        except Exception as e:  # noqa: BLE001 — user code error
+            context.abort(grpc.StatusCode.INTERNAL, repr(e))
+        return json.dumps({"result": result}).encode("utf-8")
+
+    def _stream(self, request_bytes, context):
+        import json  # noqa: PLC0415
+
+        import grpc  # noqa: PLC0415
+
+        art = _art()
+        route, body = self._parse(request_bytes, context)
+        handle = self._resolve_handle(route)
+        if handle is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"no route for {route}")
+        gen = handle.options(method_name="stream",
+                             stream=True).remote(body)
+        for ref in gen:
+            yield json.dumps(art.get(ref)).encode("utf-8")
+
+    def start(self, port: int) -> int:
+        from concurrent import futures  # noqa: PLC0415
+
+        import grpc  # noqa: PLC0415
+
+        proxy = self
+
+        class _Ingress(grpc.GenericRpcHandler):
+            def service(self, details):
+                if details.method == "/antray.serve.Ingress/Call":
+                    return grpc.unary_unary_rpc_method_handler(
+                        proxy._call)
+                if details.method == "/antray.serve.Ingress/Stream":
+                    return grpc.unary_stream_rpc_method_handler(
+                        proxy._stream)
+                return None
+
+        server = grpc.server(futures.ThreadPoolExecutor(max_workers=16))
+        server.add_generic_rpc_handlers((_Ingress(),))
+        bound = server.add_insecure_port(f"127.0.0.1:{port}")
+        server.start()
+        self._server = server
+        return bound
+
+
 # ---------------------------------------------------------------- run api
 
 def _get_or_create_controller():
@@ -984,8 +1172,11 @@ def _get_or_create_controller():
         return controller_cls.remote()
 
 
-def run(app: Application, *, port: int | None = None) -> DeploymentHandle:
-    """Deploy an application; returns its handle (ref: serve.run)."""
+def run(app: Application, *, port: int | None = None,
+        grpc_port: int | None = None) -> DeploymentHandle:
+    """Deploy an application; returns its handle (ref: serve.run).
+    ``grpc_port`` additionally starts the gRPC ingress (0 = ephemeral;
+    bound port in ``run.last_grpc_port``)."""
     art = _art()
     if not art.is_initialized():
         art.init()
@@ -995,6 +1186,9 @@ def run(app: Application, *, port: int | None = None) -> DeploymentHandle:
         actual = art.get(controller.start_http_proxy.remote(
             8000 if port is None else port))
         run.last_http_port = actual  # discoverable for tests/clients
+    if grpc_port is not None:
+        run.last_grpc_port = art.get(
+            controller.start_grpc_proxy.remote(grpc_port))
     info = art.get(
         controller.get_handle_info.remote(app.deployment.name))
     # The controller reference lets the handle refresh its replica set
@@ -1004,6 +1198,7 @@ def run(app: Application, *, port: int | None = None) -> DeploymentHandle:
 
 
 run.last_http_port = None
+run.last_grpc_port = None
 
 
 def shutdown():
