@@ -66,6 +66,12 @@ class ActorProgram:
     # channel index -> spec; this actor opens only the ones its steps use
     channels: dict[int, ChannelSpec] = field(default_factory=dict)
     input_channel: int | None = None   # index of the driver input channel
+    # Overlap pass (ref: dag_node_operation.py:325,576 — per-actor op
+    # reordering that starts READs before COMPUTE): every channel the
+    # tick will read is acquired+deserialized on prefetch threads at
+    # tick start, so waits on one upstream overlap with deserializing
+    # another and with this actor's own earlier compute steps.
+    overlap: bool = True
 
 
 class _PropagatedError:
@@ -87,6 +93,21 @@ def exec_loop(actor_instance, program: ActorProgram) -> dict:
     for idx, spec in program.channels.items():
         opened[idx] = ShmChannel(spec.path, create=False)
 
+    # Channels this program reads each tick (for the overlap prefetch).
+    read_idxs: set[int] = set()
+    for step in program.steps:
+        for t in list(step.args) + list(step.kwargs.values()):
+            if t[0] == "chan":
+                read_idxs.add(t[1])
+            elif t[0] == "input":
+                read_idxs.add(t[1][0])
+    pool = None
+    if program.overlap and len(read_idxs) > 1:
+        from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
+
+        pool = ThreadPoolExecutor(max_workers=len(read_idxs),
+                                  thread_name_prefix="dag-read")
+
     iterations = 0
     try:
         while True:
@@ -94,11 +115,19 @@ def exec_loop(actor_instance, program: ActorProgram) -> dict:
             local: dict[int, Any] = {}      # node_pos -> value
             chan_vals: dict[int, Any] = {}  # channel idx -> value
             reading: list[ShmChannel] = []
+            prefetched: dict[int, Any] = {}
+            if pool is not None:
+                # Overlap pass: all reads in flight before any compute.
+                for idx in read_idxs:
+                    prefetched[idx] = pool.submit(
+                        opened[idx].begin_read_tagged)
 
             def fetch_chan(idx: int):
                 if idx not in chan_vals:
                     ch = opened[idx]
-                    tag, value = ch.begin_read_tagged()
+                    fut = prefetched.pop(idx, None)
+                    tag, value = (fut.result() if fut is not None
+                                  else ch.begin_read_tagged())
                     reading.append(ch)
                     chan_vals[idx] = (_PropagatedError(value)
                                       if tag == "error" else value)
@@ -139,6 +168,8 @@ def exec_loop(actor_instance, program: ActorProgram) -> dict:
     except ChannelClosedError:
         pass  # teardown
     finally:
+        if pool is not None:
+            pool.shutdown(wait=False)
         for ch in opened.values():
             ch.close()
     return {"iterations": iterations}
@@ -185,10 +216,12 @@ class ChannelCompiledDAG:
     """Driver-side compiled graph: creates the channels, starts the
     per-actor exec loops, and pumps input/output."""
 
-    def __init__(self, output_node, buffer_size_bytes: int = 8 << 20):
+    def __init__(self, output_node, buffer_size_bytes: int = 8 << 20,
+                 overlap: bool = True):
         from ant_ray_tpu.dag.nodes import ActorMethodNode, InputNode
 
         self._buffer = buffer_size_bytes
+        self._overlap = overlap
         self._output_node = output_node
         order = output_node._topology()
         self._order = order
@@ -257,7 +290,7 @@ class ChannelCompiledDAG:
             aid = n._handle.actor_id
             prog = programs.get(aid)
             if prog is None:
-                prog = ActorProgram(steps=[])
+                prog = ActorProgram(steps=[], overlap=self._overlap)
                 programs[aid] = prog
                 order_of_actor[aid] = n._handle
             p = pos[id(n)]

@@ -61,18 +61,22 @@ class DAGNode:
         return resolved[id(self)]
 
     def experimental_compile(self, buffer_size_bytes: int = 8 << 20,
+                             overlap: bool = True,
                              _force_interpreted: bool = False):
         """Compile to channel-connected per-actor exec loops (the aDAG
-        substrate, dag/compiled.py).  Graphs that aren't pure
-        actor-method pipelines — or hosts without the native channel
-        extension — fall back to the interpreted pre-resolved executor."""
+        substrate, dag/compiled.py).  ``overlap`` enables the per-actor
+        read/compute overlap pass (ref: dag_node_operation.py op
+        reordering).  Graphs that aren't pure actor-method pipelines —
+        or hosts without the native channel extension — fall back to
+        the interpreted pre-resolved executor."""
         if not _force_interpreted:
             from ant_ray_tpu._private.native import load_native  # noqa: PLC0415
             from ant_ray_tpu.dag.compiled import ChannelCompiledDAG  # noqa: PLC0415
 
             if load_native() is not None:
                 try:
-                    return ChannelCompiledDAG(self, buffer_size_bytes)
+                    return ChannelCompiledDAG(self, buffer_size_bytes,
+                                              overlap=overlap)
                 except ValueError:
                     pass  # not an actor-only graph
         return CompiledDAG(self)
