@@ -39,6 +39,7 @@ from ant_ray_tpu.rllib.algorithm import (
 from ant_ray_tpu.rllib.appo import APPO, APPOConfig
 from ant_ray_tpu.rllib.bc import BC
 from ant_ray_tpu.rllib.env import CartPoleEnv, make_env, register_env
+from ant_ray_tpu.rllib.offline import OfflineData
 from ant_ray_tpu.rllib.learner_group import Learner, LearnerGroup
 from ant_ray_tpu.rllib.rl_module import (
     DiscretePolicyModule,
@@ -50,6 +51,6 @@ from ant_ray_tpu.rllib.sac import SAC, SACConfig
 
 __all__ = ["APPO", "APPOConfig", "Algorithm", "BC", "CartPoleEnv",
            "DQN", "DQNConfig", "DiscretePolicyModule", "IMPALA",
-           "IMPALAConfig", "Learner", "LearnerGroup", "PPOConfig",
-           "RLModule", "RLModuleSpec", "SAC", "SACConfig",
+           "IMPALAConfig", "Learner", "LearnerGroup", "OfflineData",
+           "PPOConfig", "RLModule", "RLModuleSpec", "SAC", "SACConfig",
            "TwinQModule", "make_env", "register_env"]

@@ -60,6 +60,25 @@ class BC:
         self._iteration += 1
         return {"training_iteration": self._iteration, **metrics}
 
+    def train_on_offline_data(self, offline_data, *, epochs: int = 1,
+                              minibatch_size: int = 128) -> dict:
+        """Stream an OfflineData (or data.Dataset / parquet paths)
+        through the learners (ref: offline_data.py:29 — training input
+        flows from the Data engine, never materialized in the driver)."""
+        from ant_ray_tpu.rllib.offline import OfflineData  # noqa: PLC0415
+
+        if not isinstance(offline_data, OfflineData):
+            offline_data = OfflineData(offline_data)
+        metrics: dict = {}
+        for _ in range(epochs):
+            for batch in offline_data.iter_minibatches(
+                    minibatch_size, columns=("obs", "actions")):
+                metrics = self.learners.update_from_batch({
+                    "obs": batch["obs"].astype(np.float32),
+                    "actions": batch["actions"].astype(np.int64)})
+        self._iteration += 1
+        return {"training_iteration": self._iteration, **metrics}
+
     def get_weights(self):
         return self.learners.get_weights()
 

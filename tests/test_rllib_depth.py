@@ -174,3 +174,32 @@ def test_ppo_with_learner_group_e2e(shutdown_only):
         losses.append(result["learner"]["total_loss"])
     algo.stop()
     assert len(losses) == 3 and np.isfinite(losses).all()
+
+
+def test_bc_trains_from_parquet_offline_dataset(shutdown_only, tmp_path):
+    """Offline pipeline (ref: rllib/offline/offline_data.py:29): BC
+    consumes a parquet dataset of transitions through the streaming
+    Data executor and learns the labeling rule."""
+    import numpy as np
+
+    import ant_ray_tpu as art
+    from ant_ray_tpu import data
+    from ant_ray_tpu.rllib import BC, OfflineData
+
+    art.init(num_cpus=2)
+    rng = np.random.RandomState(3)
+    obs = rng.randn(512, 4).astype(np.float32)
+    actions = (obs[:, 0] > 0).astype(np.int64)   # learnable rule
+    rows = [{"obs": o.tolist(), "actions": int(a)}
+            for o, a in zip(obs, actions)]
+    data.from_items(rows, parallelism=4).write_parquet(str(tmp_path))
+
+    ds = data.read_parquet([str(tmp_path / p)
+                            for p in sorted(tmp_path.iterdir())])
+    bc = BC(obs_dim=4, n_actions=2, hidden=32, lr=5e-2, seed=0)
+    offline = OfflineData(ds, shuffle=True, shuffle_seed=11)
+    metrics = {}
+    for _ in range(12):
+        metrics = bc.train_on_offline_data(offline, minibatch_size=128)
+    bc.stop()
+    assert metrics["accuracy"] > 0.9, metrics
