@@ -3,6 +3,8 @@ provisioning over pluggable node providers)."""
 
 from ant_ray_tpu.autoscaler.autoscaler import Autoscaler, AutoscalerConfig
 from ant_ray_tpu.autoscaler.node_provider import (
+    GkeApiError,
+    GkeRestNodePoolClient,
     GkeTpuNodePoolProvider,
     LocalSubprocessProvider,
     NodeProvider,
@@ -13,6 +15,8 @@ from ant_ray_tpu.autoscaler.node_provider import (
 __all__ = [
     "Autoscaler",
     "AutoscalerConfig",
+    "GkeApiError",
+    "GkeRestNodePoolClient",
     "GkeTpuNodePoolProvider",
     "LocalSubprocessProvider",
     "NodeProvider",

@@ -19,6 +19,7 @@ to the three verbs the v2 control loop actually needs.  Two built-ins:
 from __future__ import annotations
 
 import threading
+import time
 from dataclasses import dataclass, field
 
 
@@ -218,6 +219,84 @@ class LocalSubprocessProvider(NodeProvider):
         with self._lock:
             record = self._nodes.get(provider_id)
             return list(record["addresses"]) if record else None
+
+
+class GkeApiError(Exception):
+    """A GKE REST call failed (carries the HTTP-ish status code)."""
+
+    def __init__(self, status: int, message: str = ""):
+        super().__init__(f"GKE API error {status}: {message}")
+        self.status = status
+
+
+class GkeRestNodePoolClient:
+    """Node-pool client over the GKE REST surface (ref:
+    container.googleapis.com v1 —
+    ``projects.locations.clusters.nodePools`` get / ``:setSize`` and
+    zone operation polling; the surface behind
+    ``gcloud container clusters resize``).
+
+    ``request(method, path, body=None) -> dict`` is injected — a
+    google-auth session in production, a recorded fake in the contract
+    test — so the client itself is dependency-free.  The GKE semantics
+    encoded here (and pinned by tests/test_gke_provider.py):
+
+    * ``:setSize`` is ASYNC — it returns an Operation that must poll to
+      ``DONE`` before the resize is real;
+    * one resize per pool at a time — a concurrent ``:setSize`` fails
+      with 409/FAILED_PRECONDITION and must be retried after the
+      in-flight operation finishes;
+    * the pool's node count reads from the nodePool resource
+      (``initialNodeCount``, which GKE rewrites on resize).
+
+    Exposes the ``get_pool_size``/``set_pool_size`` seam
+    ``GkeTpuNodePoolProvider`` consumes.
+    """
+
+    def __init__(self, request, cluster_path: str, *,
+                 poll_interval_s: float = 1.0,
+                 resize_timeout_s: float = 900.0):
+        self._request = request
+        self._cluster = cluster_path.rstrip("/")
+        # "projects/P/locations/L/clusters/C" → operations live under
+        # "projects/P/locations/L".
+        self._location = self._cluster.rsplit("/clusters/", 1)[0]
+        self._poll_interval_s = poll_interval_s
+        self._resize_timeout_s = resize_timeout_s
+
+    def get_pool_size(self, pool: str) -> int:
+        resp = self._request(
+            "GET", f"{self._cluster}/nodePools/{pool}")
+        return int(resp.get("currentNodeCount",
+                            resp.get("initialNodeCount", 0)))
+
+    def set_pool_size(self, pool: str, size: int) -> None:
+        deadline = time.monotonic() + self._resize_timeout_s
+        while True:
+            try:
+                op = self._request(
+                    "POST", f"{self._cluster}/nodePools/{pool}:setSize",
+                    {"nodeCount": int(size)})
+                break
+            except GkeApiError as e:
+                # Another resize is in flight on this pool: wait it out.
+                if e.status not in (409, 412) or \
+                        time.monotonic() > deadline:
+                    raise
+                time.sleep(self._poll_interval_s)
+        self._wait_operation(op, deadline)
+
+    def _wait_operation(self, op: dict, deadline: float) -> None:
+        name = op.get("name")
+        status = op.get("status")
+        while status not in ("DONE", None):
+            if time.monotonic() > deadline:
+                raise GkeApiError(
+                    504, f"operation {name} did not finish in time")
+            time.sleep(self._poll_interval_s)
+            status = self._request(
+                "GET", f"{self._location}/operations/{name}"
+            ).get("status")
 
 
 class GkeTpuNodePoolProvider(NodeProvider):

@@ -1,0 +1,211 @@
+"""GKE node-pool provider contract tests: the provider + REST client
+against a recorded GKE API surface (async setSize operations, one
+resize per pool, conflict retries), including the full slice-launch →
+registration → gang-pending-release sequence (ref:
+container.googleapis.com v1 nodePools get/:setSize + operations)."""
+
+import threading
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu.autoscaler import (
+    Autoscaler,
+    AutoscalerConfig,
+    GkeApiError,
+    GkeRestNodePoolClient,
+    GkeTpuNodePoolProvider,
+    LocalSubprocessProvider,
+    tpu_slice_node_type,
+)
+from ant_ray_tpu.cluster_utils import Cluster
+from ant_ray_tpu.util.tpu import slice_placement_group
+
+CLUSTER = "projects/p1/locations/us-central2-b/clusters/tpu-c"
+
+
+class RecordedGkeApi:
+    """In-memory recording of the GKE REST surface the client speaks:
+
+    * ``GET  .../nodePools/{pool}``          → nodePool resource
+    * ``POST .../nodePools/{pool}:setSize``  → Operation (async)
+    * ``GET  .../operations/{op}``           → Operation status
+
+    Operations stay RUNNING for ``op_latency`` subsequent requests
+    (models the minutes-long real resize), and a second setSize on a
+    pool with an operation in flight fails 409 — both behaviors the
+    client must handle.  ``on_resize`` fires when a resize completes
+    (the test's stand-in for GKE VMs booting and joining the cluster).
+    """
+
+    def __init__(self, pools: dict, op_latency: int = 2):
+        self.pools = {name: {"name": name, "initialNodeCount": n}
+                      for name, n in pools.items()}
+        self.op_latency = op_latency
+        self.ops: dict = {}
+        self.inflight: dict = {}          # pool -> op name
+        self.log: list = []
+        self.on_resize = None
+        self._lock = threading.Lock()
+        self._op_counter = 0
+
+    def _tick_ops(self):
+        for name, op in list(self.ops.items()):
+            if op["status"] != "RUNNING":
+                continue
+            op["ttl"] -= 1
+            if op["ttl"] <= 0:
+                op["status"] = "DONE"
+                pool = op["pool"]
+                self.pools[pool]["initialNodeCount"] = op["target"]
+                self.inflight.pop(pool, None)
+                if self.on_resize is not None:
+                    self.on_resize(pool, op["target"])
+
+    def request(self, method: str, path: str, body=None) -> dict:
+        with self._lock:
+            self.log.append((method, path, body))
+            self._tick_ops()
+            if method == "GET" and "/nodePools/" in path:
+                pool = path.rsplit("/", 1)[1]
+                if pool not in self.pools:
+                    raise GkeApiError(404, pool)
+                return dict(self.pools[pool])
+            if method == "POST" and path.endswith(":setSize"):
+                pool = path.rsplit("/", 1)[1][:-len(":setSize")]
+                if pool not in self.pools:
+                    raise GkeApiError(404, pool)
+                if pool in self.inflight:
+                    raise GkeApiError(
+                        409, "a resize operation is already in "
+                        f"progress on {pool}")
+                self._op_counter += 1
+                name = f"operation-{self._op_counter}"
+                self.ops[name] = {"name": name, "status": "RUNNING",
+                                  "ttl": self.op_latency, "pool": pool,
+                                  "target": int(body["nodeCount"])}
+                self.inflight[pool] = name
+                return {"name": name, "status": "RUNNING",
+                        "operationType": "SET_NODE_POOL_SIZE"}
+            if method == "GET" and "/operations/" in path:
+                name = path.rsplit("/", 1)[1]
+                op = self.ops.get(name)
+                if op is None:
+                    raise GkeApiError(404, name)
+                return {"name": name, "status": op["status"]}
+            raise GkeApiError(400, f"unroutable {method} {path}")
+
+
+def _client(api, **kw):
+    kw.setdefault("poll_interval_s", 0.01)
+    return GkeRestNodePoolClient(api.request, CLUSTER, **kw)
+
+
+def test_rest_client_resize_polls_operation_to_done():
+    api = RecordedGkeApi({"pool-v5e": 0}, op_latency=3)
+    client = _client(api)
+    client.set_pool_size("pool-v5e", 2)
+    assert client.get_pool_size("pool-v5e") == 2
+    methods = [(m, p.rsplit("/", 2)[-2:]) for m, p, _ in api.log]
+    assert ("POST", ["nodePools", "pool-v5e:setSize"]) in [
+        (m, p) for m, p in methods]
+    # the client polled the operation rather than trusting the POST
+    assert any("/operations/" in p for _, p, _ in api.log)
+
+
+def test_rest_client_retries_conflicting_resize():
+    api = RecordedGkeApi({"pool-v5e": 0}, op_latency=2)
+    client = _client(api)
+    # Pre-install an in-flight resize (as if another actor resized).
+    api.request("POST", f"{CLUSTER}/nodePools/pool-v5e:setSize",
+                {"nodeCount": 1})
+    client.set_pool_size("pool-v5e", 2)      # must retry through the 409
+    assert client.get_pool_size("pool-v5e") == 2
+    posts = [e for e in api.log if e[0] == "POST"]
+    assert len(posts) >= 2                   # first conflicted, retried
+
+
+def test_rest_client_surfaces_unknown_pool():
+    api = RecordedGkeApi({"pool-v5e": 0})
+    client = _client(api)
+    with pytest.raises(GkeApiError) as err:
+        client.get_pool_size("nope")
+    assert err.value.status == 404
+
+
+def test_provider_create_terminate_list_over_rest():
+    api = RecordedGkeApi({"pool-v5e": 0}, op_latency=1)
+    provider = GkeTpuNodePoolProvider(
+        _client(api), pool_for_type={"v5e-slice": "pool-v5e"})
+    node_type = tpu_slice_node_type("4x4", name="v5e-slice")
+    a = provider.create_node(node_type)
+    b = provider.create_node(node_type)
+    assert api.pools["pool-v5e"]["initialNodeCount"] == 2
+    assert provider.non_terminated_nodes() == {
+        a: "v5e-slice", b: "v5e-slice"}
+    provider.terminate_node(a)
+    assert api.pools["pool-v5e"]["initialNodeCount"] == 1
+    provider.terminate_node(a)               # idempotent
+    assert api.pools["pool-v5e"]["initialNodeCount"] == 1
+    provider.terminate_node(b)
+    assert api.pools["pool-v5e"]["initialNodeCount"] == 0
+
+
+def test_slice_launch_registration_gang_release_sequence():
+    """The full GKE story against the recorded API: slice PG → gang
+    demand → ONE pool resize → (simulated) GKE hosts boot and register
+    → PG commits → demand released, no duplicate provisioning →
+    terminate drains the pool."""
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    cluster.connect()
+    slice_type = tpu_slice_node_type("4x4", name="v5e-slice",
+                                     cpus_per_host=1.0, max_workers=1)
+    # "GKE" boots real local daemons when a resize-up completes — the
+    # registration half of the sequence.
+    booter = LocalSubprocessProvider(cluster.gcs_address,
+                                     cluster._session_dir)
+    api = RecordedGkeApi({"pool-v5e": 0}, op_latency=1)
+
+    def boot(pool, size):
+        if size > 0:
+            booter.create_node(slice_type)
+
+    api.on_resize = boot
+    provider = GkeTpuNodePoolProvider(
+        _client(api), pool_for_type={"v5e-slice": "pool-v5e"})
+    autoscaler = Autoscaler(
+        cluster.gcs_address, provider,
+        AutoscalerConfig(node_types=[slice_type],
+                         gang_provision_grace_s=3600.0))
+    try:
+        autoscaler.run_once()                # heartbeat: PGs wait
+        spg = slice_placement_group("4x4", bundle_extra={"CPU": 0.5})
+        stop = threading.Event()
+        launched: list = []
+
+        def drive():
+            while not stop.is_set():
+                launched.extend(autoscaler.run_once()["launched"])
+                time.sleep(0.5)
+
+        thread = threading.Thread(target=drive, daemon=True)
+        thread.start()
+        try:
+            assert spg.ready(timeout=90), "slice PG never committed"
+        finally:
+            stop.set()
+            thread.join(timeout=10)
+        # ONE atomic slice resize; the satisfied gang never relaunches.
+        assert launched == ["v5e-slice"]
+        assert api.pools["pool-v5e"]["initialNodeCount"] == 1
+        assert autoscaler.run_once()["launched"] == []
+        spg.remove()
+        for pid in list(provider.non_terminated_nodes()):
+            provider.terminate_node(pid)
+        assert api.pools["pool-v5e"]["initialNodeCount"] == 0
+    finally:
+        for pid in list(booter.non_terminated_nodes()):
+            booter.terminate_node(pid)
+        art.shutdown()
+        cluster.shutdown()
