@@ -124,17 +124,28 @@ def main() -> None:
     gbps = (len(blob) * n_big / (1 << 30)) / (time.perf_counter() - t0)
     emit("put_get_bandwidth_gb_s", gbps, "GiB/s")
 
-    # ---- wait over many refs (ray_perf: "wait 1k refs")
+    # ---- wait over many refs (ray_perf: "wait 1k refs").  The refs
+    # are all ready, so one wait is far below clock resolution —
+    # measure many rounds and report µs/round (a visible unit: the
+    # old single-round seconds reading rounded to a degenerate 0.0).
     refs = [nop.remote() for _ in range(int(1000 * scale))]
     art.get(refs)
+    rounds = 20
     t0 = time.perf_counter()
-    ready, _ = art.wait(refs, num_returns=len(refs), timeout=60)
-    emit("wait_1k_ready_refs_s", time.perf_counter() - t0, "s")
+    for _ in range(rounds):
+        ready, _ = art.wait(refs, num_returns=len(refs), timeout=60)
+    emit("wait_1k_ready_refs_us", 1e6 *
+         (time.perf_counter() - t0) / rounds, "us")
     assert len(ready) == len(refs)
 
     art.shutdown()
     print(json.dumps({"metric": "microbench_summary",
-                      "workloads": len(results)}))
+                      "workloads": len(results),
+                      # Sync task/actor roundtrips are bounded by the
+                      # host's core count (driver + daemon + worker
+                      # share one CPU on the bench rig); the async
+                      # figures are the engine numbers.
+                      "note": "sync paths rig-limited on 1-cpu hosts"}))
     if args.json_out:
         import platform
 
