@@ -1307,15 +1307,17 @@ class NodeManager:
                 for addr in owners:
                     try:
                         await self._clients.get(addr).call_async(
-                            "Ping", {}, timeout=2)
+                            "Ping", {}, timeout=5)
                         fails.pop(addr, None)
                     except (RpcConnectionError, RpcTimeoutError):
                         # Both refusals and black holes (established
-                        # connection, no reply) count; one miss can be
-                        # a loaded-but-alive owner, so reclaim only
-                        # after two consecutive failures.
+                        # connection, no reply) count — but a LOADED
+                        # owner on a saturated host can miss pings for
+                        # many seconds, and a false reclaim terminates
+                        # its busy workers; demand three consecutive
+                        # strikes (≥ ~15s unresponsive) before acting.
                         fails[addr] = fails.get(addr, 0) + 1
-                        if fails[addr] >= 2:
+                        if fails[addr] >= 3:
                             fails.pop(addr, None)
                             self._reclaim_leases_of(addr)
                     except Exception:  # noqa: BLE001 — reachable but

@@ -43,7 +43,9 @@ _PLAN_FAIL_MARKERS = _OOM_MARKERS + (
     "remote_compile", "tpu_compile_helper", "HTTP 500")
 
 
-def measure(remat: str, batch_scale: float):
+def measure(remat: str, batch_scale: float, *, config_key: str | None =
+            None, seq_override: int | None = None, base_batch: int = 8,
+            n_steps: int = 10):
     from ant_ray_tpu._private.accelerators import tpu as tpu_accel
     from ant_ray_tpu._private.jax_utils import import_jax
     from ant_ray_tpu.models import llama
@@ -62,11 +64,13 @@ def measure(remat: str, batch_scale: float):
     on_tpu = backend in ("tpu", "axon")
 
     if on_tpu:
-        config = llama.CONFIGS["llama-400m"]
-        batch, seq = max(1, int(8 * batch_scale)), 2048
+        config = llama.CONFIGS[config_key or "llama-400m"]
+        batch = max(1, int(base_batch * batch_scale))
+        seq = seq_override or 2048
         gen = tpu_accel.detect_generation() or "v5e"
         peak_flops = tpu_accel.peak_bf16_tflops(gen) * 1e12
-        metric = "llama400m_train_mfu_v5e_1chip"
+        metric = (f"llama_{config_key}_train_mfu_1chip" if config_key
+                  else "llama400m_train_mfu_v5e_1chip")
     else:  # CI / no-accelerator fallback: tiny config, nominal peak
         config = llama.CONFIGS["tiny"]
         batch, seq = max(1, int(2 * batch_scale)), 256
@@ -94,8 +98,6 @@ def measure(remat: str, batch_scale: float):
     for _ in range(3):
         params, opt_state, loss = step(params, opt_state, tokens)
     float(loss)
-
-    n_steps = 10
     t0 = time.perf_counter()
     for _ in range(n_steps):
         params, opt_state, loss = step(params, opt_state, tokens)
@@ -136,16 +138,37 @@ def run_child() -> None:
     for remat, scale in plans:
         try:
             result = measure(remat, scale)
-            print(json.dumps(result))
-            return
+            break
         except Exception as e:  # noqa: BLE001
             msg = repr(e)
             last_err = msg
+            result = None
             if any(m in msg for m in _PLAN_FAIL_MARKERS):
                 continue  # next (cheaper) plan
             break  # non-OOM: report it — parent decides about retry
-    print(json.dumps({"metric": "bench_error", "value": 0.0, "unit": "MFU",
-                      "vs_baseline": 0.0, "error": (last_err or "")[:300]}))
+    if result is None:
+        print(json.dumps({"metric": "bench_error", "value": 0.0,
+                          "unit": "MFU", "vs_baseline": 0.0,
+                          "error": (last_err or "")[:300]}))
+        return
+    if result.get("backend") in ("tpu", "axon"):
+        # Secondary metric: the north-star model SHAPE on one chip —
+        # a llama-1B proxy step (full remat; bf16 adam states) so the
+        # 8B-class memory regime is measured at all (VERDICT r4 #4).
+        # Best-effort: its failure must never cost the headline number.
+        for batch in (4, 2, 1):
+            try:
+                r1b = measure("full", 1.0, config_key="llama3-1b",
+                              base_batch=batch, n_steps=4)
+                result["llama1b_mfu"] = r1b["value"]
+                result["llama1b_step_time_ms"] = r1b["step_time_ms"]
+                result["llama1b_batch"] = batch
+                break
+            except Exception as e:  # noqa: BLE001 — OOM → smaller batch
+                result["llama1b_error"] = repr(e)[:160]
+                if not any(m in repr(e) for m in _PLAN_FAIL_MARKERS):
+                    break
+    print(json.dumps(result))
 
 
 def main() -> None:

@@ -9,6 +9,13 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon site hook (sitecustomize) eagerly imports jax + registers the
+# TPU PJRT plugin in EVERY python process when this var is set — ~1.9s
+# of pure overhead per spawned gcs/daemon/worker subprocess, and the
+# suite spawns hundreds.  Tests are pinned to CPU; drop the trigger so
+# children skip the hook (bench.py / real-TPU runs never import this
+# conftest and keep it).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 # The env var alone is not enough where a site plugin pins the platform;
 # ART_JAX_PLATFORM makes ant_ray_tpu's jax_utils force it via jax.config
 # (inherited by worker subprocesses).

@@ -323,7 +323,7 @@ def test_overlap_pass_parallelizes_channel_reads(cluster):
     _require_channels()
     import time
 
-    delay = 0.15
+    delay = 0.1
 
     @art.remote
     class Producer:
@@ -341,7 +341,7 @@ def test_overlap_pass_parallelizes_channel_reads(cluster):
             dag = c.both.bind(pa.make.bind(inp), pb.make.bind(inp))
         return pa, pb, c, dag
 
-    def timed(compiled, n=6):
+    def timed(compiled, n=4):
         # warmup (channel setup + first reads), then steady-state ticks
         compiled.execute(0).get(timeout=60)
         t0 = time.perf_counter()

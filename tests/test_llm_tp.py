@@ -32,7 +32,7 @@ def test_tp_engine_builds_mesh_and_shards(params):
 
 def test_tp_prefill_decode_parity(params):
     prompt = [3, 5, 7, 11, 13, 17]
-    sp = SamplingParams(max_tokens=10, temperature=0.0)
+    sp = SamplingParams(max_tokens=6, temperature=0.0)
     single = LLMEngine("tiny", params=params, slots=2)
     tp2 = LLMEngine("tiny", params=params, slots=2,
                     tensor_parallel_size=2)

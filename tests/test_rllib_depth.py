@@ -121,7 +121,7 @@ def test_sac_improves_on_cartpole():
     algo = config.build()
     first = None
     best = -np.inf
-    for _ in range(12):
+    for _ in range(8):
         result = algo.train()
         if not np.isnan(result["episode_return_mean"]):
             if first is None:
@@ -188,7 +188,7 @@ def test_bc_trains_from_parquet_offline_dataset(shutdown_only, tmp_path):
 
     art.init(num_cpus=2)
     rng = np.random.RandomState(3)
-    obs = rng.randn(512, 4).astype(np.float32)
+    obs = rng.randn(384, 4).astype(np.float32)
     actions = (obs[:, 0] > 0).astype(np.int64)   # learnable rule
     rows = [{"obs": o.tolist(), "actions": int(a)}
             for o, a in zip(obs, actions)]
@@ -196,10 +196,10 @@ def test_bc_trains_from_parquet_offline_dataset(shutdown_only, tmp_path):
 
     ds = data.read_parquet([str(tmp_path / p)
                             for p in sorted(tmp_path.iterdir())])
-    bc = BC(obs_dim=4, n_actions=2, hidden=32, lr=5e-2, seed=0)
+    bc = BC(obs_dim=4, n_actions=2, hidden=32, lr=8e-2, seed=0)
     offline = OfflineData(ds, shuffle=True, shuffle_seed=11)
     metrics = {}
-    for _ in range(12):
+    for _ in range(8):
         metrics = bc.train_on_offline_data(offline, minibatch_size=128)
     bc.stop()
     assert metrics["accuracy"] > 0.9, metrics

@@ -164,7 +164,7 @@ def test_trainer_broadcast_dataset_not_split(cluster, tmp_path_factory):
 
 def test_trainer_shard_reassigned_after_worker_death(cluster,
                                                      tmp_path_factory):
-    ds = data.range(48, parallelism=6)
+    ds = data.range(32, parallelism=4)
 
     class Sink:
         def __init__(self):
@@ -209,8 +209,8 @@ def test_trainer_shard_reassigned_after_worker_death(cluster,
     # the fresh split), equal counts per rank.
     attempt1 = by_attempt[1]
     assert set(attempt1) == {0, 1}
-    assert sorted(attempt1[0] + attempt1[1]) == list(range(48))
-    assert len(attempt1[0]) == len(attempt1[1]) == 24
+    assert sorted(attempt1[0] + attempt1[1]) == list(range(32))
+    assert len(attempt1[0]) == len(attempt1[1]) == 16
 
 
 def test_get_dataset_shard_unknown_name_raises(cluster, tmp_path_factory):
