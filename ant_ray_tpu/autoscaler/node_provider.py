@@ -288,15 +288,41 @@ class GkeRestNodePoolClient:
 
     def _wait_operation(self, op: dict, deadline: float) -> None:
         name = op.get("name")
-        status = op.get("status")
-        while status not in ("DONE", None):
+        while True:
+            if op.get("status") == "DONE":
+                # DONE is NOT success: a failed resize completes DONE
+                # with an `error` (or legacy `statusMessage`) attached —
+                # e.g. stockout / quota — and treating it as success
+                # leaves the autoscaler believing nodes exist.
+                self._raise_if_operation_failed(op)
+                return
             if time.monotonic() > deadline:
                 raise GkeApiError(
                     504, f"operation {name} did not finish in time")
+            if name is None:
+                # No handle to poll and no DONE status: the response is
+                # malformed — fail loudly instead of assuming success.
+                raise GkeApiError(
+                    500, "operation response carried no name/status: "
+                    f"{op!r}")
             time.sleep(self._poll_interval_s)
-            status = self._request(
-                "GET", f"{self._location}/operations/{name}"
-            ).get("status")
+            op = self._request(
+                "GET", f"{self._location}/operations/{name}")
+
+    @staticmethod
+    def _raise_if_operation_failed(op: dict) -> None:
+        err = op.get("error")
+        msg = op.get("statusMessage") or ""
+        if not err and not msg:
+            return
+        code = 500
+        if isinstance(err, dict):
+            code = int(err.get("code") or 500)
+            msg = err.get("message") or msg or repr(err)
+        elif err:
+            msg = msg or repr(err)
+        raise GkeApiError(
+            code, f"operation {op.get('name')} finished with error: {msg}")
 
 
 class GkeTpuNodePoolProvider(NodeProvider):

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from collections import deque
 from typing import Iterator
 
@@ -40,6 +41,12 @@ logger = logging.getLogger(__name__)
 # a consumer's queue is full, which stalls the executor's pull, which
 # stops launching read/map tasks — end-to-end backpressure.
 _QUEUE_CAP = 2
+# How long a rank parked at a retry barrier waits after a PREVIOUS
+# epoch failed with no sign of the other ranks retrying, before the
+# failure is surfaced to it too (epoch-scoped errors keep the stale
+# failure out of a genuine retry; this grace keeps a gang that is NOT
+# retrying from parking the early rank forever).
+_BARRIER_GRACE_S = 30.0
 
 
 def _art():
@@ -53,6 +60,11 @@ class DataIterator:
     python/ray/data/iterator.py:55).  Each ``iter_batches`` /
     ``iter_rows`` call is one full pass (one epoch); concrete
     subclasses supply the block-ref stream."""
+
+    # Defaults for iter_device_batches, set via configure_device_feed
+    # (the trainer forwards DataConfig.device_feed per rank through it).
+    _device_feed_defaults: dict | None = None
+    _last_device_feed = None
 
     def _iter_block_refs(self) -> Iterator:
         raise NotImplementedError
@@ -73,6 +85,75 @@ class DataIterator:
 
         for block in self._iter_blocks():
             yield from BlockAccessor.for_block(block).to_rows()
+
+    def iter_device_batches(self, batch_size: int | None = None,
+                            prefetch_batches: int | None = None,
+                            sharding=None, collate_fn=None, *,
+                            drop_last: bool | None = None,
+                            pad_value=None) -> Iterator:
+        """One epoch of prefetched, double-buffered DEVICE batches.
+
+        A background producer thread pulls blocks, collates rows into
+        contiguous fixed-shape arrays (the tail batch pads to
+        ``batch_size`` so a jitted step never recompiles), and issues
+        async ``jax.device_put`` against ``sharding`` into a bounded
+        queue — the host→HBM transfer for batch N+1 overlaps the step
+        compute for batch N.  ``prefetch_batches=0`` is the blocking
+        baseline (transfer on the critical path).  ``sharding`` may be
+        a jax Sharding/device or a callable ``(rank, world) ->
+        sharding`` resolved lazily in the consuming process.  Per-stage
+        timings land in ``stats()["device_feed"]``.
+        """
+        from ant_ray_tpu.data.device_feed import DeviceFeed  # noqa: PLC0415
+
+        d = self._device_feed_defaults or {}
+        feed = DeviceFeed(
+            self._iter_blocks,
+            batch_size=(batch_size if batch_size is not None
+                        else d.get("batch_size", 256)),
+            prefetch_batches=(prefetch_batches
+                              if prefetch_batches is not None
+                              else d.get("prefetch_batches", 2)),
+            sharding=sharding if sharding is not None else d.get("sharding"),
+            collate_fn=(collate_fn if collate_fn is not None
+                        else d.get("collate_fn")),
+            drop_last=(drop_last if drop_last is not None
+                       else d.get("drop_last", False)),
+            pad_value=(pad_value if pad_value is not None
+                       else d.get("pad_value", 0)),
+            rank=d.get("rank", getattr(self, "_rank", 0)),
+            world=d.get("world", getattr(self, "_world", 1)),
+        )
+        self._last_device_feed = feed
+        return iter(feed)
+
+    def configure_device_feed(self, **defaults) -> "DataIterator":
+        """Set defaults for :meth:`iter_device_batches` (keys:
+        batch_size, prefetch_batches, sharding, collate_fn, drop_last,
+        pad_value, rank, world).  The train controller calls this per
+        rank from ``DataConfig.device_feed``; explicit call-site
+        arguments still win."""
+        merged = dict(self._device_feed_defaults or {})
+        merged.update(defaults)
+        self._device_feed_defaults = merged
+        return self
+
+    def stats(self) -> dict:
+        """Observability surface: per-stage timings of the most recent
+        (possibly still-running) device feed under ``"device_feed"``
+        (block-wait, collate, transfer-issue, consumer-starve)."""
+        out: dict = {}
+        feed = self._last_device_feed
+        if feed is not None:
+            out["device_feed"] = dict(feed.stats)
+        return out
+
+    def __getstate__(self):
+        # Iterators ship to workers; a live feed (thread handle) does
+        # not survive pickling and never needs to.
+        state = dict(self.__dict__)
+        state.pop("_last_device_feed", None)
+        return state
 
     def materialize(self):
         """Drain one epoch into a plain Dataset (refs, not rows)."""
@@ -130,7 +211,9 @@ class StreamSplitDataIterator(DataIterator):
                     f"streaming split '{self._name}' failed: {payload}")
 
     def stats(self) -> dict:
-        return _art().get(self._coord.stats.remote())
+        out = _art().get(self._coord.stats.remote())
+        out.update(DataIterator.stats(self))   # adds "device_feed"
+        return out
 
     def __repr__(self):
         return (f"StreamSplitDataIterator(name={self._name!r}, "
@@ -158,7 +241,11 @@ class _SplitCoordinator:
         self._epoch = -1               # epoch currently running/finished
         self._arrived: set = set()     # (epoch, rank) barrier arrivals
         self._done = False             # current epoch's stream exhausted
-        self._error: str | None = None
+        # Errors are scoped (epoch, repr): a retried epoch must never
+        # see the previous epoch's failure (a rank arriving early at
+        # the new barrier would otherwise re-raise the stale error and
+        # desync the gang forever).
+        self._error: "tuple[int, str] | None" = None
         self._rows_out = [0] * n       # last finished epoch's row counts
         self._epochs_finished = 0
 
@@ -186,15 +273,43 @@ class _SplitCoordinator:
                                  daemon=True).start()
                 self._cv.notify_all()
             else:
-                self._cv.wait_for(lambda: self._epoch >= epoch
-                                  or self._error is not None)
+                grace_deadline = None
+                seen_arrivals = len(self._arrived)
+                while not (self._epoch >= epoch
+                           or self._epoch_error(epoch) is not None):
+                    self._cv.wait(timeout=1.0)
+                    if len(self._arrived) != seen_arrivals:
+                        seen_arrivals = len(self._arrived)
+                        grace_deadline = None   # gang is arriving
+                    prev = self._error
+                    if (prev is not None and prev[0] < epoch
+                            and self._epoch < epoch):
+                        # A previous epoch failed and this barrier is
+                        # not filling: the other ranks may never retry.
+                        now = time.monotonic()
+                        if grace_deadline is None:
+                            grace_deadline = now + _BARRIER_GRACE_S
+                        elif now >= grace_deadline:
+                            raise RuntimeError(
+                                f"streaming split '{self._name}' "
+                                f"barrier for epoch {epoch} abandoned: "
+                                f"epoch {prev[0]} failed ({prev[1]}) "
+                                "and the other consumers did not retry")
             return True
+
+    def _epoch_error(self, epoch: int) -> str | None:
+        """The recorded error IF it belongs to ``epoch`` (errors are
+        (epoch, repr) pairs; other epochs' failures are invisible)."""
+        if self._error is not None and self._error[0] == epoch:
+            return self._error[1]
+        return None
 
     def next_block(self, rank: int, epoch: int):
         with self._cv:
             while True:
-                if self._error is not None:
-                    return ("error", self._error)
+                err = self._epoch_error(epoch)
+                if err is not None:
+                    return ("error", err)
                 if epoch < self._epoch:
                     # A newer epoch started (this consumer was resliced
                     # away mid-stream) — its old stream is over.
@@ -247,8 +362,12 @@ class _SplitCoordinator:
             logger.exception("streaming split '%s' epoch %d failed",
                              self._name, epoch)
             with self._cv:
-                self._error = repr(e)
-                self._cv.notify_all()
+                # Only poison the epoch that actually failed; a late
+                # failure from a superseded epoch's thread must not
+                # leak into the one now running.
+                if self._epoch == epoch:
+                    self._error = (epoch, repr(e))
+                    self._cv.notify_all()
 
     def _abandoned(self, rank: int, epoch: int) -> bool:
         return any(r == rank and e > epoch for e, r in self._arrived)
@@ -258,8 +377,9 @@ class _SplitCoordinator:
             self._cv.wait_for(
                 lambda: len(self._queues[rank]) < _QUEUE_CAP
                 or self._abandoned(rank, epoch)
-                or self._epoch != epoch or self._error is not None)
-            if self._epoch != epoch or self._error is not None:
+                or self._epoch != epoch
+                or self._epoch_error(epoch) is not None)
+            if self._epoch != epoch or self._epoch_error(epoch) is not None:
                 raise _Aborted
             if self._abandoned(rank, epoch):
                 return                 # consumer left this epoch; drop
@@ -272,8 +392,9 @@ class _SplitCoordinator:
         with self._cv:
             self._cv.wait_for(
                 lambda: any(len(q) < _QUEUE_CAP for q in self._queues)
-                or self._epoch != epoch or self._error is not None)
-            if self._epoch != epoch or self._error is not None:
+                or self._epoch != epoch
+                or self._epoch_error(epoch) is not None)
+            if self._epoch != epoch or self._epoch_error(epoch) is not None:
                 raise _Aborted
             return min(range(self._n),
                        key=lambda r: (len(self._queues[r]), r))

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from typing import Any
 
 logger = logging.getLogger(__name__)
@@ -51,6 +52,40 @@ def _pair_lock(group: str, peer: int) -> threading.Lock:
         return _fetch_locks.setdefault((group, peer), threading.Lock())
 
 
+# Outbound transfers serialize PER DESTINATION (p2p channels are
+# ordered pair-wise): a wedged consumer must only stall sends to
+# itself, never the whole holder.  A module-global lock here would let
+# one dead peer wedge every outbound transfer of the process.
+_send_locks: dict = {}
+_send_locks_guard = threading.Lock()
+# Bound on one outbound transfer (lock acquisition + shard sends): on
+# expiry the pair is poisoned and the send abandoned — the consumer's
+# own watchdog turns the dead transfer into ObjectLost on its side.
+_SEND_DEADLINE_S = 300.0
+
+
+def _send_lock_for(group: str, peer: int) -> threading.Lock:
+    with _send_locks_guard:
+        return _send_locks.setdefault((group, peer), threading.Lock())
+
+
+def clear_group(group: str) -> None:
+    """Forget all per-pair transport state for ``group`` — called on
+    collective-group teardown so a re-initialized group starts with a
+    clean slate (stale poisoned-pair markers would dma-degrade the new
+    incarnation forever; stale locks could be held by dead threads)."""
+    # Snapshot before filtering: watchdog threads add() concurrently,
+    # and iterating the live set would raise mid-teardown.
+    _poisoned_pairs.difference_update(
+        {p for p in list(_poisoned_pairs) if p[0] == group})
+    with _fetch_locks_guard:
+        for key in [k for k in _fetch_locks if k[0] == group]:
+            del _fetch_locks[key]
+    with _send_locks_guard:
+        for key in [k for k in _send_locks if k[0] == group]:
+            del _send_locks[key]
+
+
 def shards_in_mesh_order(array: Any) -> list:
     """Addressable shards sorted by their device's flat position in the
     mesh grid — the canonical wire order for shard-by-shard transfers
@@ -63,26 +98,70 @@ def shards_in_mesh_order(array: Any) -> list:
                   key=lambda s: pos.get(id(s.device), 1 << 30))
 
 
-_send_lock = threading.Lock()
-
-
-def send_shards(array: Any, dst_rank: int, group: str) -> None:
+def send_shards(array: Any, dst_rank: int, group: str,
+                deadline_s: float | None = None) -> None:
     """Holder side of the collective transport: push each shard in mesh
     order over the p2p channel (called from the DeviceTensorSendVia
     RPC, off the io loop).  Failures are logged, not raised — the RPC
     already acked; the consumer's recv watchdog turns a dead transfer
-    into ObjectLost + pair poisoning on its side."""
+    into ObjectLost + pair poisoning on its side.
+
+    Sends serialize per destination (pair-ordered channels) and are
+    bounded by ``deadline_s`` (default ``_SEND_DEADLINE_S``): a dead
+    consumer poisons only its own pair instead of wedging every
+    outbound transfer of this process behind one global lock."""
     import numpy as np  # noqa: PLC0415
 
     from ant_ray_tpu.util.collective import collective as col  # noqa: PLC0415
 
+    budget = deadline_s if deadline_s is not None else _SEND_DEADLINE_S
+    # ONE deadline for lock acquisition + sends — not budget each, so a
+    # caller queued behind a stalled transfer still observes the
+    # documented bound rather than up to twice it.
+    deadline_at = time.monotonic() + budget
+    if (group, dst_rank) in _poisoned_pairs:
+        logger.warning("skipping shard send to rank %d over %r: pair is "
+                       "poisoned (previous transfer stalled)",
+                       dst_rank, group)
+        return
+    lock = _send_lock_for(group, dst_rank)
+    if not lock.acquire(timeout=budget):
+        _poisoned_pairs.add((group, dst_rank))
+        logger.error("send lock for rank %d over %r not acquired within "
+                     "%.0fs; pair poisoned", dst_rank, group, budget)
+        return
     try:
-        with _send_lock:  # one outbound transfer at a time: p2p order
+        abort = threading.Event()
+
+        def _send_all() -> None:
             for shard in shards_in_mesh_order(array):
+                if abort.is_set():
+                    return     # abandoned: stop at a shard boundary so
+                    # a later incarnation of the group never sees our
+                    # remaining shards interleaved into its channel
                 col.send(np.asarray(shard.data), dst_rank, group)
+
+        import concurrent.futures as cf  # noqa: PLC0415
+
+        pool = cf.ThreadPoolExecutor(max_workers=1)
+        fut = pool.submit(_send_all)
+        try:
+            fut.result(max(0.1, deadline_at - time.monotonic()))
+        except cf.TimeoutError:
+            abort.set()
+            _poisoned_pairs.add((group, dst_rank))
+            logger.error("collective shard send to rank %d over %r "
+                         "stalled for %.0fs; pair poisoned, send "
+                         "abandoned", dst_rank, group, budget)
+        finally:
+            # wait=False: an expired send thread is parked in an
+            # uninterruptible send — joining it would re-wedge us.
+            pool.shutdown(wait=False)
     except Exception:  # noqa: BLE001 — surfaced on the consumer side
         logger.exception("collective shard send to rank %d over %r "
                          "failed", dst_rank, group)
+    finally:
+        lock.release()
 
 
 def shard_layout(array: Any) -> dict | None:

@@ -8,6 +8,7 @@ dense per-slot KV slabs, bucketed prefill, and a continuous-batching
 scheduler whose compiled step functions have static shapes.
 """
 
+from ant_ray_tpu.llm.batch import build_llm_processor, build_logprob_processor
 from ant_ray_tpu.llm.engine import LLMEngine, RequestOutput
 from ant_ray_tpu.llm.sampling import SamplingParams
 from ant_ray_tpu.llm.tokenizer import ByteTokenizer, get_tokenizer
@@ -17,5 +18,7 @@ __all__ = [
     "LLMEngine",
     "RequestOutput",
     "SamplingParams",
+    "build_llm_processor",
+    "build_logprob_processor",
     "get_tokenizer",
 ]

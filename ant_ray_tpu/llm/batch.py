@@ -46,3 +46,79 @@ def build_llm_processor(model="tiny", *, concurrency: int = 1,
         return Dataset(out_refs)
 
     return process
+
+
+def build_logprob_processor(model="tiny", *, batch_size: int = 8,
+                            prefetch_batches: int = 2,
+                            max_len: int | None = None,
+                            token_key: str = "tokens",
+                            output_key: str = "nll",
+                            pad_id: int = 0, sharding=None, seed: int = 0):
+    """Batch scoring (per-row mean next-token NLL) over pre-tokenized
+    rows, fed through the device-feed iterator
+    (``DataIterator.iter_device_batches``): a producer thread pads each
+    batch to a fixed ``(batch_size, max_len)`` shape and issues the
+    host→device transfer for batch N+1 while the jitted forward for
+    batch N runs — the same transfer/compute overlap the Train ingest
+    path gets.
+
+    rows: dicts with ``token_key`` → list of token ids.  Returns a
+    Dataset→Dataset callable producing rows ``{"row": i, output_key:
+    nll_per_token}`` aligned with the input order (the feed's
+    ``tail_padded_rows`` stat trims the padded tail).
+    """
+    import numpy as np  # noqa: PLC0415
+
+    from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
+    from ant_ray_tpu.models import checkpoint as ckpt  # noqa: PLC0415
+    from ant_ray_tpu.models import llama  # noqa: PLC0415
+
+    jax = import_jax()
+    import jax.numpy as jnp  # noqa: PLC0415
+    import optax  # noqa: PLC0415
+
+    loaded, config = ckpt.resolve_model(model)
+    params = (loaded if loaded is not None
+              else llama.init_params(config, jax.random.PRNGKey(seed)))
+    seq = min(max_len or 128, config.max_seq)
+
+    def _nll(params, tokens):
+        mask = (tokens != pad_id).astype(jnp.float32)
+        logits = llama.forward(params, tokens[:, :-1], config)
+        losses = optax.softmax_cross_entropy_with_integer_labels(
+            logits, tokens[:, 1:])
+        m = mask[:, 1:]
+        return (losses * m).sum(-1) / jnp.maximum(m.sum(-1), 1.0)
+
+    nll_jit = jax.jit(_nll)
+
+    def collate(batch):
+        """numpy batch → one dense (n, seq) int32 token array (rows
+        truncated/padded to seq; list-block dict rows supported)."""
+        if isinstance(batch, dict) and token_key in batch:
+            col = list(batch[token_key])
+        else:
+            col = [r[token_key] for r in batch.get("value", [])]
+        out = np.full((len(col), seq), pad_id, np.int32)
+        for i, ids in enumerate(col):
+            ids = list(ids)[:seq]
+            out[i, :len(ids)] = ids
+        return {"tokens": out}
+
+    def process(dataset):
+        it = dataset.iterator()
+        nlls = []
+        for batch in it.iter_device_batches(
+                batch_size, prefetch_batches=prefetch_batches,
+                sharding=sharding, collate_fn=collate, pad_value=pad_id):
+            nlls.append(np.asarray(nll_jit(params, batch["tokens"])))
+        feed = it.stats()["device_feed"]
+        n_valid = feed["batches"] * batch_size - feed["tail_padded_rows"]
+        flat = (np.concatenate(nlls)[:n_valid] if nlls
+                else np.zeros((0,), np.float32))
+        from ant_ray_tpu.data.dataset import from_items  # noqa: PLC0415
+
+        return from_items(
+            [{"row": i, output_key: float(v)} for i, v in enumerate(flat)])
+
+    return process

@@ -633,6 +633,9 @@ class ServeController:
     def deploy(self, deployment: Deployment, args, kwargs) -> dict:
         if self._deployments.get(deployment.name) is not None:
             return self._rolling_redeploy(deployment, args, kwargs)
+        return self._fresh_deploy(deployment, args, kwargs)
+
+    def _fresh_deploy(self, deployment: Deployment, args, kwargs) -> dict:
         n = deployment.num_replicas
         if deployment.autoscaling_config is not None:
             n = deployment.autoscaling_config.min_replicas
@@ -666,13 +669,19 @@ class ServeController:
         name = deployment.name
         with self._lock:
             entry = self._deployments.get(name)
-            if entry is None:    # raced a delete: fresh deploy
-                return {"name": name}
-            entry["deployment"] = deployment
-            entry["args"] = args
-            entry["kwargs"] = kwargs
-            entry["route_prefix"] = deployment.route_prefix
-            remaining = collections.deque(entry["replicas"])
+            raced_delete = entry is None
+            if not raced_delete:
+                entry["deployment"] = deployment
+                entry["args"] = args
+                entry["kwargs"] = kwargs
+                entry["route_prefix"] = deployment.route_prefix
+                remaining = collections.deque(entry["replicas"])
+        if raced_delete:
+            # The deployment vanished between deploy()'s existence check
+            # and here: the caller asked for this app to be RUNNING, so
+            # deploy fresh rather than returning success with nothing
+            # deployed.
+            return self._fresh_deploy(deployment, args, kwargs)
         surge = max(1, deployment.rolling_max_surge)
         while remaining:
             doomed = [remaining.popleft()

@@ -60,6 +60,15 @@ class DataConfig:
 
     datasets_to_split: "str | list[str]" = "all"
     equal: bool = True
+    # Defaults forwarded to every shard's configure_device_feed(), so a
+    # worker loop can call get_dataset_shard(name).iter_device_batches()
+    # with no arguments and get prefetched host→HBM delivery.  Keys:
+    # batch_size, prefetch_batches, sharding, collate_fn, pad_value,
+    # drop_last.  ``sharding`` may be a callable ``(rank, world) ->
+    # jax.sharding.Sharding`` — the controller forwards each worker's
+    # rank/world and the callable resolves on the worker's own devices
+    # (device handles never cross processes).
+    device_feed: dict | None = None
 
     def splits(self, name: str) -> bool:
         if self.datasets_to_split == "all":

@@ -272,6 +272,18 @@ class TrainController:
             else:
                 for rank in range(world):
                     shards[rank][name] = ds.iterator()
+            if cfg.device_feed:
+                # Forward per-worker device-feed defaults (incl. rank/
+                # world, so a callable sharding resolves per worker on
+                # its own devices) — the loop then just calls
+                # get_dataset_shard(name).iter_device_batches().
+                for rank in range(world):
+                    # dict-merge (not kwargs) so a user-supplied rank/
+                    # world in device_feed is overridden, not a
+                    # TypeError; the controller's values are the truth.
+                    shards[rank][name].configure_device_feed(
+                        **{**cfg.device_feed,
+                           "rank": rank, "world": world})
         self._data_coords = coords
         return shards
 

@@ -55,18 +55,26 @@ def report(metrics: dict, checkpoint=None) -> None:
             ctx.world_rank, dict(metrics), checkpoint))
 
 
-def get_dataset_shard(name: str = "train"):
+def get_dataset_shard(name: str = "train", device_feed: dict | None = None):
     """This rank's streaming DataIterator for the trainer's
     ``datasets={name: ds}`` (ref: train/_internal/session.py:1134).
     Split datasets are coordinated streaming shards (one pass of the
     plan per epoch, shared across ranks); broadcast datasets return a
-    full-dataset iterator."""
+    full-dataset iterator.
+
+    The shard exposes ``iter_device_batches(...)`` — prefetched,
+    double-buffered host→HBM batch delivery (data/device_feed.py) —
+    preconfigured from ``DataConfig.device_feed`` by the controller.
+    ``device_feed`` here overlays extra defaults from inside the loop
+    (e.g. a sharding built on this worker's mesh)."""
     ctx = get_context()
     shard = ctx.dataset_shards.get(name)
     if shard is None:
         raise KeyError(
             f"no dataset {name!r} was passed to the trainer "
             f"(have: {sorted(ctx.dataset_shards)})")
+    if device_feed:
+        shard.configure_device_feed(**device_feed)
     return shard
 
 

@@ -40,6 +40,9 @@ def main() -> None:
     parser.add_argument("--json-out", default="",
                         help="also write results to this JSON file "
                              "(committed as BENCH_control.json)")
+    parser.add_argument("--note", default="",
+                        help="free-form provenance note recorded in "
+                             "--json-out")
     args = parser.parse_args()
     scale = 0.1 if args.quick else 1.0
 
@@ -52,7 +55,7 @@ def main() -> None:
     results = []
 
     def emit(metric: str, value: float, unit: str):
-        line = {"metric": metric, "value": round(value, 1), "unit": unit}
+        line = {"metric": metric, "value": round(value, 4), "unit": unit}
         results.append(line)
         print(json.dumps(line), flush=True)
 
@@ -138,6 +141,38 @@ def main() -> None:
          (time.perf_counter() - t0) / rounds, "us")
     assert len(ready) == len(refs)
 
+    # ---- device-feed ingest (data/device_feed.py): consumer starve-
+    # fraction with prefetch on vs. off, plus end-to-end batches/s.
+    # The consumer's "step" is a sleep: like a TPU step (which runs on
+    # the device) it releases the GIL, so the producer's block-pull +
+    # collate + transfer-issue overlap it — real jit compute on this
+    # 1-cpu rig would instead contend for the producer's core and hide
+    # the effect being measured.
+    from ant_ray_tpu import data as art_data  # noqa: PLC0415
+
+    feed_rows = max(2560, int(12800 * scale))
+    step_s = 0.004                     # simulated train_step compute
+
+    def feed_run(prefetch: int):
+        it = art_data.range(feed_rows, parallelism=4).iterator()
+        n = 0
+        t0 = time.perf_counter()
+        for _ in it.iter_device_batches(batch_size=256,
+                                        prefetch_batches=prefetch):
+            time.sleep(step_s)
+            n += 1
+        wall = time.perf_counter() - t0
+        return it.stats()["device_feed"], n, wall
+
+    feed_run(2)                        # warmup: plan + device init
+    starve0, _, _ = feed_run(0)
+    starve2, n2, wall2 = feed_run(2)
+    emit("data_device_feed_starve_frac_prefetch0",
+         starve0["consumer_starve_fraction"], "fraction")
+    emit("data_device_feed_starve_frac_prefetch2",
+         starve2["consumer_starve_fraction"], "fraction")
+    emit("data_device_feed_batches_per_s", n2 / wall2, "batches/s")
+
     art.shutdown()
     print(json.dumps({"metric": "microbench_summary",
                       "workloads": len(results),
@@ -152,7 +187,8 @@ def main() -> None:
         with open(args.json_out, "w") as f:
             json.dump({"results": results,
                        "cpu_count": os.cpu_count(),
-                       "platform": platform.platform()}, f, indent=1)
+                       "platform": platform.platform(),
+                       "note": args.note}, f, indent=1)
 
 
 if __name__ == "__main__":

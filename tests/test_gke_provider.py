@@ -209,3 +209,52 @@ def test_slice_launch_registration_gang_release_sequence():
             booter.terminate_node(pid)
         art.shutdown()
         cluster.shutdown()
+
+
+# ---- operation completion semantics (DONE is not success)
+
+
+def test_wait_operation_raises_on_done_with_error():
+    """A resize that completes DONE with an ``error`` body (stockout,
+    quota) must raise, not read as success — the autoscaler would
+    otherwise believe nodes exist that were never created."""
+    def request(method, path, body=None):
+        return {"name": "op-9", "status": "DONE",
+                "error": {"code": 429, "message": "out of TPU capacity"}}
+
+    client = GkeRestNodePoolClient(request, CLUSTER, poll_interval_s=0.01)
+    with pytest.raises(GkeApiError, match="out of TPU capacity") as ei:
+        client._wait_operation({"name": "op-9", "status": "RUNNING"},
+                               time.monotonic() + 5)
+    assert ei.value.status == 429
+
+
+def test_wait_operation_raises_on_done_with_status_message():
+    def request(method, path, body=None):
+        return {"name": "op-9", "status": "DONE",
+                "statusMessage": "node pool went sideways"}
+
+    client = GkeRestNodePoolClient(request, CLUSTER, poll_interval_s=0.01)
+    with pytest.raises(GkeApiError, match="went sideways"):
+        client._wait_operation({"name": "op-9", "status": "RUNNING"},
+                               time.monotonic() + 5)
+
+
+def test_wait_operation_missing_status_is_not_success():
+    """Responses with no ``status`` used to short-circuit as success;
+    they must keep polling until the deadline instead."""
+    def request(method, path, body=None):
+        return {"name": "op-9"}                   # no status field
+
+    client = GkeRestNodePoolClient(request, CLUSTER, poll_interval_s=0.01)
+    with pytest.raises(GkeApiError) as ei:
+        client._wait_operation({"name": "op-9"}, time.monotonic() + 0.3)
+    assert ei.value.status == 504
+
+
+def test_wait_operation_clean_done_still_succeeds():
+    client = GkeRestNodePoolClient(
+        lambda *a, **k: {"name": "op-9", "status": "DONE"},
+        CLUSTER, poll_interval_s=0.01)
+    client._wait_operation({"name": "op-9", "status": "DONE"},
+                           time.monotonic() + 5)  # no raise

@@ -125,3 +125,41 @@ def test_grpc_ingress_unary_and_stream(cluster):
              timeout=30)
     assert err.value.code() == grpc.StatusCode.NOT_FOUND
     channel.close()
+
+
+def test_redeploy_racing_delete_falls_through_to_fresh_deploy(cluster):
+    """deploy() saw the app existing, but it was deleted before
+    _rolling_redeploy took the lock: the roll must fall through to a
+    fresh deploy (the caller asked for the app to be RUNNING), not
+    return success with nothing deployed."""
+    from ant_ray_tpu.serve.api import ServeController
+
+    controller = ServeController()
+    try:
+        dep = serve.deployment(Versioned, name="raced", num_replicas=1)
+        controller.deploy(dep, ("v1",), {})
+        assert "raced" in controller._deployments
+
+        # Simulate the race: the entry vanishes between deploy()'s
+        # existence check and the redeploy's lock acquisition.
+        with controller._lock:
+            controller._deployments.pop("raced")
+
+        out = controller._rolling_redeploy(dep.options(name="raced"),
+                                           ("v2",), {})
+        assert out == {"name": "raced"}
+        entry = controller._deployments.get("raced")
+        assert entry is not None, "raced delete returned without deploying"
+        assert len(entry["replicas"]) == 1
+        # The fresh replicas actually serve the new version.
+        got = art.get(entry["replicas"][0].handle_request.remote(
+            "__call__", ({"x": 7},), {}))
+        assert got == {"version": "v2", "echo": 7}
+    finally:
+        controller._stopping = True
+        for entry in controller._deployments.values():
+            for replica in entry["replicas"]:
+                try:
+                    art.kill(replica)
+                except Exception:  # noqa: BLE001
+                    pass

@@ -141,3 +141,74 @@ def test_shard_layout_metadata(transport_cluster):
     import jax.numpy as jnp
 
     assert shard_layout(jnp.ones((4,))) is None
+
+
+# ---- send-side hardening: per-destination locks, bounded deadline,
+# ---- poison healing on group teardown
+
+
+def test_send_locks_are_per_destination():
+    from ant_ray_tpu.experimental import tensor_transport as tt
+
+    a = tt._send_lock_for("g-locks", 1)
+    b = tt._send_lock_for("g-locks", 2)
+    c = tt._send_lock_for("g-locks", 1)
+    assert a is c and a is not b       # same pair → same lock, only
+    tt.clear_group("g-locks")
+
+
+def test_send_shards_bounded_deadline_poisons_pair(monkeypatch):
+    """A consumer that never posts its recvs must not wedge the holder:
+    the send is abandoned at the deadline and the pair poisoned, while
+    sends to OTHER destinations stay unaffected (per-dest locks)."""
+    import threading
+    import time
+
+    from ant_ray_tpu.experimental import tensor_transport as tt
+    from ant_ray_tpu.util.collective import collective as col
+
+    calls = []
+    started = threading.Event()
+
+    def wedged_send(data, dst, group):
+        calls.append(dst)
+        started.set()
+        time.sleep(30)                 # consumer never recvs
+
+    monkeypatch.setattr(col, "send", wedged_send)
+    arr = _make_sharded()
+
+    t0 = time.monotonic()
+    tt.send_shards(arr, 1, "g-wedge", deadline_s=0.3)
+    elapsed = time.monotonic() - t0
+    assert started.wait(1)
+    assert elapsed < 5                 # returned at the deadline, not 30s
+    assert ("g-wedge", 1) in tt._poisoned_pairs
+
+    # Poisoned pair: further sends to it are skipped outright...
+    n_calls = len(calls)
+    tt.send_shards(arr, 1, "g-wedge", deadline_s=0.3)
+    assert len(calls) == n_calls
+    # ...but a different destination on the same group still sends
+    # (would deadlock behind the old module-global lock).
+    monkeypatch.setattr(col, "send", lambda d, dst, g: calls.append(dst))
+    tt.send_shards(arr, 2, "g-wedge", deadline_s=5.0)
+    assert calls[-1] == 2
+
+    # Group teardown heals the pair for the next incarnation.
+    col.destroy_collective_group("g-wedge")
+    assert ("g-wedge", 1) not in tt._poisoned_pairs
+    assert all(k[0] != "g-wedge" for k in tt._send_locks)
+
+
+def test_destroy_group_clears_transport_state_even_if_uninitialized():
+    from ant_ray_tpu.experimental import tensor_transport as tt
+    from ant_ray_tpu.util.collective import collective as col
+
+    tt._poisoned_pairs.add(("g-ghost", 3))
+    tt._pair_lock("g-ghost", 3)
+    tt._send_lock_for("g-ghost", 3)
+    col.destroy_collective_group("g-ghost")   # group never existed here
+    assert ("g-ghost", 3) not in tt._poisoned_pairs
+    assert all(k[0] != "g-ghost" for k in tt._fetch_locks)
+    assert all(k[0] != "g-ghost" for k in tt._send_locks)
