@@ -160,6 +160,14 @@ class Config:
     # (fail-stop for orphans; GCS FT restarts return well inside it).
     # 0 disables.
     gcs_dead_exit_s: float = 60.0
+    # Remote lease-owner liveness sweep (node_daemon): ping period and
+    # the number of consecutive failed pings before a reclaim is even
+    # considered.  High-latency deployments raise these; the reclaim
+    # additionally corroborates with GCS node liveness — an owner
+    # whose node is still heartbeating is never reclaimed over a
+    # transient partition between daemon and owner.
+    lease_owner_sweep_interval_s: float = 3.0
+    lease_owner_ping_strikes: int = 3
     # Hybrid (DEFAULT) scheduling: pack onto feasible nodes until their
     # utilization passes this, then spread (ref:
     # hybrid_scheduling_policy.h spread_threshold).

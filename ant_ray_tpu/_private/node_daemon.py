@@ -1283,8 +1283,12 @@ class NodeManager:
     def _sweep_lease_owners(self, now: float) -> None:
         """Periodic lessee liveness check for owners NOT on this node
         (drivers, remote workers): a dead owner's lease can't be
-        reclaimed by the local worker-death path above."""
-        if now - getattr(self, "_last_owner_sweep", 0.0) < 3.0 or \
+        reclaimed by the local worker-death path above.  Interval and
+        strike budget come from config
+        (lease_owner_sweep_interval_s / lease_owner_ping_strikes)."""
+        cfg = global_config()
+        if now - getattr(self, "_last_owner_sweep", 0.0) < \
+                cfg.lease_owner_sweep_interval_s or \
                 getattr(self, "_owner_sweep_running", False):
             return
         self._last_owner_sweep = now
@@ -1294,6 +1298,7 @@ class NodeManager:
                   and h.lease_owner not in local}
         if not owners:
             return
+        strikes_needed = max(1, cfg.lease_owner_ping_strikes)
 
         fails: dict = getattr(self, "_owner_ping_fails", None)
         if fails is None:
@@ -1303,6 +1308,7 @@ class NodeManager:
 
         async def _sweep():
             self._owner_sweep_running = True
+            alive_hosts = None      # fetched at most once per sweep
             try:
                 for addr in owners:
                     try:
@@ -1314,18 +1320,59 @@ class NodeManager:
                         # connection, no reply) count — but a LOADED
                         # owner on a saturated host can miss pings for
                         # many seconds, and a false reclaim terminates
-                        # its busy workers; demand three consecutive
-                        # strikes (≥ ~15s unresponsive) before acting.
+                        # its busy workers; demand N consecutive
+                        # strikes before even considering a reclaim.
                         fails[addr] = fails.get(addr, 0) + 1
-                        if fails[addr] >= 3:
-                            fails.pop(addr, None)
-                            self._reclaim_leases_of(addr)
+                        if fails[addr] < strikes_needed:
+                            continue
+                        if fails[addr] < strikes_needed * 3:
+                            if alive_hosts is None:
+                                alive_hosts = \
+                                    await self._gcs_alive_hosts()
+                            if addr.rsplit(":", 1)[0] in alive_hosts:
+                                # The GCS still hears heartbeats from
+                                # the owner's node — likely a partition
+                                # (or a stalled io loop) between THIS
+                                # daemon and the owner, not a death.
+                                # Defer, but only up to 3x the strike
+                                # budget: node liveness says nothing
+                                # about the owner PROCESS, and a dead
+                                # driver on a live node must not pin
+                                # leases forever.
+                                logger.warning(
+                                    "lease owner %s unresponsive for "
+                                    "%d pings but its node is alive "
+                                    "per GCS; deferring reclaim",
+                                    addr, fails[addr])
+                                continue
+                        fails.pop(addr, None)
+                        self._reclaim_leases_of(addr)
                     except Exception:  # noqa: BLE001 — reachable but
                         fails.pop(addr, None)  # erroring owner is alive
             finally:
                 self._owner_sweep_running = False
 
         asyncio.ensure_future(_sweep())
+
+    async def _gcs_alive_hosts(self) -> set:
+        """Host IPs of nodes the GCS currently believes alive — the
+        corroboration set for suspected-dead lease owners (an owner
+        process lives on some node, and that node's daemon heartbeats
+        the GCS independently of our ping path).  One RPC per sweep:
+        during a real partition EVERY remote owner fails pings at
+        once, and per-owner refetches would serialize 5s-timeout calls
+        against an already-struggling GCS.  Empty set when the GCS
+        can't confirm — then we lean toward reclaiming (a dead owner's
+        leases must not pin resources forever; the GCS-down case
+        fail-stops this daemon anyway via gcs_dead_exit_s)."""
+        try:
+            gcs = self._clients.get(self._gcs_address)
+            infos = await gcs.call_async("GetAllNodes", {}, timeout=5)
+        except Exception:  # noqa: BLE001 — GCS unreachable: no veto
+            return set()
+        return {getattr(info, "address", "").rsplit(":", 1)[0]
+                for info in (infos or {}).values()
+                if getattr(info, "alive", False)}
 
     def _reclaim_leases_of(self, owner_address: str) -> None:
         """Reclaim leases whose lessee died (ref: the raylet cancels

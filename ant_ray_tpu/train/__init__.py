@@ -16,6 +16,7 @@ from ant_ray_tpu.train.session import (
     get_world_rank,
     get_world_size,
     report,
+    sync_gradients,
 )
 from ant_ray_tpu.train.trainer import DataParallelTrainer, JaxTrainer, TpuTrainer
 
@@ -38,4 +39,5 @@ __all__ = [
     "load_pytree",
     "report",
     "save_pytree",
+    "sync_gradients",
 ]

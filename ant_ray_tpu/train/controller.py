@@ -78,6 +78,7 @@ class TrainWorker:
             controller=controller,
             latest_checkpoint=latest_checkpoint,
             attempt=attempt,
+            use_tpu=self._use_tpu,
             dataset_shards=dataset_shards or {},
         )
         _set_context(ctx)

@@ -22,6 +22,9 @@ class TrainContext:
     # derive attempt-unique rendezvous names so a restarted gang never
     # collides with its predecessor's collective group.
     attempt: int = 0
+    # Whether this rank binds TPU chips (picks the collective backend
+    # for sync_gradients: xla on TPU gangs, gloo on CPU gangs).
+    use_tpu: bool = False
     # name -> DataIterator for this rank (from the trainer's datasets=).
     dataset_shards: dict = field(default_factory=dict)
     _report_lock: threading.Lock = field(default_factory=threading.Lock)
@@ -76,6 +79,38 @@ def get_dataset_shard(name: str = "train", device_feed: dict | None = None):
     if device_feed:
         shard.configure_device_feed(**device_feed)
     return shard
+
+
+def sync_gradients(grads, op=None, *, group_name: str | None = None,
+                   **fusion_knobs):
+    """Data-parallel gradient sync over the worker gang — fused
+    bucketed allreduce by default (util/collective/fusion.py): the
+    gradient pytree packs into 4 MiB flat buckets, one collective per
+    bucket, bucket k+1's transfer pipelined against bucket k's
+    collective.  Defaults to AVERAGE over ranks.
+
+    The gang's collective group is created lazily on first call
+    (attempt-unique name, so a restarted gang never collides with its
+    predecessor's) — xla backend on TPU gangs, gloo on CPU gangs.
+    ``fusion_knobs`` forward to ``collective.sync_pytree``
+    (``bucket_bytes``, ``transport_dtype``, ``overlap``).  World size
+    1 returns the pytree unchanged."""
+    ctx = get_context()
+    if ctx.world_size <= 1:
+        return grads
+
+    from ant_ray_tpu.util import collective as col  # noqa: PLC0415
+    from ant_ray_tpu.util.collective import ReduceOp  # noqa: PLC0415
+
+    group = group_name or (
+        f"train-sync-{ctx.experiment_name or 'run'}-a{ctx.attempt}")
+    if not col.is_group_initialized(group):
+        col.init_collective_group(
+            ctx.world_size, ctx.world_rank,
+            backend="xla" if ctx.use_tpu else "gloo", group_name=group)
+    return col.sync_pytree(grads, group_name=group,
+                           op=ReduceOp.AVERAGE if op is None else op,
+                           **fusion_knobs)
 
 
 def get_checkpoint():

@@ -151,6 +151,50 @@ def allreduce(tensor, group_name: str = "default",
     return group.allreduce([tensor], types.AllReduceOptions(reduce_op=op))[0]
 
 
+def allreduce_coalesced(tensors, group_name: str = "default",
+                        op: ReduceOp = ReduceOp.SUM, *,
+                        bucket_bytes: int = 4 << 20,
+                        transport_dtype: "str | None" = None,
+                        overlap: bool = True):
+    """Fused bucketed allreduce over a list of tensors
+    (util/collective/fusion.py): leaves pack into dtype-segregated
+    flat buckets of at most ``bucket_bytes``, one collective runs per
+    bucket, and bucket k+1's pack + host→device transfer overlaps
+    bucket k's collective.  ``transport_dtype="bfloat16"`` opts wide
+    float buckets into reduced-precision transport (accumulation stays
+    float32).  Returns the reduced tensors in input order."""
+    group = _group_mgr.get_group(group_name)
+    return group.allreduce_coalesced(
+        list(tensors),
+        types.AllReduceCoalescedOptions(
+            reduce_op=op, bucket_bytes=bucket_bytes,
+            transport_dtype=transport_dtype, overlap=overlap))
+
+
+def sync_pytree(tree, group_name: str = "default",
+                op: ReduceOp = ReduceOp.AVERAGE, *,
+                bucket_bytes: int = 4 << 20,
+                transport_dtype: "str | None" = None,
+                overlap: bool = True):
+    """Allreduce every leaf of a pytree through the fused bucketed
+    path — the data-parallel gradient-sync verb.  Defaults to AVERAGE
+    (gradient semantics); structure is preserved."""
+    from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
+
+    leaves, treedef = fusion.flatten_pytree(tree)
+    reduced = allreduce_coalesced(
+        leaves, group_name=group_name, op=op, bucket_bytes=bucket_bytes,
+        transport_dtype=transport_dtype, overlap=overlap)
+    return fusion.unflatten_pytree(treedef, reduced)
+
+
+def fusion_stats(group_name: str = "default") -> dict:
+    """Cumulative fused-collective stats for a group (pack / transfer /
+    collective seconds, overlap fraction — the device_feed stats
+    idiom)."""
+    return _group_mgr.get_group(group_name).fusion_stats()
+
+
 def allreduce_multidevice(tensor_list, group_name: str = "default",
                           op: ReduceOp = ReduceOp.SUM):
     """One tensor per local device (parity: allreduce_multigpu)."""

@@ -36,6 +36,29 @@ class BaseGroup:
     def allreduce(self, tensors, opts: types.AllReduceOptions):
         raise NotImplementedError
 
+    def allreduce_coalesced(self, tensors,
+                            opts: types.AllReduceCoalescedOptions):
+        """Fused bucketed allreduce over a tensor list.  Backends
+        without a fused path inherit this naive per-tensor loop, so
+        the public API works (slowly) on any group."""
+        out = []
+        for tensor in tensors:
+            out.append(self.allreduce(
+                [tensor],
+                types.AllReduceOptions(reduce_op=opts.reduce_op,
+                                       timeout_ms=opts.timeout_ms))[0])
+        return out
+
+    def fusion_stats(self) -> dict:
+        """Cumulative fused-collective stats (device_feed idiom); the
+        naive fallback has nothing to report."""
+        from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
+
+        stats = getattr(self, "_fusion_stats", None)
+        if stats is None:
+            stats = self._fusion_stats = fusion.FusionStats()
+        return stats.as_dict()
+
     def barrier(self, opts: types.BarrierOptions):
         raise NotImplementedError
 

@@ -63,16 +63,27 @@ class GlooGroup(BaseGroup):
         import torch  # noqa: PLC0415
 
         if isinstance(tensor, torch.Tensor):
-            return tensor, "torch"
+            return tensor, ("torch", None)
         arr = np.asarray(tensor)
-        return torch.from_numpy(arr.copy()), type(tensor).__module__
+        origin = type(tensor).__module__
+        try:
+            return torch.from_numpy(arr.copy()), (origin, None)
+        except TypeError:
+            # Narrow floats the numpy↔torch bridge rejects (ml_dtypes
+            # bfloat16): reduce at float32, restore dtype on the way out.
+            return (torch.from_numpy(arr.astype(np.float32)),
+                    (origin, arr.dtype))
 
     @staticmethod
     def _from_torch(t, origin):
-        if origin == "torch":
+        module, cast = origin if isinstance(origin, tuple) else (origin,
+                                                                 None)
+        if module == "torch":
             return t
         out = t.numpy()
-        if origin.startswith("jax"):
+        if cast is not None:
+            out = out.astype(cast)
+        if module.startswith("jax"):
             import jax.numpy as jnp  # noqa: PLC0415
 
             return jnp.asarray(out)
@@ -85,6 +96,42 @@ class GlooGroup(BaseGroup):
         t, origin = self._to_torch(tensors[0])
         dist.all_reduce(t, op=_REDUCE_MAP[opts.reduce_op])
         return [self._from_torch(t, origin)]
+
+    def allreduce_coalesced(self, tensors,
+                            opts: types.AllReduceCoalescedOptions):
+        """Fused path: one flat torch tensor (and one ``all_reduce``)
+        per dtype-segregated bucket instead of a per-tensor loop — the
+        per-call gloo round trip is paid ~#buckets times, not #tensors
+        times.  A reduced-precision bucket (``transport_dtype``) was
+        quantized once at pack time; the reduction itself runs at
+        float32 (accumulate-in-f32, EQuARX-style)."""
+        import torch  # noqa: PLC0415
+
+        from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
+
+        dist = _dist()
+        if getattr(self, "_fusion_stats", None) is None:
+            self._fusion_stats = fusion.FusionStats()
+
+        def transfer(flat, bucket):
+            if bucket.transport_dtype != bucket.dtype:
+                # The lossy cast already happened in pack_bucket;
+                # upcast so gloo accumulates at full precision.
+                flat = flat.astype(np.float32)
+            try:
+                return torch.from_numpy(flat)   # zero-copy wrap
+            except TypeError:
+                # ml_dtypes bucket (bfloat16 leaves): float32 bridge —
+                # unpack restores the leaf dtype.
+                return torch.from_numpy(flat.astype(np.float32))
+
+        def reduce_bucket(t, bucket):
+            dist.all_reduce(t, op=_REDUCE_MAP[opts.reduce_op])
+            return t.numpy()
+
+        return fusion.run_coalesced(tensors, opts, transfer_fn=transfer,
+                                    collective_fn=reduce_bucket,
+                                    stats=self._fusion_stats)
 
     def barrier(self, opts: types.BarrierOptions):
         _dist().barrier()

@@ -95,6 +95,18 @@ class XLAGroup(BaseGroup):
 
         def op(x):
             # x: this device's block, shape (1, *S)
+            if verb.endswith("_accf32"):
+                # Reduced-precision transport bucket (fusion.py): the
+                # operand arrived in the narrow wire dtype; accumulate
+                # at float32 (EQuARX-style) and return float32 — the
+                # unpack stage restores the leaf dtype.
+                import jax.numpy as jnp  # noqa: PLC0415
+
+                return op_base(verb[:-len("_accf32")],
+                               x.astype(jnp.float32))
+            return op_base(verb, x)
+
+        def op_base(verb, x):
             if verb == "allreduce_sum":
                 return jax.lax.psum(x, axis)
             if verb == "allreduce_min":
@@ -152,8 +164,11 @@ class XLAGroup(BaseGroup):
         by_device = {s.device: s.data for s in out.addressable_shards}
         return [by_device[d] for d in local_order]
 
-    def _run_rank_verb(self, verb: str, tensor, extra=None):
-        """One tensor per member process; returns this rank's out block."""
+    def _stage_rank_verb(self, verb: str, tensor, extra=None):
+        """Transfer stage of a per-rank verb: compile-cache lookup plus
+        async-dispatched host→device ``device_put``.  Split from the
+        execute stage so the fused coalesced path can issue bucket
+        k+1's transfer while bucket k's collective runs."""
         jax = _jax()
         if not self._federated_ok:
             raise RuntimeError(
@@ -169,6 +184,11 @@ class XLAGroup(BaseGroup):
         shard = jax.device_put(t[None], self._rank_devices[self._rank])
         arr = jax.make_array_from_single_device_arrays(
             (self._world_size,) + t.shape, sharding, [shard])
+        return jitted, arr
+
+    def _run_rank_verb(self, verb: str, tensor, extra=None):
+        """One tensor per member process; returns this rank's out block."""
+        jitted, arr = self._stage_rank_verb(verb, tensor, extra)
         return jitted(arr).addressable_shards[0].data
 
     _REDUCE_VERBS = {
@@ -194,6 +214,34 @@ class XLAGroup(BaseGroup):
         block = self._run_rank_verb(self._reduce_verb(opts.reduce_op),
                                     tensors[0])
         return [block[0]]
+
+    def allreduce_coalesced(self, tensors,
+                            opts: types.AllReduceCoalescedOptions):
+        """Fused path: one compiled shard_map collective per *bucket*
+        shape (reusing the ``_compiled`` LRU) instead of one per
+        tensor, with bucket k+1's host→HBM transfer pipelined against
+        bucket k's collective.  Runs the compiled program even at
+        world_size == 1 (psum over a 1-device mesh is identity) so the
+        bucketed compile-cache behavior is identical at any scale."""
+        from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
+
+        if getattr(self, "_fusion_stats", None) is None:
+            self._fusion_stats = fusion.FusionStats()
+        verb = self._reduce_verb(opts.reduce_op)
+
+        def transfer(flat, bucket):
+            wire_verb = verb + ("_accf32"
+                                if bucket.transport_dtype != bucket.dtype
+                                else "")
+            return self._stage_rank_verb(wire_verb, flat)
+
+        def reduce_bucket(staged, bucket):
+            jitted, arr = staged
+            return jitted(arr).addressable_shards[0].data[0]
+
+        return fusion.run_coalesced(tensors, opts, transfer_fn=transfer,
+                                    collective_fn=reduce_bucket,
+                                    stats=self._fusion_stats)
 
     def barrier(self, opts: types.BarrierOptions):
         if self._world_size > 1:

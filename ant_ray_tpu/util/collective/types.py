@@ -45,6 +45,24 @@ class AllReduceOptions:
 
 
 @dataclass
+class AllReduceCoalescedOptions:
+    """Knobs of the fused bucketed allreduce (util/collective/fusion.py).
+
+    ``bucket_bytes`` — flat-buffer budget per collective (a leaf larger
+    than it gets its own oversized bucket).  ``transport_dtype`` —
+    opt-in reduced-precision wire format for wide float buckets
+    (e.g. "bfloat16"; accumulation stays float32, EQuARX-style).
+    ``overlap`` — pipeline bucket k+1's pack+transfer with bucket k's
+    collective (False = sequential naive-order baseline)."""
+
+    reduce_op: ReduceOp = ReduceOp.SUM
+    bucket_bytes: int = 4 << 20
+    transport_dtype: "str | None" = None
+    overlap: bool = True
+    timeout_ms: int = 30_000
+
+
+@dataclass
 class BarrierOptions:
     timeout_ms: int = 30_000
 

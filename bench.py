@@ -128,6 +128,36 @@ def measure(remat: str, batch_scale: float, *, config_key: str | None =
     }
 
 
+def _collective_fusion_ratio() -> float:
+    """Fused/naive coalesced-allreduce throughput ratio on the
+    256 x 16 KiB CPU workload (the collective_allreduce_* microbench
+    metrics), attached to the summary record so accelerator-rig
+    reports carry the collective-stack figure alongside MFU."""
+    from ant_ray_tpu._private.protocol import find_free_port
+    from ant_ray_tpu.util import collective as col
+
+    col.init_collective_group(
+        1, 0, backend="gloo", group_name="bench_fusion",
+        init_method=f"tcp://127.0.0.1:{find_free_port()}")
+    try:
+        grads = [np.ones((4096,), np.float32) for _ in range(256)]
+        for t in grads:                      # warmup both paths
+            col.allreduce(t, group_name="bench_fusion")
+        col.allreduce_coalesced(grads, group_name="bench_fusion")
+        t0 = time.perf_counter()
+        for t in grads:
+            col.allreduce(t, group_name="bench_fusion")
+        naive_s = time.perf_counter() - t0
+        rounds = 3
+        t0 = time.perf_counter()
+        for _ in range(rounds):
+            col.allreduce_coalesced(grads, group_name="bench_fusion")
+        fused_s = (time.perf_counter() - t0) / rounds
+        return naive_s / fused_s if fused_s > 0 else 0.0
+    finally:
+        col.destroy_collective_group("bench_fusion")
+
+
 def run_child() -> None:
     """Run one measurement; falls back through remat policies / batch on
     OOM inside this process (backend is known-alive once the first
@@ -170,6 +200,11 @@ def run_child() -> None:
                 result["llama1b_error"] = repr(e)[:160]
                 if not any(m in repr(e) for m in _PLAN_FAIL_MARKERS):
                     break
+    try:  # best-effort: must never cost the headline MFU number
+        result["collective_fused_naive_ratio"] = round(
+            _collective_fusion_ratio(), 2)
+    except Exception as e:  # noqa: BLE001
+        result["collective_fused_naive_ratio_error"] = repr(e)[:120]
     print(json.dumps(result))
 
 

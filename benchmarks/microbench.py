@@ -173,6 +173,45 @@ def main() -> None:
          starve2["consumer_starve_fraction"], "fraction")
     emit("data_device_feed_batches_per_s", n2 / wall2, "batches/s")
 
+    # ---- fused bucketed allreduce vs the per-tensor loop
+    # (util/collective/fusion.py): 256 x 16 KiB float32 tensors — the
+    # sub-MiB gradient-pytree regime where per-call launch overhead
+    # dominates.  gloo/CPU world_size=1 so the workload runs in the
+    # tier-1 environment; the collective round trip per CALL is what
+    # differs between the two paths.
+    from ant_ray_tpu._private.protocol import find_free_port  # noqa: PLC0415
+    from ant_ray_tpu.util import collective as col  # noqa: PLC0415
+
+    col.init_collective_group(
+        1, 0, backend="gloo", group_name="bench_fusion",
+        init_method=f"tcp://127.0.0.1:{find_free_port()}")
+    grads = [np.ones((4096,), np.float32) for _ in range(256)]
+
+    def naive_rounds(r):
+        for _ in range(r):
+            for t in grads:
+                col.allreduce(t, group_name="bench_fusion")
+
+    def fused_rounds(r):
+        for _ in range(r):
+            col.allreduce_coalesced(grads, group_name="bench_fusion")
+
+    naive_rounds(1)                    # warmup (gloo lazy init)
+    fused_rounds(1)                    # warmup (plan + compile caches)
+    r_naive = max(1, int(3 * scale))
+    t0 = time.perf_counter()
+    naive_rounds(r_naive)
+    naive_per_s = len(grads) * r_naive / (time.perf_counter() - t0)
+    r_fused = max(2, int(10 * scale))
+    t0 = time.perf_counter()
+    fused_rounds(r_fused)
+    fused_per_s = len(grads) * r_fused / (time.perf_counter() - t0)
+    col.destroy_collective_group("bench_fusion")
+    emit("collective_allreduce_naive_per_s", naive_per_s, "tensors/s")
+    emit("collective_allreduce_fused_per_s", fused_per_s, "tensors/s")
+    emit("collective_allreduce_fused_naive_ratio",
+         fused_per_s / naive_per_s if naive_per_s else 0.0, "x")
+
     art.shutdown()
     print(json.dumps({"metric": "microbench_summary",
                       "workloads": len(results),

@@ -16,13 +16,50 @@ from ant_ray_tpu._private.protocol import ClientPool
 from ant_ray_tpu.cluster_utils import Cluster
 
 
-@pytest.fixture()
+@pytest.fixture(scope="module")
 def sync_cluster():
+    # Module-scoped: one boot serves every test here.  The observer
+    # tests only WATCH heartbeat/view traffic (the one task they run
+    # releases its CPU before the test ends); the GCS-restart test
+    # kills and restarts the head on this shared cluster but exits
+    # only after verifying the resource view AND scheduling fully
+    # recovered — so test order does not matter.
     cluster = Cluster(head_node_args={"num_cpus": 2})
     cluster.connect()
     yield cluster
     art.shutdown()
     cluster.shutdown()
+
+
+def test_gcs_restart_commands_resync(sync_cluster):
+    """After a head restart the fresh GCS holds no view versions; the
+    node must be told to resync so scheduling never runs on an empty
+    resource view (the stale-view race)."""
+    cluster = sync_cluster
+    gcs = _gcs_client(cluster)
+    time.sleep(1.0)
+    cluster.kill_gcs()
+    time.sleep(0.5)
+    cluster.restart_gcs()
+    # The node re-registers (full view) or resyncs; either way the
+    # restarted head must converge to the true availability.
+    deadline = time.monotonic() + 20
+    ok = False
+    while time.monotonic() < deadline and not ok:
+        try:
+            totals = gcs.call("AvailableResources", {}, timeout=5)
+            ok = totals.get("CPU", 0.0) >= 2.0
+        except Exception:  # noqa: BLE001 — head still coming up
+            pass
+        time.sleep(0.25)
+    assert ok, "restarted GCS never recovered the resource view"
+
+    # And scheduling on the recovered view works.
+    @art.remote
+    def ping():
+        return "pong"
+
+    assert art.get(ping.remote(), timeout=30) == "pong"
 
 
 def _node_client(cluster):
@@ -86,39 +123,3 @@ def test_resource_change_ships_a_new_view(sync_cluster):
     # Views were sent for the changes, but far fewer than beats — the
     # version gate, not the clock, decides.
     assert 1 <= views < beats
-
-
-def test_gcs_restart_commands_resync():
-    """After a head restart the fresh GCS holds no view versions; the
-    node must be told to resync so scheduling never runs on an empty
-    resource view (the stale-view race)."""
-    cluster = Cluster(head_node_args={"num_cpus": 2})
-    cluster.connect()
-    try:
-        gcs = _gcs_client(cluster)
-        time.sleep(1.0)
-        cluster.kill_gcs()
-        time.sleep(0.5)
-        cluster.restart_gcs()
-        # The node re-registers (full view) or resyncs; either way the
-        # restarted head must converge to the true availability.
-        deadline = time.monotonic() + 20
-        ok = False
-        while time.monotonic() < deadline and not ok:
-            try:
-                totals = gcs.call("AvailableResources", {}, timeout=5)
-                ok = totals.get("CPU", 0.0) >= 2.0
-            except Exception:  # noqa: BLE001 — head still coming up
-                pass
-            time.sleep(0.25)
-        assert ok, "restarted GCS never recovered the resource view"
-
-        # And scheduling on the recovered view works.
-        @art.remote
-        def ping():
-            return "pong"
-
-        assert art.get(ping.remote(), timeout=30) == "pong"
-    finally:
-        art.shutdown()
-        cluster.shutdown()

@@ -27,8 +27,11 @@ def test_strategy_wire_forms():
         strategy_wire("BOGUS")
 
 
-@pytest.fixture()
+@pytest.fixture(scope="module")
 def three_nodes():
+    # Module-scoped: every test here only SCHEDULES onto the cluster
+    # (no node kills, no GCS restarts), so one 3-node boot serves all
+    # of them — per-test boots were ~3.5s of setup apiece.
     cluster = Cluster(head_node_args={"num_cpus": 4})
     cluster.add_node(num_cpus=4)
     cluster.add_node(num_cpus=4)

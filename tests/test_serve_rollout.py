@@ -13,9 +13,20 @@ from ant_ray_tpu import serve
 from ant_ray_tpu.serve.api import _get_or_create_controller
 
 
-@pytest.fixture()
-def cluster(shutdown_only):
+@pytest.fixture(scope="module")
+def rollout_cluster():
+    # One cluster boot for the module — the tests only deploy/redeploy
+    # serve apps, never mutate cluster membership.
     art.init(num_cpus=4)
+    yield None
+    art.shutdown()
+
+
+@pytest.fixture()
+def cluster(rollout_cluster):
+    # Per-test serve teardown: shutdown() kills the detached controller,
+    # replicas and proxies, so each test starts from empty serve state
+    # without paying a fresh cluster boot.
     yield None
     serve.shutdown()
 

@@ -28,3 +28,113 @@ def test_task_burst_spawns_bounded_workers(tmp_path):
             f"burst spawned {len(spawned)} workers on a 2-CPU node"
     finally:
         art.shutdown()
+
+
+def _make_sweeper(owner: str, ping_fails: bool, gcs_nodes):
+    """Minimal NodeManager shell driving _sweep_lease_owners: one
+    LEASED worker owned by ``owner``, a fake client pool whose owner
+    Ping fails (or not) and whose GCS returns ``gcs_nodes``."""
+    import asyncio  # noqa: F401
+
+    from ant_ray_tpu._private import node_daemon as nd
+    from ant_ray_tpu._private.ids import WorkerID
+    from ant_ray_tpu._private.protocol import RpcConnectionError
+
+    mgr = object.__new__(nd.NodeManager)
+    mgr._gcs_address = "gcs:1"
+    handle = nd.WorkerHandle(worker_id=WorkerID.from_random(), proc=None,
+                             address="127.0.0.1:4000", state=nd.LEASED,
+                             lease_owner=owner)
+    mgr._workers = {handle.worker_id: handle}
+    reclaimed = []
+    mgr._reclaim_leases_of = reclaimed.append
+
+    class _Client:
+        def __init__(self, addr):
+            self.addr = addr
+
+        async def call_async(self, method, payload, timeout=None):
+            if self.addr == "gcs:1" and method == "GetAllNodes":
+                return gcs_nodes
+            if ping_fails:
+                raise RpcConnectionError(f"no route to {self.addr}")
+            return "pong"
+
+    class _Pool:
+        def get(self, addr):
+            return _Client(addr)
+
+    mgr._clients = _Pool()
+    return mgr, reclaimed
+
+
+async def _run_sweeps(mgr, rounds: int):
+    import asyncio
+
+    # Monotonic fake clock persisted on the manager so successive
+    # _run_sweeps calls keep advancing past the sweep interval.
+    now = getattr(mgr, "_test_now", 1000.0)
+    for _ in range(rounds):
+        now += 100.0                        # always past the interval
+        mgr._sweep_lease_owners(now)
+        while getattr(mgr, "_owner_sweep_running", False):
+            await asyncio.sleep(0.01)
+    mgr._test_now = now
+
+
+def test_lease_owner_sweep_defers_when_gcs_says_node_alive():
+    """Strike threshold reached, but the owner's node still heartbeats
+    the GCS → the reclaim is deferred (transient partition), and only
+    fires once the extended 3x-strike budget is also exhausted."""
+    import asyncio
+
+    from ant_ray_tpu._private.config import global_config
+    from ant_ray_tpu._private.ids import NodeID
+    from ant_ray_tpu._private.specs import NodeInfo
+
+    owner = "10.9.9.9:7001"
+    alive = {NodeID.from_random(): NodeInfo(
+        node_id=NodeID.from_random(), address="10.9.9.9:6000", alive=True)}
+    mgr, reclaimed = _make_sweeper(owner, ping_fails=True, gcs_nodes=alive)
+    cfg = global_config()
+    old = cfg.lease_owner_ping_strikes
+    cfg.lease_owner_ping_strikes = 2
+    try:
+        asyncio.run(_run_sweeps(mgr, rounds=3))   # strikes 1..3 < 2*3
+        assert reclaimed == [], "reclaimed despite live node in GCS"
+        asyncio.run(_run_sweeps(mgr, rounds=3))   # crosses 3x budget (6)
+        assert reclaimed == [owner], \
+            "extended budget exhausted but lease never reclaimed"
+    finally:
+        cfg.lease_owner_ping_strikes = old
+
+
+def test_lease_owner_sweep_reclaims_when_gcs_confirms_death():
+    """No alive GCS node hosts the owner → reclaim fires right at the
+    configured strike count, not later."""
+    import asyncio
+
+    from ant_ray_tpu._private.config import global_config
+
+    owner = "10.9.9.9:7001"
+    mgr, reclaimed = _make_sweeper(owner, ping_fails=True, gcs_nodes={})
+    cfg = global_config()
+    old = cfg.lease_owner_ping_strikes
+    cfg.lease_owner_ping_strikes = 2
+    try:
+        asyncio.run(_run_sweeps(mgr, rounds=1))
+        assert reclaimed == []                    # one strike: too early
+        asyncio.run(_run_sweeps(mgr, rounds=1))
+        assert reclaimed == [owner]
+    finally:
+        cfg.lease_owner_ping_strikes = old
+
+
+def test_lease_owner_sweep_resets_strikes_on_successful_ping():
+    import asyncio
+
+    owner = "10.9.9.9:7001"
+    mgr, reclaimed = _make_sweeper(owner, ping_fails=False, gcs_nodes={})
+    asyncio.run(_run_sweeps(mgr, rounds=5))
+    assert reclaimed == []
+    assert mgr._owner_ping_fails == {}
