@@ -78,6 +78,11 @@ class Config:
     # dashboard/runtime-env agents).  Builds fall back in-process while
     # the agent is down.
     enable_node_agent: bool = True
+    # Node-agent interval for publishing per-device HBM gauges
+    # (observability/device_stats.py) into the GCS metrics table.
+    # 0 disables the publish loop (stats stay available on demand via
+    # the AgentDeviceStats RPC).
+    device_stats_interval_s: float = 15.0
     # Mirror per-task lifecycle events into the export pipeline (ref:
     # the reference's per-source enable_export_api_write gates).  Off by
     # default: tasks are the one high-volume source and recording each

@@ -9,6 +9,7 @@ aiohttp process colocated with the head node.  Endpoints:
     GET  /api/nodes | /api/actors | /api/placement_groups | /api/objects
     GET  /api/cluster_status | /api/export_events
     GET  /metrics                         (Prometheus text format)
+    POST /api/profile                     {node_id?, duration_s} → XLA trace
     POST /api/jobs                        {entrypoint, runtime_env, ...}
     GET  /api/jobs            /api/jobs/{id}   /api/jobs/{id}/logs
     POST /api/jobs/{id}/stop
@@ -180,13 +181,23 @@ def _prometheus_text(series: list[dict]) -> str:
                 help_text = (str(s["description"])
                              .replace("\\", r"\\").replace("\n", r"\n"))
                 lines.append(f"# HELP {name} {help_text}")
-            ptype = {"counter": "counter", "gauge": "gauge"}.get(
-                s["type"], "untyped")
+            ptype = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "histogram"}.get(s["type"], "untyped")
             lines.append(f"# TYPE {name} {ptype}")
-        tags = ",".join(f'{k}="{_escape_label(v)}"'
-                        for k, v in sorted(s.get("tags", {}).items()))
-        label = f"{{{tags}}}" if tags else ""
+        pairs = [f'{k}="{_escape_label(v)}"'
+                 for k, v in sorted(s.get("tags", {}).items())]
+        label = f"{{{','.join(pairs)}}}" if pairs else ""
         if s["type"] == "histogram":
+            # Cumulative buckets + the mandatory +Inf bucket (== count).
+            cum = 0
+            for le, n in zip(s.get("boundaries", ()),
+                             s.get("buckets", ())):
+                cum += n
+                le_pairs = pairs + [f'le="{format(float(le), "g")}"']
+                lines.append(f"{name}_bucket{{{','.join(le_pairs)}}} {cum}")
+            inf_pairs = pairs + ['le="+Inf"']
+            lines.append(
+                f"{name}_bucket{{{','.join(inf_pairs)}}} {s['count']}")
             lines.append(f"{name}_count{label} {s['count']}")
             lines.append(f"{name}_sum{label} {s['sum']}")
         else:
@@ -312,7 +323,53 @@ def create_app(gcs_address: str, session_dir: str):
 
             events = gcs.call("TaskEventsGet", {"limit": 50000},
                               retries=3) or []
-            return build_chrome_trace(events)
+            steps = gcs.call("StepEventsGet", {"limit": 20000},
+                             retries=3) or []
+            return build_chrome_trace(events, step_events=steps)
+        return web.json_response(await _call(build))
+
+    async def profile(req):
+        """On-demand XLA trace capture: route the request to the target
+        node's agent, which runs ``jax.profiler.trace`` into the
+        session dir and archives it into the log dir (so the existing
+        /api/logs routes list and serve it)."""
+        try:
+            body = await req.json()
+        except Exception:  # noqa: BLE001 — empty body = defaults
+            body = {}
+        node_id = body.get("node_id")
+        try:
+            duration = float(body.get("duration_s", 2.0))
+        except (TypeError, ValueError):
+            return web.json_response({"error": "duration_s must be a "
+                                               "number"}, status=400)
+
+        def build():
+            infos = gcs.call("GetAllNodes", retries=3)
+            last_error = f"no alive node matches {node_id!r}"
+            for info in infos.values():
+                if not info.alive:
+                    continue
+                if node_id and not info.node_id.hex().startswith(node_id):
+                    continue
+                agent = clients.get(info.address).call(
+                    "GetAgentInfo", {}, timeout=5) or {}
+                addr = agent.get("address")
+                if not addr or not agent.get("alive"):
+                    # With no node pinned, keep looking: another node's
+                    # agent may be alive even if this one is down.
+                    last_error = ("node has no live agent (start the "
+                                  "cluster with ART_ENABLE_NODE_AGENT=1)")
+                    if node_id:
+                        return {"error": last_error,
+                                "node_id": info.node_id.hex()}
+                    continue
+                reply = dict(clients.get(addr).call(
+                    "AgentProfile", {"duration_s": duration},
+                    timeout=duration + 90) or {})
+                reply["node_id"] = info.node_id.hex()
+                return reply
+            return {"error": last_error}
         return web.json_response(await _call(build))
 
     async def index(_req):
@@ -420,6 +477,7 @@ def create_app(gcs_address: str, session_dir: str):
     app.router.add_get("/api/logs", node_logs)
     app.router.add_get("/api/logs/{filename}", node_log_read)
     app.router.add_get("/metrics", metrics)
+    app.router.add_post("/api/profile", profile)
     app.router.add_post("/api/jobs", submit_job)
     app.router.add_get("/api/jobs", list_jobs)
     app.router.add_get("/api/jobs/{job_id}", get_job)

@@ -99,6 +99,9 @@ class GcsServer:
         self._task_events: deque = deque(maxlen=50000)
         # bounded ring of flow-insight events (ant-fork, util/insight)
         self._insight_events: deque = deque(maxlen=10000)
+        # bounded ring of per-step profiler records (observability/
+        # step_profiler.py — merged into the timeline as device rows)
+        self._step_events: deque = deque(maxlen=20000)
         self._dirty_locations: set[ObjectID] = set()
         # ---- pubsub (ref: src/ray/pubsub/publisher.h — long-poll
         # channels; here one global sequence + per-event channel tag so a
@@ -166,6 +169,8 @@ class GcsServer:
             "InsightGet": self._insight_get,
             "TaskEventsAdd": self._task_events_add,
             "TaskEventsGet": self._task_events_get,
+            "StepEventsAdd": self._step_events_add,
+            "StepEventsGet": self._step_events_get,
             "SubPoll": self._sub_poll,
             "PublishLogs": self._publish_logs,
             "ExportEventsGet": self._export_events_get,
@@ -636,6 +641,22 @@ class GcsServer:
             events = [e for e in events if e.get("task_id") == task_id]
         return events[-limit:]
 
+    # ------------------------------------------------------ step events
+    # (observability/step_profiler.py: batch-published per-step phase
+    #  records, one bounded ring like task events)
+
+    async def _step_events_add(self, payload):
+        self._step_events.extend(payload.get("records", ()))
+        return True
+
+    async def _step_events_get(self, payload):
+        limit = int((payload or {}).get("limit", 20000))
+        rank = (payload or {}).get("rank")
+        records = list(self._step_events)
+        if rank is not None:
+            records = [r for r in records if r.get("rank") == rank]
+        return records[-limit:]
+
     # -------------------------------------------------------- metrics
     # (ref: src/ray/stats/metric.h registry + the dashboard metrics
     #  agent python/ray/_private/metrics_agent.py — GCS holds the
@@ -659,10 +680,18 @@ class GcsServer:
             entry["value"] += value
         elif mtype == "gauge":
             entry["value"] = value
-        else:  # histogram-ish: running count/sum + last
+        else:  # histogram: running count/sum + per-bucket tallies
+            bounds = payload.get("boundaries")
+            if bounds and "boundaries" not in entry:
+                entry["boundaries"] = [float(b) for b in bounds]
+                entry["buckets"] = [0] * len(entry["boundaries"])
             entry["count"] += 1
             entry["sum"] += value
             entry["value"] = value
+            for i, le in enumerate(entry.get("boundaries", ())):
+                if value <= le:
+                    entry["buckets"][i] += 1
+                    break               # cumulation happens at render
         return True
 
     async def _metrics_get(self, _payload):

@@ -13,7 +13,13 @@ One process covers the three agent roles:
   keeps its own copies of these routes for back-compat; the dashboard
   may talk to either);
 * **node metrics** — OS-level gauges (load, memory, disk) for the
-  head's metrics aggregation.
+  head's metrics aggregation;
+* **device telemetry** — per-device HBM stats
+  (observability/device_stats.py) served on demand and published into
+  the GCS metrics table on an interval, and on-demand XLA trace
+  capture (``AgentProfile`` ← dashboard ``POST /api/profile``):
+  ``jax.profiler.trace`` for the requested duration, archived into the
+  session log dir so the existing log routes list and serve it.
 
 The daemon restarts a dead agent with backoff and falls back to
 in-process builds while the agent is down — agents are an isolation
@@ -22,8 +28,10 @@ upgrade, never a single point of failure.
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import os
+import threading
 import time
 
 from ant_ray_tpu._private.config import global_config
@@ -40,8 +48,12 @@ class NodeAgent:
         self._server = RpcServer(host, port)
         self._clients = ClientPool()
         self.stats = {"env_builds": 0, "env_build_failures": 0,
-                      "log_reads": 0, "started_at": time.time()}
+                      "log_reads": 0, "profiles_captured": 0,
+                      "started_at": time.time()}
         self.address = ""
+        self._profiling = threading.Lock()
+        self._stop_publish = threading.Event()
+        self._publish_thread: threading.Thread | None = None
 
     def start(self) -> str:
         self._server.routes({
@@ -50,12 +62,23 @@ class NodeAgent:
             "AgentReadLog": self._read_log,
             "AgentMetrics": self._metrics,
             "AgentStats": self._get_stats,
+            "AgentDeviceStats": self._device_stats,
+            "AgentProfile": self._profile,
             "Ping": self._ping,
         })
         self.address = self._server.start()
+        interval = global_config().device_stats_interval_s
+        if interval > 0:
+            self._publish_thread = threading.Thread(
+                target=self._publish_device_stats_loop, args=(interval,),
+                daemon=True, name="agent-device-stats")
+            self._publish_thread.start()
         return self.address
 
     def stop(self) -> None:
+        self._stop_publish.set()
+        if self._publish_thread is not None:
+            self._publish_thread.join(timeout=2.0)
         self._server.stop()
         self._clients.close_all()
 
@@ -63,7 +86,14 @@ class NodeAgent:
         return "pong"
 
     async def _get_stats(self, _payload):
-        return dict(self.stats)
+        from ant_ray_tpu.observability import device_stats  # noqa: PLC0415
+
+        out = dict(self.stats)
+        # device_memory_stats may import jax (seconds, once) — keep the
+        # agent's event loop responsive while it does.
+        out["device"] = await asyncio.get_running_loop().run_in_executor(
+            None, device_stats.device_memory_stats)
+        return out
 
     # ---------------------------------------------------- runtime envs
 
@@ -131,6 +161,83 @@ class NodeAgent:
         except OSError:
             pass
         return gauges
+
+    # ------------------------------------------------ device telemetry
+
+    async def _device_stats(self, _payload):
+        """Per-device HBM gauges in the node-metrics wire shape
+        (observability/device_stats.py; CPU backends yield [])."""
+        from ant_ray_tpu.observability import device_stats  # noqa: PLC0415
+
+        return await asyncio.get_running_loop().run_in_executor(
+            None, device_stats.device_stats_gauges)
+
+    def _publish_device_stats_loop(self, interval: float) -> None:
+        """Push HBM gauges into the GCS metrics table on an interval so
+        /metrics carries art_device_hbm_* without a scrape hop.  Waits
+        one full interval before the first publish — the jax import
+        this forces must not slow agent startup."""
+        from ant_ray_tpu.observability import device_stats  # noqa: PLC0415
+
+        while not self._stop_publish.wait(interval):
+            try:
+                gauges = device_stats.device_stats_gauges()
+            except Exception:  # noqa: BLE001 — stay alive, retry later
+                continue
+            gcs = self._clients.get(self._gcs_address)
+            for g in gauges:
+                try:
+                    gcs.call("MetricRecord", g, timeout=5)
+                except Exception:  # noqa: BLE001 — head restarting
+                    break
+
+    async def _profile(self, payload):
+        """On-demand XLA trace capture (dashboard POST /api/profile →
+        daemon GetAgentInfo → here).  Runs ``jax.profiler.trace`` for
+        ``duration_s``, then archives the trace tree into the session
+        log dir — a single .tar.gz the existing ListLogs/ReadLog routes
+        serve.  One capture at a time (the XLA profiler is a process
+        singleton)."""
+        duration = max(0.05, min(
+            float((payload or {}).get("duration_s", 2.0)), 300.0))
+        return await asyncio.get_running_loop().run_in_executor(
+            None, self._capture_trace, duration)
+
+    def _capture_trace(self, duration_s: float) -> dict:
+        if not self._profiling.acquire(blocking=False):
+            return {"error": "a trace capture is already in progress"}
+        try:
+            try:
+                from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
+
+                jax = import_jax()
+            except Exception as e:  # noqa: BLE001 — report, don't crash
+                return {"error": f"jax unavailable: {e}"}
+            import tarfile  # noqa: PLC0415
+
+            from ant_ray_tpu._private import log_serving  # noqa: PLC0415
+
+            stamp = time.strftime("%Y%m%d-%H%M%S")
+            trace_dir = os.path.join(self._session_dir, "profiles",
+                                     f"xla-{stamp}-{os.getpid()}")
+            os.makedirs(trace_dir, exist_ok=True)
+            try:
+                with jax.profiler.trace(trace_dir):
+                    time.sleep(duration_s)
+            except Exception as e:  # noqa: BLE001
+                return {"error":
+                        f"trace capture failed: {type(e).__name__}: {e}"}
+            logs_dir = log_serving.logs_dir(self._session_dir)
+            os.makedirs(logs_dir, exist_ok=True)
+            archive = f"xla-trace-{stamp}-{os.getpid()}.tar.gz"
+            with tarfile.open(os.path.join(logs_dir, archive),
+                              "w:gz") as tar:
+                tar.add(trace_dir, arcname=os.path.basename(trace_dir))
+            self.stats["profiles_captured"] += 1
+            return {"trace_dir": trace_dir, "archive": archive,
+                    "duration_s": duration_s}
+        finally:
+            self._profiling.release()
 
 
 def main():  # pragma: no cover — exercised via subprocess in tests

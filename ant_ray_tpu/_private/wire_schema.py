@@ -91,6 +91,9 @@ METHODS: dict[str, dict] = {
     "TaskEventsAdd": _m("gcs", "{events: [{task_id, name, event, ...}]}",
                         "bool"),
     "TaskEventsGet": _m("gcs", "{limit?, task_id?}", "[event]"),
+    "StepEventsAdd": _m("gcs", "{records: [{step, ts, total_s, phases, "
+                               "mfu?, rank}]}", "bool"),
+    "StepEventsGet": _m("gcs", "{limit?, rank?}", "[record]"),
     "SubPoll": _m("gcs", "{channels, cursor, timeout}",
                   "{cursor, events: [(seq, channel, data)]}"),
     "PublishLogs": _m("gcs", "{node, entries: [{worker, pid, job_id?, "
@@ -168,7 +171,12 @@ METHODS: dict[str, dict] = {
     "AgentReadLog": _m("agent", "{filename, offset?, tail?, max_bytes?}",
                        "{data, next_offset, eof}|{error}"),
     "AgentMetrics": _m("agent", "{}", "{os gauges}"),
-    "AgentStats": _m("agent", "{}", "{env_builds, log_reads, ...}"),
+    "AgentStats": _m("agent", "{}", "{env_builds, log_reads, "
+                              "profiles_captured, device, ...}"),
+    "AgentDeviceStats": _m("agent", "{}",
+                           "[{name, type, value, tags, description}]"),
+    "AgentProfile": _m("agent", "{duration_s?}",
+                       "{trace_dir, archive, duration_s}|{error}"),
     "GetAgentInfo": _m("node", "{}", "{address, alive, restarts}"),
 
     # ---- store service (shared-store HA) ------------------------------

@@ -1,0 +1,86 @@
+"""Per-device memory (HBM) statistics via ``jax.Device.memory_stats()``.
+
+Parity target: the reference's per-node GPU/GRAM gauges from the
+metrics agent (ref: dashboard/modules/reporter) — here TPU-native:
+``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit`` per chip
+from the PJRT allocator, with a graceful degrade everywhere stats do
+not exist (CPU backends return ``None``; a chip locked by another
+process yields an empty list rather than an exception).
+
+Consumers: the node agent serves these on demand (``AgentStats`` /
+``AgentDeviceStats``) and publishes them into the GCS metrics table on
+an interval so ``/metrics`` exposes ``art_device_hbm_*`` gauges; a
+training loop can snapshot them directly for step records.
+"""
+
+from __future__ import annotations
+
+_STAT_KEYS = (
+    ("bytes_in_use", "bytes_in_use"),
+    ("peak_bytes_in_use", "peak_bytes_in_use"),
+    ("bytes_limit", "bytes_limit"),
+    # some PJRT plugins spell the pool ceiling differently
+    ("bytes_limit", "pool_bytes"),
+)
+
+
+def _devices():
+    try:
+        from ant_ray_tpu._private.jax_utils import import_jax  # noqa: PLC0415
+
+        jax = import_jax()
+        return jax.local_devices()
+    except Exception:  # noqa: BLE001 — no jax / no usable backend
+        return []
+
+
+def device_memory_stats(devices=None) -> list[dict]:
+    """One entry per local device.  ``bytes_*`` fields are ints where
+    the backend reports them and ``None`` where it does not (CPU) —
+    the CPU-graceful contract callers rely on."""
+    out = []
+    for i, dev in enumerate(_devices() if devices is None else devices):
+        entry: dict = {
+            "index": i,
+            "device": str(dev),
+            "platform": getattr(dev, "platform", "unknown"),
+            "bytes_in_use": None,
+            "peak_bytes_in_use": None,
+            "bytes_limit": None,
+        }
+        try:
+            stats = dev.memory_stats()
+        except Exception:  # noqa: BLE001 — backend without the API
+            stats = None
+        if stats:
+            for field, key in _STAT_KEYS:
+                if entry[field] is None and stats.get(key) is not None:
+                    entry[field] = int(stats[key])
+        out.append(entry)
+    return out
+
+
+def device_stats_gauges(stats: list[dict] | None = None) -> list[dict]:
+    """Prometheus-shaped gauge series (the node-metrics wire format:
+    name/type/value/tags/description).  Devices without memory stats
+    (CPU) contribute nothing — scrapes stay clean off-TPU."""
+    if stats is None:
+        stats = device_memory_stats()
+    series = []
+    for entry in stats:
+        tags = {"device": str(entry.get("device", entry.get("index"))),
+                "platform": entry.get("platform", "unknown")}
+        for field, name, desc in (
+                ("bytes_in_use", "art_device_hbm_bytes_in_use",
+                 "device memory currently allocated"),
+                ("peak_bytes_in_use", "art_device_hbm_peak_bytes",
+                 "high-water device memory"),
+                ("bytes_limit", "art_device_hbm_bytes_limit",
+                 "device memory capacity")):
+            value = entry.get(field)
+            if value is None:
+                continue
+            series.append({"name": name, "type": "gauge",
+                           "value": float(value), "tags": dict(tags),
+                           "description": desc})
+    return series

@@ -60,8 +60,12 @@ def gpipe_kernel(stage_fn, stage_params, microbatches, *, axis_name: str,
     zeros0 = jnp.zeros_like(microbatches[0])
     if hasattr(lax, "pcast"):          # jax >= the pvary deprecation
         pending0 = lax.pcast(zeros0, axis_name, to="varying")
-    else:
+    elif hasattr(lax, "pvary"):        # the pvary window
         pending0 = lax.pvary(zeros0, axis_name)
+    else:
+        # jax predating varying-axes typing: there is no replicated vs.
+        # varying distinction to annotate — the carry is just a value.
+        pending0 = zeros0
     _, stage_outs = lax.scan(tick, pending0, jnp.arange(ticks))
 
     # Microbatch j leaves the last stage at tick j + axis_size - 1;

@@ -92,6 +92,13 @@ class RunConfig:
         default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = dataclasses.field(
         default_factory=CheckpointConfig)
+    # Aggregate step records (attached by session.report when the loop
+    # runs a StepProfiler) into cluster Prometheus gauges —
+    # art_train_step_time_s / art_train_step_phase_fraction /
+    # art_train_step_skew_ratio, labeled with the run name.  Off: the
+    # controller still collects records (Result-level summaries) but
+    # emits nothing.
+    step_metrics: bool = True
 
     def resolved_storage_path(self) -> str:
         base = self.storage_path or os.path.join(

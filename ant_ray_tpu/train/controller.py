@@ -112,6 +112,10 @@ class TrainController:
             restore=resume, run_token=run_token)
         self._metrics_history: list[dict] = []
         self._latest_metrics: dict = {}
+        # rank -> latest step record dict (observability/step_profiler);
+        # folded into cluster gauges on every report.
+        self._step_records: dict[int, dict] = {}
+        self._step_gauges = None
         # Resume past any on-disk checkpoints (a recreated controller
         # must not reuse their directories).
         self._report_index = self._ckpt_manager.next_index
@@ -120,7 +124,10 @@ class TrainController:
     # ---- called by workers (concurrently with run())
 
     def report_from_worker(self, rank: int, metrics: dict, checkpoint):
+        step_record = metrics.pop("_step_record", None)
         with self._lock:
+            if step_record is not None:
+                self._step_records[rank] = step_record
             if rank == 0:
                 self._latest_metrics = metrics
                 self._metrics_history.append(metrics)
@@ -132,7 +139,107 @@ class TrainController:
                                 self._report_index))
                     self._ckpt_manager.register(checkpoint)
                 self._report_index += 1
+        # Emit once per step, not once per rank-report: N ranks each
+        # re-aggregating N records would make telemetry cost quadratic
+        # in world size.  The lowest rank carrying records is the
+        # designated emitter (rank 0 normally; still works if only a
+        # subset of ranks runs a profiler).
+        if step_record is not None and rank == min(self._step_records):
+            self._emit_step_gauges()
         return True
+
+    # ---- step telemetry (observability/step_profiler.py records)
+
+    def get_step_summary(self) -> dict:
+        """Cross-rank aggregation of each rank's LATEST step record:
+        step-time mean/p50/max, mean per-phase fractions, and the
+        straggler ratio (max/median step time — 1.0 means a perfectly
+        even gang; arXiv:2510.20171's skew telemetry)."""
+        with self._lock:
+            records = dict(self._step_records)
+        if not records:
+            return {"ranks": 0}
+        times = sorted(float(r.get("total_s", 0.0))
+                       for r in records.values())
+        n = len(times)
+        # True median — an even gang (the common case: 2 hosts) must
+        # not read the max as "median" and report skew=1.0 forever.
+        median = (times[(n - 1) // 2] + times[n // 2]) / 2
+        out: dict = {
+            "ranks": n,
+            "step_time_mean_s": sum(times) / n,
+            "step_time_p50_s": median,
+            "step_time_max_s": times[-1],
+            "skew_ratio": (times[-1] / median) if median > 0 else 1.0,
+        }
+        names: set = set()
+        for r in records.values():
+            names.update(r.get("phases") or {})
+        for name in sorted(names):
+            fracs = []
+            for r in records.values():
+                total = float(r.get("total_s", 0.0))
+                sec = float((r.get("phases") or {}).get(name, 0.0))
+                fracs.append(min(1.0, sec / total) if total > 0 else 0.0)
+            out[f"phase_{name}_fraction"] = sum(fracs) / n
+        mfus = [r.get("mfu") for r in records.values()
+                if r.get("mfu") is not None]
+        if mfus:
+            out["mfu_mean"] = sum(mfus) / len(mfus)
+        return out
+
+    def _emit_step_gauges(self) -> None:
+        """Publish the cross-rank aggregation as cluster gauges (best
+        effort, metrics-style — a no-op when emission is disabled or
+        the worker is not connected)."""
+        if not getattr(self._run_config, "step_metrics", True):
+            return
+        summary = self.get_step_summary()
+        if not summary.get("ranks"):
+            return
+        try:
+            from ant_ray_tpu.util.metrics import Gauge  # noqa: PLC0415
+
+            if self._step_gauges is None:
+                run = self._run_config.name or "run"
+                self._step_gauges = {
+                    "time": Gauge(
+                        "art_train_step_time_s",
+                        description="train step time across ranks",
+                        tag_keys=("run", "stat")).set_default_tags(
+                            {"run": run}),
+                    "phase": Gauge(
+                        "art_train_step_phase_fraction",
+                        description="mean fraction of step time per "
+                                    "phase",
+                        tag_keys=("run", "phase")).set_default_tags(
+                            {"run": run}),
+                    "skew": Gauge(
+                        "art_train_step_skew_ratio",
+                        description="straggler gauge: max/median step "
+                                    "time over ranks",
+                        tag_keys=("run",)).set_default_tags(
+                            {"run": run}),
+                    "mfu": Gauge(
+                        "art_train_step_mfu",
+                        description="mean MFU across ranks",
+                        tag_keys=("run",)).set_default_tags(
+                            {"run": run}),
+                }
+            g = self._step_gauges
+            for stat in ("mean", "p50", "max"):
+                g["time"].set(summary[f"step_time_{stat}_s"],
+                              tags={"stat": stat})
+            for key, value in summary.items():
+                if key.startswith("phase_") and key.endswith("_fraction"):
+                    g["phase"].set(
+                        value, tags={"phase": key[len("phase_"):
+                                                  -len("_fraction")]})
+            g["skew"].set(summary["skew_ratio"])
+            if "mfu_mean" in summary:
+                g["mfu"].set(summary["mfu_mean"])
+        except Exception:  # noqa: BLE001 — telemetry is best-effort
+            pass
 
     def get_metrics_history(self):
         with self._lock:

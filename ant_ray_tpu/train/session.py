@@ -27,6 +27,10 @@ class TrainContext:
     use_tpu: bool = False
     # name -> DataIterator for this rank (from the trainer's datasets=).
     dataset_shards: dict = field(default_factory=dict)
+    # The loop's StepProfiler (observability/step_profiler.py) — it
+    # registers itself here on construction, and report() auto-attaches
+    # its latest step record for the controller's cross-rank gauges.
+    step_profiler: Any = None
     _report_lock: threading.Lock = field(default_factory=threading.Lock)
 
 
@@ -49,13 +53,26 @@ def get_context() -> TrainContext:
 def report(metrics: dict, checkpoint=None) -> None:
     """Report metrics (and optionally a checkpoint) to the controller
     (ref: ray.train.report).  Blocks until the controller acknowledged, so
-    checkpoint ordering is deterministic."""
+    checkpoint ordering is deterministic.
+
+    When the loop runs a :class:`~ant_ray_tpu.observability.StepProfiler`,
+    the latest step record rides along (``_step_record``) — the
+    controller folds every rank's records into step-time and
+    rank-skew gauges, and the profiler's publish buffer is flushed so
+    the timeline's device rows stay current."""
     import ant_ray_tpu as art  # noqa: PLC0415
 
     ctx = get_context()
+    metrics = dict(metrics)
+    prof = ctx.step_profiler
+    if prof is not None and "_step_record" not in metrics:
+        last = prof.last
+        if last is not None:
+            metrics["_step_record"] = last.as_dict()
+        prof.flush()
     with ctx._report_lock:
         art.get(ctx.controller.report_from_worker.remote(
-            ctx.world_rank, dict(metrics), checkpoint))
+            ctx.world_rank, metrics, checkpoint))
 
 
 def get_dataset_shard(name: str = "train", device_feed: dict | None = None):
@@ -108,6 +125,13 @@ def sync_gradients(grads, op=None, *, group_name: str | None = None,
         col.init_collective_group(
             ctx.world_size, ctx.world_rank,
             backend="xla" if ctx.use_tpu else "gloo", group_name=group)
+        if ctx.step_profiler is not None:
+            # The gang's fusion stats become the profiler's collective/
+            # h2d phases — one attach per group lifetime (deltas).
+            try:
+                ctx.step_profiler.attach_fusion_stats(group)
+            except Exception:  # noqa: BLE001 — telemetry is best-effort
+                pass
     return col.sync_pytree(grads, group_name=group,
                            op=ReduceOp.AVERAGE if op is None else op,
                            **fusion_knobs)

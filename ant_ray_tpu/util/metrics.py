@@ -31,13 +31,17 @@ class _Metric:
         self._default_tags = dict(tags)
         return self
 
-    def _emit(self, value: float, tags: dict | None):
+    def _emit(self, value: float, tags: dict | None,
+              extra: dict | None = None):
         merged = dict(self._default_tags)
         if tags:
             merged.update(tags)
-        _record({"name": self._name, "type": self._type,
-                 "value": float(value), "tags": merged,
-                 "description": self._description})
+        payload = {"name": self._name, "type": self._type,
+                   "value": float(value), "tags": merged,
+                   "description": self._description}
+        if extra:
+            payload.update(extra)
+        _record(payload)
 
 
 class Counter(_Metric):
@@ -62,7 +66,9 @@ class Histogram(_Metric):
     def __init__(self, name: str, description: str = "",
                  boundaries: list | None = None, tag_keys: tuple = ()):
         super().__init__(name, description, tag_keys)
-        self._boundaries = list(boundaries or [])
+        self._boundaries = sorted(float(b) for b in (boundaries or []))
 
     def observe(self, value: float, tags: dict | None = None):
-        self._emit(value, tags)
+        # Boundaries ride along so the GCS can tally per-bucket counts
+        # and /metrics can render real _bucket{le=...} lines.
+        self._emit(value, tags, extra={"boundaries": self._boundaries})

@@ -6,11 +6,22 @@ python/ray/_private/state.py chrome_tracing_dump over GCS task events).
 (tid) — plus flow arrows ("s"/"f") from submission to execution, so
 chrome://tracing / Perfetto renders the cluster's task schedule with
 cross-process causality.
+
+Step-profiler records (observability/step_profiler.py) merge in as
+per-rank "device" rows: one ``train-step/rank-N`` track per rank, each
+step a slice subdivided into its phases (data_wait → h2d → compute →
+collective, canonical order, measured durations) — so Perfetto shows
+compute vs. transfer vs. collective right next to the task schedule.
 """
 
 from __future__ import annotations
 
 import json
+
+# Canonical within-step phase order for the rendered sub-slices (phase
+# seconds are attributions, not a measured schedule — see
+# observability/step_profiler.py).
+_STEP_PHASE_ORDER = ("data_wait", "h2d", "compute", "collective")
 
 
 def fetch_task_events(limit: int = 50000) -> list[dict]:
@@ -24,7 +35,62 @@ def fetch_task_events(limit: int = 50000) -> list[dict]:
                              retries=3) or []
 
 
-def build_chrome_trace(events: list[dict]) -> list[dict]:
+def fetch_step_events(limit: int = 20000) -> list[dict]:
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    runtime = global_worker.runtime
+    try:
+        return runtime._gcs.call("StepEventsGet", {"limit": limit},
+                                 retries=3) or []
+    except Exception:  # noqa: BLE001 — pre-upgrade GCS without the table
+        return []
+
+
+def build_step_rows(step_events: list[dict]) -> list[dict]:
+    """Per-rank device rows from published step records: one "X" slice
+    per step ("step N", args carry phase seconds + MFU) and nested "X"
+    sub-slices per phase in canonical order."""
+    trace: list[dict] = []
+    pid = "train-step"
+    for rec in step_events:
+        total = float(rec.get("total_s", 0.0))
+        ts0_us = float(rec.get("ts", 0.0)) * 1e6
+        if total <= 0:
+            continue
+        tid = f"rank-{int(rec.get('rank', 0))}"
+        phases = {k: float(v)
+                  for k, v in (rec.get("phases") or {}).items()}
+        args = {f"{name}_s": round(sec, 6)
+                for name, sec in sorted(phases.items())}
+        if rec.get("mfu") is not None:
+            args["mfu"] = rec["mfu"]
+        trace.append({
+            "ph": "X", "cat": "train_step",
+            "name": f"step {int(rec.get('step', 0))}",
+            "pid": pid, "tid": tid, "ts": ts0_us, "dur": total * 1e6,
+            "args": args,
+        })
+        cursor = ts0_us
+        end_us = ts0_us + total * 1e6
+        ordered = [p for p in _STEP_PHASE_ORDER if p in phases]
+        ordered += sorted(p for p in phases if p not in _STEP_PHASE_ORDER)
+        for name in ordered:
+            # Clamp into the parent slice: attributions can over-count
+            # (an attached stream overlapping an explicit phase block)
+            # and Perfetto rejects children escaping their parent.
+            dur_us = min(phases[name] * 1e6, end_us - cursor)
+            if dur_us <= 0:
+                continue
+            trace.append({
+                "ph": "X", "cat": "step_phase", "name": name,
+                "pid": pid, "tid": tid, "ts": cursor, "dur": dur_us,
+            })
+            cursor += dur_us
+    return trace
+
+
+def build_chrome_trace(events: list[dict],
+                       step_events: list[dict] | None = None) -> list[dict]:
     by_task: dict[str, dict] = {}
     for event in events:
         record = by_task.setdefault(event["task_id"], {"events": {}})
@@ -68,14 +134,19 @@ def build_chrome_trace(events: list[dict]) -> list[dict]:
                 "ph": "f", "cat": "submit", "id": flow_id,
                 "name": "submit", "bp": "e",
                 "pid": pid, "tid": tid, "ts": ts_us})
+    if step_events:
+        trace.extend(build_step_rows(step_events))
     return trace
 
 
 def timeline(filename: str | None = None) -> list[dict] | str:
-    """Chrome trace of the cluster's task schedule.  With ``filename``
-    writes the JSON and returns the path (load in chrome://tracing or
-    https://ui.perfetto.dev); without, returns the event list."""
-    trace = build_chrome_trace(fetch_task_events())
+    """Chrome trace of the cluster's task schedule — plus, when a step
+    profiler published records, per-rank step-phase device rows.  With
+    ``filename`` writes the JSON and returns the path (load in
+    chrome://tracing or https://ui.perfetto.dev); without, returns the
+    event list."""
+    trace = build_chrome_trace(fetch_task_events(),
+                               step_events=fetch_step_events())
     if filename is None:
         return trace
     with open(filename, "w") as f:

@@ -104,6 +104,10 @@ def task_spans(events: list[dict] | None = None) -> list[Span]:
         if submitted is not None:
             attributes["art.queue_time_s"] = round(
                 started["ts"] - submitted["ts"], 6)
+        if "failed" in ev:
+            # OTel semantic convention: failed spans carry error=true on
+            # top of the ERROR status code the exporters set.
+            attributes["error"] = True
         spans.append(Span(
             trace_id=_trace_id(root_of(task_id)),
             span_id=_span_id(task_id),
@@ -153,7 +157,10 @@ def export_otlp_json(filename: str | None = None,
                 "endTimeUnixNano": str(s.end_ns),
                 "attributes": [_otlp_attr(k, v)
                                for k, v in s.attributes.items()],
-                "status": {"code": 1 if s.ok else 2},
+                # STATUS_CODE_OK / STATUS_CODE_ERROR; per the OTLP spec
+                # a message only accompanies ERROR.
+                "status": ({"code": 2, "message": "task failed"}
+                           if not s.ok else {"code": 1}),
             } for s in spans],
         }],
     }]}

@@ -158,6 +158,31 @@ def _collective_fusion_ratio() -> float:
         col.destroy_collective_group("bench_fusion")
 
 
+_PROFILER_BUDGET_NS = 2000.0   # 2 µs/step — observability stays free
+
+
+def _step_profiler_overhead_ns(n_steps: int = 20000) -> float:
+    """Instrumented-vs-bare loop cost of the step profiler's hot path
+    (observability/step_profiler.py); median of 3 rounds to shrug off
+    scheduler noise on shared rigs."""
+    from ant_ray_tpu.observability import StepProfiler
+
+    def one_round() -> float:
+        prof = StepProfiler(publish=False)
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            pass
+        bare = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            with prof.step():
+                pass
+        return (time.perf_counter() - t0 - bare) / n_steps * 1e9
+
+    one_round()                                    # warmup
+    return sorted(one_round() for _ in range(3))[1]
+
+
 def run_child() -> None:
     """Run one measurement; falls back through remat policies / batch on
     OOM inside this process (backend is known-alive once the first
@@ -205,6 +230,18 @@ def run_child() -> None:
             _collective_fusion_ratio(), 2)
     except Exception as e:  # noqa: BLE001
         result["collective_fused_naive_ratio_error"] = repr(e)[:120]
+    try:
+        overhead = round(_step_profiler_overhead_ns(), 1)
+        result["step_profiler_overhead_ns"] = overhead
+        if overhead > _PROFILER_BUDGET_NS:
+            # Observability must stay free: a profiler that taxes the
+            # step path fails the record outright (the budget is the
+            # contract train loops instrument against).
+            result["bench_error"] = (
+                f"step_profiler_overhead_ns={overhead} exceeds "
+                f"{_PROFILER_BUDGET_NS}ns budget")
+    except Exception as e:  # noqa: BLE001
+        result["step_profiler_overhead_error"] = repr(e)[:120]
     print(json.dumps(result))
 
 

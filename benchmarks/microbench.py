@@ -212,6 +212,35 @@ def main() -> None:
     emit("collective_allreduce_fused_naive_ratio",
          fused_per_s / naive_per_s if naive_per_s else 0.0, "x")
 
+    # ---- step-profiler overhead (observability/step_profiler.py):
+    # instrumented vs. bare loop.  The headline metric is the step-path
+    # instrumentation cost (publishing disabled) — budgeted at < 2 µs
+    # per step (bench.py fails its summary record past that).  The
+    # _publish variant includes the batched GCS publication a connected
+    # training loop pays (amortized flush every publish_batch steps —
+    # off the 2 µs budget because it is not on the step's timed path
+    # in any real loop, where a step is ≥ milliseconds).
+    from ant_ray_tpu.observability import StepProfiler  # noqa: PLC0415
+
+    n_steps = max(2000, int(20000 * scale))
+
+    def profiler_overhead_ns(prof):
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            pass
+        bare = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            with prof.step():
+                pass
+        return (time.perf_counter() - t0 - bare) / n_steps * 1e9
+
+    profiler_overhead_ns(StepProfiler(publish=False))     # warmup
+    emit("step_profiler_overhead_ns",
+         profiler_overhead_ns(StepProfiler(publish=False)), "ns")
+    emit("step_profiler_overhead_publish_ns",
+         profiler_overhead_ns(StepProfiler()), "ns")
+
     art.shutdown()
     print(json.dumps({"metric": "microbench_summary",
                       "workloads": len(results),

@@ -1,0 +1,497 @@
+"""Step-level telemetry plane (observability/): StepProfiler phase
+math + MFU, device HBM stats (CPU-graceful), on-demand XLA trace
+capture through the node agent and dashboard, controller skew-gauge
+aggregation, and the timeline's step-phase device rows."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu.observability import (
+    StepProfiler,
+    device_memory_stats,
+    device_stats_gauges,
+)
+from ant_ray_tpu.observability.step_profiler import StepRecord
+
+
+# ---------------------------------------------------------------------------
+# StepProfiler — no cluster needed (and MUST work with none: telemetry
+# is best-effort, like util/metrics._record)
+# ---------------------------------------------------------------------------
+
+
+def test_phase_math_explicit_blocks():
+    prof = StepProfiler(publish=False)
+    with prof.step():
+        with prof.phase("data_wait"):
+            time.sleep(0.02)
+        time.sleep(0.03)                     # un-attributed → compute
+    rec = prof.last
+    assert rec.step == 0
+    assert 0.015 <= rec.phases["data_wait"] <= 0.2
+    # compute is the remainder: total - attributed
+    assert rec.phases["compute"] == pytest.approx(
+        rec.total_s - rec.phases["data_wait"], abs=1e-9)
+    assert 0 < rec.fraction("data_wait") < 1
+    assert rec.fraction("data_wait") + rec.fraction("compute") == \
+        pytest.approx(1.0, abs=1e-6)
+
+
+def test_phase_blocks_accumulate_within_step():
+    prof = StepProfiler(publish=False)
+    with prof.step():
+        for _ in range(3):
+            with prof.phase("h2d"):
+                time.sleep(0.005)
+    rec = prof.last
+    assert rec.phases["h2d"] >= 0.012        # 3 blocks summed
+
+
+def test_explicit_compute_phase_is_not_overwritten():
+    prof = StepProfiler(publish=False)
+    with prof.step():
+        with prof.phase("compute"):
+            time.sleep(0.01)
+        time.sleep(0.01)                     # stays un-attributed
+    rec = prof.last
+    # explicitly timed compute wins over the derived remainder
+    assert rec.phases["compute"] < rec.total_s * 0.8
+
+
+def test_mfu_against_explicit_peak():
+    prof = StepProfiler(flops_per_step=1e9, peak_flops=1e12,
+                        publish=False)
+    with prof.step():
+        time.sleep(0.01)
+    rec = prof.last
+    assert rec.mfu == pytest.approx(1e9 / (rec.total_s * 1e12), rel=1e-9)
+    assert "mfu_mean" in prof.summary()
+
+
+def test_mfu_absent_off_tpu_without_peak():
+    """No TPU generation detected and no explicit peak → MFU is None,
+    never a junk number against a defaulted peak."""
+    prof = StepProfiler(flops_per_step=1e9, publish=False)
+    with prof.step():
+        time.sleep(0.001)
+    assert prof.last.mfu is None
+
+
+def test_attached_device_feed_stats_become_phases():
+    """The PR-2 stats idiom (device_feed stage seconds) is absorbed as
+    per-step deltas: starve → data_wait, transfer-issue → h2d."""
+    feed = {"consumer_starve_s": 0.0, "transfer_issue_s": 0.0}
+    prof = StepProfiler(publish=False)
+    prof.attach_data_iterator(feed)
+    feed["consumer_starve_s"] += 0.25
+    feed["transfer_issue_s"] += 0.5
+    with prof.step():
+        time.sleep(0.001)
+    rec = prof.last
+    assert rec.phases["data_wait"] == pytest.approx(0.25)
+    assert rec.phases["h2d"] == pytest.approx(0.5)
+    # second step sees only NEW seconds (deltas, not cumulative)
+    feed["consumer_starve_s"] += 0.1
+    with prof.step():
+        time.sleep(0.001)
+    assert prof.last.phases["data_wait"] == pytest.approx(0.1)
+    assert "h2d" not in prof.last.phases
+
+
+def test_attached_fusion_stats_become_phases():
+    """The PR-3 stats idiom (collective.fusion_stats) is absorbed:
+    pack/unpack/collective → collective, transfer → h2d."""
+    live = {"pack_s": 0.0, "transfer_s": 0.0, "collective_s": 0.0,
+            "unpack_s": 0.0}
+    prof = StepProfiler(publish=False)
+    prof._fusion_fns.append({"fn": lambda: live, "snap": dict(live)})
+    live["pack_s"] += 0.1
+    live["collective_s"] += 0.2
+    live["transfer_s"] += 0.05
+    with prof.step():
+        time.sleep(0.001)
+    rec = prof.last
+    assert rec.phases["collective"] == pytest.approx(0.3)
+    assert rec.phases["h2d"] == pytest.approx(0.05)
+
+
+def test_no_cluster_is_cheap_noop():
+    """Without a cluster the profiler records locally and publishing
+    drops silently — construction, steps, flush and close all work
+    disconnected (metrics-style best-effort)."""
+    prof = StepProfiler(publish_batch=2)     # publish path exercised
+    for _ in range(7):
+        with prof.step():
+            pass
+    prof.flush()
+    prof.close()
+    assert len(prof.records) == 7
+    assert prof.summary()["steps"] == 7
+    assert prof._pending == []               # dropped, not leaked
+
+
+def test_summary_and_history_window():
+    prof = StepProfiler(publish=False, history=4)
+    for _ in range(6):
+        with prof.step():
+            time.sleep(0.001)
+    s = prof.summary()
+    assert s["steps"] == 6                   # lifetime step count
+    assert s["window"] == 4                  # bounded retention
+    assert s["step_time_max_s"] >= s["step_time_p50_s"] > 0
+    assert s["phase_compute_fraction"] == pytest.approx(1.0, abs=0.01)
+
+
+def test_step_record_dict_roundtrip():
+    rec = StepRecord(step=3, start_ts=123.0, total_s=0.5,
+                     phases={"compute": 0.4, "h2d": 0.1},
+                     mfu=0.37, rank=2)
+    back = StepRecord.from_dict(rec.as_dict())
+    assert back == rec
+
+
+# ---------------------------------------------------------------------------
+# device_stats — CPU-graceful contract
+# ---------------------------------------------------------------------------
+
+
+def test_device_memory_stats_cpu_graceful():
+    stats = device_memory_stats()
+    assert isinstance(stats, list) and stats  # CPU backend has devices
+    for entry in stats:
+        assert entry["platform"] == "cpu"
+        # the graceful contract: fields exist, values are None on CPU
+        for field in ("bytes_in_use", "peak_bytes_in_use",
+                      "bytes_limit"):
+            assert field in entry and entry[field] is None
+
+
+def test_device_stats_gauges_skip_none_and_shape_series():
+    # CPU devices (no memory_stats) contribute nothing
+    assert device_stats_gauges() == []
+    # synthetic TPU-shaped stats produce the node-metrics wire shape
+    series = device_stats_gauges([{
+        "index": 0, "device": "TPU_0", "platform": "tpu",
+        "bytes_in_use": 100, "peak_bytes_in_use": 200,
+        "bytes_limit": 1000,
+    }])
+    by_name = {s["name"]: s for s in series}
+    assert by_name["art_device_hbm_bytes_in_use"]["value"] == 100.0
+    assert by_name["art_device_hbm_peak_bytes"]["value"] == 200.0
+    assert by_name["art_device_hbm_bytes_limit"]["value"] == 1000.0
+    for s in series:
+        assert s["type"] == "gauge"
+        assert s["tags"] == {"device": "TPU_0", "platform": "tpu"}
+
+
+# ---------------------------------------------------------------------------
+# controller aggregation — skew gauge math, no cluster
+# ---------------------------------------------------------------------------
+
+
+def _record_dict(rank, total_s, phases=None, mfu=None):
+    return {"step": 1, "ts": 0.0, "total_s": total_s,
+            "phases": phases or {"compute": total_s}, "mfu": mfu,
+            "rank": rank}
+
+
+def test_controller_skew_aggregation(tmp_path):
+    from ant_ray_tpu.train.config import RunConfig, ScalingConfig
+    from ant_ray_tpu.train.controller import TrainController
+
+    controller = TrainController(
+        loop_fn=lambda: None, loop_config=None,
+        scaling=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="skew", storage_path=str(tmp_path)))
+    assert controller.get_step_summary() == {"ranks": 0}
+    controller.report_from_worker(
+        0, {"loss": 1.0, "_step_record": _record_dict(
+            0, 0.1, {"compute": 0.08, "data_wait": 0.02}, mfu=0.4)},
+        None)
+    controller.report_from_worker(
+        1, {"loss": 1.0, "_step_record": _record_dict(
+            1, 0.3, {"compute": 0.3})}, None)
+    s = controller.get_step_summary()
+    assert s["ranks"] == 2
+    assert s["step_time_max_s"] == pytest.approx(0.3)
+    assert s["step_time_mean_s"] == pytest.approx(0.2)
+    # straggler gauge: max / median (median of [0.1, 0.3] = 0.2)
+    assert s["skew_ratio"] == pytest.approx(0.3 / 0.2)
+    assert s["phase_data_wait_fraction"] == pytest.approx(0.1)  # mean
+    assert s["mfu_mean"] == pytest.approx(0.4)
+    # the step record is telemetry, not a user metric
+    assert "_step_record" not in controller._latest_metrics
+
+
+def test_controller_keeps_latest_record_per_rank(tmp_path):
+    from ant_ray_tpu.train.config import RunConfig, ScalingConfig
+    from ant_ray_tpu.train.controller import TrainController
+
+    controller = TrainController(
+        loop_fn=lambda: None, loop_config=None,
+        scaling=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="latest", storage_path=str(tmp_path)))
+    controller.report_from_worker(
+        0, {"_step_record": _record_dict(0, 0.5)}, None)
+    controller.report_from_worker(
+        0, {"_step_record": _record_dict(0, 0.1)}, None)
+    assert controller.get_step_summary()["step_time_max_s"] == \
+        pytest.approx(0.1)
+
+
+# ---------------------------------------------------------------------------
+# timeline merge — per-rank device rows
+# ---------------------------------------------------------------------------
+
+
+def test_build_step_rows_per_rank_device_rows():
+    from ant_ray_tpu.util.timeline import build_chrome_trace
+
+    steps = [
+        _record_dict(0, 0.1, {"data_wait": 0.02, "compute": 0.07,
+                              "collective": 0.01}),
+        _record_dict(1, 0.2, {"compute": 0.2}),
+    ]
+    steps[0]["ts"] = steps[1]["ts"] = 1000.0
+    trace = build_chrome_trace([], step_events=steps)
+    step_slices = [t for t in trace if t["cat"] == "train_step"]
+    assert {t["tid"] for t in step_slices} == {"rank-0", "rank-1"}
+    r0 = next(t for t in step_slices if t["tid"] == "rank-0")
+    assert r0["ph"] == "X" and r0["dur"] == pytest.approx(0.1 * 1e6)
+    assert r0["args"]["data_wait_s"] == pytest.approx(0.02)
+    # phase sub-slices: canonical order, contiguous, inside the parent
+    phases = [t for t in trace
+              if t["cat"] == "step_phase" and t["tid"] == "rank-0"]
+    assert [p["name"] for p in phases] == ["data_wait", "compute",
+                                           "collective"]
+    assert phases[0]["ts"] == pytest.approx(r0["ts"])
+    for prev, cur in zip(phases, phases[1:]):
+        assert cur["ts"] == pytest.approx(prev["ts"] + prev["dur"])
+    end = phases[-1]["ts"] + phases[-1]["dur"]
+    assert end <= r0["ts"] + r0["dur"] + 1e-6
+    json.dumps(trace)                        # Perfetto-loadable JSON
+
+
+def test_build_step_rows_clamps_overattribution():
+    from ant_ray_tpu.util.timeline import build_step_rows
+
+    # attributions exceed the step total (stream overlap): sub-slices
+    # must stay inside the parent slice
+    rows = build_step_rows([_record_dict(
+        0, 0.1, {"data_wait": 0.08, "h2d": 0.08, "compute": 0.08})])
+    parent = next(t for t in rows if t["cat"] == "train_step")
+    for t in rows:
+        if t["cat"] == "step_phase":
+            assert t["ts"] + t["dur"] <= \
+                parent["ts"] + parent["dur"] + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# tracing — failed-task spans carry OTel ERROR status
+# ---------------------------------------------------------------------------
+
+
+def _task_events(task_id, ok=True):
+    base = {"task_id": task_id, "name": f"task_{task_id}",
+            "node_id": "n1", "pid": 7}
+    events = [dict(base, event="submitted", ts=1.0),
+              dict(base, event="started", ts=2.0)]
+    events.append(dict(base, event="finished" if ok else "failed",
+                       ts=3.0))
+    return events
+
+
+def test_failed_task_span_status_error():
+    from ant_ray_tpu.util import tracing
+
+    events = _task_events("aaa1", ok=True) + _task_events("bbb2",
+                                                          ok=False)
+    spans = tracing.task_spans(events)
+    by_ok = {s.ok: s for s in spans}
+    assert by_ok[False].attributes.get("error") is True
+    assert "error" not in by_ok[True].attributes
+
+    payload = tracing.export_otlp_json(spans=spans)
+    otlp = payload["resourceSpans"][0]["scopeSpans"][0]["spans"]
+    statuses = {s["name"]: s["status"] for s in otlp}
+    assert statuses["task_aaa1"] == {"code": 1}
+    assert statuses["task_bbb2"]["code"] == 2       # STATUS_CODE_ERROR
+    assert statuses["task_bbb2"]["message"]
+
+
+# ---------------------------------------------------------------------------
+# node agent in isolation — XLA trace capture + device stats RPC
+# (the "stub agent" round trip: a real NodeAgent over a dummy GCS
+# address, no cluster)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def lone_agent(tmp_path, monkeypatch):
+    monkeypatch.setenv("ART_DEVICE_STATS_INTERVAL_S", "0")
+    from ant_ray_tpu._private import config as config_mod
+    from ant_ray_tpu._private.node_agent import NodeAgent
+
+    config_mod._global_config = None
+    agent = NodeAgent(str(tmp_path), gcs_address="127.0.0.1:1")
+    agent.start()
+    yield agent
+    agent.stop()
+    config_mod._global_config = None
+
+
+def test_agent_profile_capture_and_log_serving(lone_agent):
+    from ant_ray_tpu._private import log_serving
+    from ant_ray_tpu._private.protocol import ClientPool
+
+    client = ClientPool().get(lone_agent.address)
+    reply = client.call("AgentProfile", {"duration_s": 0.1}, timeout=120)
+    assert "error" not in reply, reply
+    assert reply["archive"].endswith(".tar.gz")
+    assert os.path.isdir(reply["trace_dir"])
+    # the archive is served by the EXISTING log routes
+    files = [f["filename"]
+             for f in log_serving.list_logs(str(lone_agent._session_dir))]
+    assert reply["archive"] in files
+    read = client.call("AgentReadLog",
+                       {"filename": reply["archive"]}, timeout=30)
+    assert "error" not in read and len(read["data"]) > 0
+    stats = client.call("AgentStats", {}, timeout=30)
+    assert stats["profiles_captured"] == 1
+
+
+def test_agent_device_stats_rpc(lone_agent):
+    from ant_ray_tpu._private.protocol import ClientPool
+
+    client = ClientPool().get(lone_agent.address)
+    assert client.call("AgentDeviceStats", {}, timeout=60) == []  # CPU
+    stats = client.call("AgentStats", {}, timeout=60)
+    assert isinstance(stats["device"], list)
+    assert stats["device"][0]["platform"] == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# cluster end-to-end: train gauges in /metrics, timeline device rows,
+# dashboard /api/profile round trip
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def obs_cluster():
+    os.environ["ART_ENABLE_NODE_AGENT"] = "1"
+    from ant_ray_tpu._private import config as config_mod
+
+    config_mod._global_config = None
+    ctx = art.init(num_cpus=4,
+                   _system_config={"include_dashboard": True})
+    assert ctx.dashboard_url, "dashboard did not start"
+    yield ctx.dashboard_url
+    art.shutdown()
+    os.environ["ART_ENABLE_NODE_AGENT"] = "0"
+    config_mod._global_config = None
+
+
+def _train_with_profiler(world: int, storage: str):
+    from ant_ray_tpu import train
+    from ant_ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        import time as _t
+
+        from ant_ray_tpu import train as _train
+        from ant_ray_tpu.observability import StepProfiler as _SP
+
+        ctx = _train.get_context()
+        prof = _SP(flops_per_step=1e9, peak_flops=1e12,
+                   publish_batch=2)
+        for step in range(4):
+            with prof.step():
+                with prof.phase("data_wait"):
+                    _t.sleep(0.002)
+                _t.sleep(0.005 + 0.02 * ctx.world_rank)  # rank skew
+            _train.report({"step": step})
+        prof.close()
+
+    trainer = JaxTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=world),
+        run_config=RunConfig(name="obs-e2e", storage_path=storage))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    return train
+
+
+def test_train_step_gauges_reach_prometheus(obs_cluster,
+                                            tmp_path_factory):
+    _train_with_profiler(2, str(tmp_path_factory.mktemp("obs")))
+    with urllib.request.urlopen(obs_cluster + "/metrics",
+                                timeout=10) as resp:
+        text = resp.read().decode()
+    for stat in ("mean", "p50", "max"):
+        assert f'art_train_step_time_s{{run="obs-e2e",stat="{stat}"}}' \
+            in text, text
+    assert 'art_train_step_phase_fraction{phase="data_wait",' \
+           'run="obs-e2e"}' in text
+    skew_line = next(l for l in text.splitlines()
+                     if l.startswith('art_train_step_skew_ratio'))
+    assert float(skew_line.split()[-1]) >= 1.0
+    mfu_line = next(l for l in text.splitlines()
+                    if l.startswith('art_train_step_mfu'))
+    assert 0 < float(mfu_line.split()[-1]) < 1
+
+
+def test_timeline_has_step_phase_device_rows(obs_cluster):
+    """The acceptance shape: timeline() output contains per-rank
+    step-phase slices Perfetto can load (the training run above
+    published them)."""
+    trace = art.timeline()
+    step_slices = [t for t in trace if t.get("cat") == "train_step"]
+    assert {t["tid"] for t in step_slices} >= {"rank-0", "rank-1"}
+    phase_names = {t["name"] for t in trace
+                   if t.get("cat") == "step_phase"}
+    assert {"data_wait", "compute"} <= phase_names
+    json.dumps(trace)
+
+
+def test_profiler_attaches_real_data_iterator(obs_cluster):
+    """Regression: DataIterator.stats() returns a fresh COPY each call
+    (and {} before iteration starts) — the profiler must re-read it
+    every step, not freeze one snapshot at attach time."""
+    from ant_ray_tpu import data as art_data
+
+    it = art_data.range(512, parallelism=2).iterator()
+    prof = StepProfiler(publish=False)
+    prof.attach_data_iterator(it)            # before iteration: stats={}
+    for _ in it.iter_device_batches(batch_size=128, prefetch_batches=0):
+        with prof.step():
+            time.sleep(0.001)
+    assert sum(r.phases.get("data_wait", 0.0)
+               for r in prof.step_records()) > 0
+
+
+def test_api_profile_roundtrip(obs_cluster):
+    req = urllib.request.Request(
+        obs_cluster + "/api/profile",
+        data=json.dumps({"duration_s": 0.2}).encode(),
+        headers={"Content-Type": "application/json"})
+    deadline = time.monotonic() + 60
+    while True:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            reply = json.loads(resp.read().decode())
+        if "error" not in reply:
+            break
+        # the agent process may still be booting right after init
+        assert "agent" in reply["error"], reply
+        assert time.monotonic() < deadline, reply
+        time.sleep(0.5)
+    assert reply["archive"].endswith(".tar.gz")
+    assert reply["node_id"]
+    logs = json.loads(urllib.request.urlopen(
+        obs_cluster + "/api/logs", timeout=10).read().decode())
+    names = [f["filename"] for node in logs for f in node["files"]]
+    assert reply["archive"] in names

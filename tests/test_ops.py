@@ -68,6 +68,30 @@ def test_prometheus_metrics_endpoint(dashboard_cluster):
     assert "art_cluster_resource_total" in text
 
 
+def test_prometheus_histogram_buckets(dashboard_cluster):
+    """Histogram boundaries travel end-to-end: observe() → GCS bucket
+    tallies → cumulative _bucket{le=...} lines incl. +Inf, under
+    # TYPE histogram."""
+    from ant_ray_tpu.util.metrics import Histogram
+
+    lat = Histogram("op_latency_s", description="op latency",
+                    boundaries=[0.01, 0.1, 1.0], tag_keys=("op",))
+    for v in (0.005, 0.05, 0.5, 5.0, 0.06):
+        lat.observe(v, tags={"op": "read"})
+    time.sleep(0.3)  # oneway records drain
+
+    with urllib.request.urlopen(dashboard_cluster + "/metrics",
+                                timeout=10) as resp:
+        text = resp.read().decode()
+    assert "# TYPE op_latency_s histogram" in text
+    assert 'op_latency_s_bucket{op="read",le="0.01"} 1' in text
+    assert 'op_latency_s_bucket{op="read",le="0.1"} 3' in text     # cum
+    assert 'op_latency_s_bucket{op="read",le="1"} 4' in text
+    assert 'op_latency_s_bucket{op="read",le="+Inf"} 5' in text
+    assert 'op_latency_s_count{op="read"} 5' in text
+    assert 'op_latency_s_sum{op="read"}' in text
+
+
 def test_job_submission_end_to_end(dashboard_cluster, tmp_path):
     script = tmp_path / "driver.py"
     script.write_text(
