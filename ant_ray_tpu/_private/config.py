@@ -48,8 +48,22 @@ class Config:
     transfer_chunk_cache_bytes: int = 64 * 1024 * 1024
     # Cap on a node's in-flight inbound transfer bytes; pulls beyond it
     # queue (ref: pull_manager.h:50 quota).  0 = unlimited.  A single
-    # object larger than the quota still pulls (alone).
+    # object larger than the quota still pulls (alone).  A striped pull
+    # accounts its whole object size ONCE, not per stripe.
     pull_quota_bytes: int = 256 * 1024 * 1024
+    # ReadChunk requests kept in flight per holder during a pull, so
+    # transfer bandwidth is bounded by the wire, not chunk_size/RTT
+    # (ref: PushManager's in-flight chunk window, push_manager.h:28).
+    # 1 degenerates to the stop-and-wait protocol.
+    object_pull_window: int = 8
+    # Objects at least this large with >=2 registered holders pull
+    # STRIPED: the chunk range is partitioned across holders and pulled
+    # concurrently into the same grant (broadcast fan-in at k x NIC).
+    # 0 disables striping.
+    object_stripe_min_bytes: int = 16 * 1024 * 1024
+    # Testing only: holder-side delay per served transfer chunk, so
+    # tests can deterministically kill a holder mid-transfer.
+    testing_chunk_serve_delay_s: float = 0.0
     # An unsealed arena grant younger than this is presumed live (its
     # producer is still writing); only older grants are reclaimed.
     unsealed_grant_ttl_s: float = 30.0

@@ -42,6 +42,7 @@ from ant_ray_tpu._private.protocol import (
     ClientPool,
     IoThread,
     RpcConnectionError,
+    RpcError,
     RpcServer,
 )
 from ant_ray_tpu._private.specs import (
@@ -194,6 +195,8 @@ class ClusterRuntime(CoreRuntime):
             "Ping": self._handle_ping,
             "GetObject": self._handle_get_object,
             "GetObjectStatus": self._handle_get_object_status,
+            "GetObjectStatusBatch": self._handle_get_object_status_batch,
+            "WaitObjects": self._handle_wait_objects,
             "GetObjectInfo": self._handle_get_object_info,
             "BorrowAdd": self._handle_borrow_add,
             "BorrowRemove": self._handle_borrow_remove,
@@ -615,10 +618,46 @@ class ClusterRuntime(CoreRuntime):
         return (kind, value)
 
     async def _handle_get_object_status(self, payload):
-        entry = self.memory.get_entry(payload["object_id"])
+        return self._status_of(payload["object_id"])
+
+    def _status_of(self, oid: ObjectID) -> str:
+        entry = self.memory.get_entry(oid)
         if entry is None:
             return "unknown"
         return "ready" if entry[0] != "pending" else "pending"
+
+    async def _handle_get_object_status_batch(self, payload):
+        """One status round trip for a whole batch of refs — waiting on
+        N borrowed refs of one owner costs one RPC per round, not N."""
+        return {oid: self._status_of(oid)
+                for oid in payload["object_ids"]}
+
+    async def _handle_wait_objects(self, payload):
+        """Push-based wait: park the reply until ``num_ready`` of the
+        listed refs are terminal (ready/error/unknown) or the deadline
+        fires, then reply with every ref's status.  The park rides the
+        memory store's any-change subscription — no per-ref futures, so
+        a 1k-ref wait costs one parked reply and O(refs) dict lookups
+        per terminal event."""
+        oids = payload["object_ids"]
+        num_ready = max(1, int(payload.get("num_ready", 1)))
+        # Server-side park is bounded: clients re-issue long-polls, so a
+        # forgotten wait can never wedge a reply slot for minutes.
+        timeout = min(float(payload.get("timeout", 10.0)), 60.0)
+        deadline = time.monotonic() + max(0.0, timeout)
+        while True:
+            # Register the wakeup BEFORE snapshotting: a put landing
+            # from another thread in between then resolves the already-
+            # registered future instead of being missed for a full park.
+            change = self.memory.change_future()
+            statuses = {oid: self._status_of(oid) for oid in oids}
+            n_terminal = sum(1 for s in statuses.values()
+                             if s != "pending")
+            remaining = deadline - time.monotonic()
+            if n_terminal >= min(num_ready, len(oids)) or remaining <= 0:
+                self.memory.discard_change_future(change)
+                return statuses
+            await self.memory.wait_change(remaining, change)
 
     async def _handle_get_object_info(self, payload):
         """Status + payload size in one round trip — the Data engine's
@@ -831,71 +870,153 @@ class ClusterRuntime(CoreRuntime):
     def wait(self, refs, num_returns, timeout, fetch_local):
         """Block until `num_returns` refs are terminal or `timeout`
         elapses (ref: CoreWorker::Wait — a real blocking wait, not a
-        status poll; timeout=0 degrades to a poll).  Owned refs wait on
-        the in-process memory store; borrowed refs poll the owner with
-        backoff."""
-        async def _status_once(ref: ObjectRef) -> bool:
+        status poll; timeout=0 degrades to a poll).
+
+        Owned refs resolve with synchronous memory-store lookups first
+        (an all-ready wait over 1k refs costs zero tasks and zero
+        RPCs); only still-pending owned refs park on the store.
+        Borrowed refs are grouped BY OWNER: one pump per owner drives a
+        ``WaitObjects`` long-poll (the owner parks the reply until a
+        listed ref turns terminal), falling back to batched
+        ``GetObjectStatusBatch`` polling against peers that predate the
+        push path — O(owners) RPCs in flight, never O(refs x polls)."""
+        # Sync fast path: classify every ref without touching the loop.
+        ready_idx: set[int] = set()
+        owned_pending: list[tuple[int, ObjectID]] = []
+        by_owner: dict[str, list[tuple[int, ObjectID]]] = {}
+        for i, ref in enumerate(refs):
             if self.memory.is_owned(ref.id):
                 entry = self.memory.get_entry(ref.id)
-                return entry is not None and entry[0] != "pending"
-            owner = self._clients.get(ref.owner_address)
-            try:
-                status = await owner.call_async(
-                    "GetObjectStatus", {"object_id": ref.id}, timeout=5)
-            except Exception:  # noqa: BLE001 — owner gone: ready(err)
-                return True
-            return status != "pending"
+                if entry is not None and entry[0] != "pending":
+                    ready_idx.add(i)
+                else:
+                    owned_pending.append((i, ref.id))
+            else:
+                by_owner.setdefault(ref.owner_address, []).append(
+                    (i, ref.id))
+        if len(ready_idx) >= num_returns:
+            ready = [r for i, r in enumerate(refs) if i in ready_idx]
+            not_ready = [r for i, r in enumerate(refs)
+                         if i not in ready_idx]
+            return ready, not_ready
 
-        async def _one_ready(ref: ObjectRef):
-            if self.memory.is_owned(ref.id):
-                await self.memory.wait_async(ref.id)
-                return
-            owner = self._clients.get(ref.owner_address)
-            delay = 0.005
-            while True:
+        async def _status_round():
+            # Poll semantics (timeout<=0): one batched status round per
+            # owner (the RPCs still complete — timeout=0 bounds
+            # *waiting*, not the status check itself).
+            async def one_owner(owner_addr, items):
+                owner = self._clients.get(owner_addr)
                 try:
-                    status = await owner.call_async(
-                        "GetObjectStatus", {"object_id": ref.id}, timeout=5)
+                    statuses = await owner.call_async(
+                        "GetObjectStatusBatch",
+                        {"object_ids": [oid for _i, oid in items]},
+                        timeout=5)
                 except Exception:  # noqa: BLE001 — owner gone: ready(err)
+                    for i, _oid in items:
+                        ready_idx.add(i)
                     return
-                if status != "pending":
-                    return
-                await asyncio.sleep(delay)
-                delay = min(delay * 2, 0.1)
+                for i, oid in items:
+                    if statuses.get(oid, "unknown") != "pending":
+                        ready_idx.add(i)
+
+            await asyncio.gather(*[one_owner(a, items)
+                                   for a, items in by_owner.items()])
 
         async def _gather():
             if timeout is not None and timeout <= 0:
-                # Poll semantics: one status round for every ref (a
-                # borrowed ref's owner RPC still completes — timeout=0
-                # bounds *waiting*, not the status check itself).
-                statuses = await asyncio.gather(
-                    *[_status_once(r) for r in refs])
-                return {i for i, s in enumerate(statuses) if s}
-            futs = {asyncio.ensure_future(_one_ready(r)): i
-                    for i, r in enumerate(refs)}
-            pending = set(futs)
-            ready_idx: set[int] = set()
+                await _status_round()
+                return
+            progress = asyncio.Event()
+
+            def mark(i: int):
+                ready_idx.add(i)
+                progress.set()
+
+            tasks = [asyncio.ensure_future(
+                self._wait_owned(oid, i, mark))
+                for i, oid in owned_pending]
+            tasks += [asyncio.ensure_future(
+                self._wait_owner_pump(owner_addr, items, mark))
+                for owner_addr, items in by_owner.items()]
             deadline = (None if timeout is None
                         else self._io.loop.time() + timeout)
-            while pending and len(ready_idx) < num_returns:
-                remaining = (None if deadline is None
-                             else max(0.0, deadline - self._io.loop.time()))
-                done, pending = await asyncio.wait(
-                    pending, timeout=remaining,
-                    return_when=asyncio.FIRST_COMPLETED)
-                for fut in done:
-                    ready_idx.add(futs[fut])
-                if not done and remaining is not None:
-                    break  # timed out
-            for fut in pending:
-                fut.cancel()
-            return ready_idx
+            try:
+                while len(ready_idx) < num_returns and \
+                        not all(t.done() for t in tasks):
+                    remaining = (None if deadline is None else
+                                 deadline - self._io.loop.time())
+                    if remaining is not None and remaining <= 0:
+                        return
+                    progress.clear()
+                    try:
+                        await asyncio.wait_for(progress.wait(), remaining)
+                    except asyncio.TimeoutError:
+                        return
+            finally:
+                for t in tasks:
+                    t.cancel()
 
         with self._blocked():
-            ready_idx = self._io.run_coro(_gather())
-        ready = [r for i, r in enumerate(refs) if i in ready_idx]
-        not_ready = [r for i, r in enumerate(refs) if i not in ready_idx]
+            self._io.run_coro(_gather())
+        # Snapshot once: cancelled pumps may still mark() on the io
+        # thread; reading the live set twice could drop a ref from
+        # BOTH lists (lost forever by wait-loop callers).
+        done_idx = set(ready_idx)
+        ready = [r for i, r in enumerate(refs) if i in done_idx]
+        not_ready = [r for i, r in enumerate(refs) if i not in done_idx]
         return ready, not_ready
+
+    async def _wait_owned(self, oid: ObjectID, index: int, mark):
+        await self.memory.wait_async(oid)
+        mark(index)
+
+    async def _wait_owner_pump(self, owner_addr: str, items, mark):
+        """Drive one owner's borrowed refs to terminal: WaitObjects
+        long-polls while the owner supports them (server-side park, no
+        client sleeps), batched status polling with backoff otherwise.
+        An unreachable owner marks everything terminal — the follow-up
+        get() surfaces the real error, same as the old per-ref path."""
+        owner = self._clients.get(owner_addr)
+        # oid -> ALL indices waiting on it (the same borrowed ref may
+        # appear several times in one wait call).
+        pending: dict = {}
+        for i, oid in items:
+            pending.setdefault(oid, []).append(i)
+        use_push = True
+        delay = 0.005
+        while pending:
+            oids = list(pending)
+            try:
+                if use_push:
+                    try:
+                        statuses = await owner.call_async(
+                            "WaitObjects",
+                            {"object_ids": oids, "num_ready": 1,
+                             "timeout": 10.0}, timeout=20)
+                    except RpcError as e:
+                        if "no route" not in str(e):
+                            raise
+                        # Owner predates the push path: poll fallback.
+                        use_push = False
+                        continue
+                else:
+                    statuses = await owner.call_async(
+                        "GetObjectStatusBatch", {"object_ids": oids},
+                        timeout=5)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 — owner gone: ready(err)
+                for indices in pending.values():
+                    for i in indices:
+                        mark(i)
+                return
+            for oid, status in statuses.items():
+                if status != "pending" and oid in pending:
+                    for i in pending.pop(oid):
+                        mark(i)
+            if not use_push and pending:
+                await asyncio.sleep(delay)
+                delay = min(delay * 2, 0.1)
 
     def _blocked(self):
         """Tell the node daemon this worker is blocked so its cpu can be

@@ -24,6 +24,10 @@ class MemoryStore:
         self._loop = loop
         self._entries: dict[ObjectID, tuple] = {}
         self._async_waiters: dict[ObjectID, list[asyncio.Future]] = {}
+        # Any-change subscription (io-loop side): WaitObjects long-polls
+        # park here and are woken by EVERY terminal put, so one parked
+        # reply covers a whole batch of refs without per-ref futures.
+        self._change_waiters: list[asyncio.Future] = []
         # REENTRANT: any allocation inside the critical sections can
         # trigger GC, which may run ObjectRef.__del__ -> _refcount_event
         # -> is_owned() on the SAME thread — a plain Lock self-deadlocks
@@ -41,8 +45,12 @@ class MemoryStore:
         with self._lock:
             self._entries[object_id] = (kind, value)
             waiters = self._async_waiters.pop(object_id, [])
+            change_waiters, self._change_waiters = \
+                self._change_waiters, []
         for fut in waiters:
             self._loop.call_soon_threadsafe(self._resolve, fut, (kind, value))
+        for fut in change_waiters:
+            self._loop.call_soon_threadsafe(self._resolve, fut, True)
 
     @staticmethod
     def _resolve(fut: asyncio.Future, entry: tuple) -> None:
@@ -74,9 +82,54 @@ class MemoryStore:
             if entry is not None and entry[0] != "pending":
                 return entry
             self._async_waiters.setdefault(object_id, []).append(fut)
-        if timeout is None:
-            return await fut
-        return await asyncio.wait_for(fut, timeout)
+        try:
+            if timeout is None:
+                return await fut
+            return await asyncio.wait_for(fut, timeout)
+        except (asyncio.TimeoutError, asyncio.CancelledError):
+            # Abandoned waiter (timed-out wait_for / cancelled wait()
+            # task): remove it NOW — a long-pending object polled in a
+            # loop would otherwise accumulate one dead future per call
+            # until its eventual put().
+            with self._lock:
+                waiters = self._async_waiters.get(object_id)
+                if waiters is not None:
+                    try:
+                        waiters.remove(fut)
+                    except ValueError:
+                        pass
+                    if not waiters:
+                        del self._async_waiters[object_id]
+            raise
+
+    def change_future(self) -> asyncio.Future:
+        """Register a future resolved on the NEXT terminal put.  Long
+        pollers register BEFORE snapshotting entries, so a cross-thread
+        put between snapshot and park can never be missed."""
+        fut = self._loop.create_future()
+        with self._lock:
+            self._change_waiters.append(fut)
+        return fut
+
+    def discard_change_future(self, fut: asyncio.Future) -> None:
+        with self._lock:
+            try:
+                self._change_waiters.remove(fut)
+            except ValueError:
+                pass
+
+    async def wait_change(self, timeout: float,
+                          fut: asyncio.Future | None = None) -> bool:
+        """Park until ANY object turns terminal (or timeout); returns
+        whether a change fired.  Must run on the io loop."""
+        if fut is None:
+            fut = self.change_future()
+        try:
+            await asyncio.wait_for(fut, timeout)
+            return True
+        except asyncio.TimeoutError:
+            self.discard_change_future(fut)
+            return False
 
     def delete(self, object_id: ObjectID) -> None:
         with self._lock:

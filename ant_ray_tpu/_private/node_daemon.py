@@ -11,6 +11,7 @@ to the GCS.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import logging
 import os
 import random
@@ -19,7 +20,7 @@ import subprocess
 import sys
 import time
 import uuid
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from dataclasses import dataclass, field
 
 from ant_ray_tpu._private.config import global_config
@@ -28,7 +29,9 @@ from ant_ray_tpu._private.object_store import ObjectStore, default_store_capacit
 from ant_ray_tpu._private.protocol import (
     ClientPool,
     IoThread,
+    RawReply,
     RpcConnectionError,
+    RpcError,
     RpcServer,
     RpcTimeoutError,
 )
@@ -64,7 +67,24 @@ def _enable_subreaper() -> bool:
 class _HolderMiss(RuntimeError):
     """A GCS-listed holder no longer has the object (stale location)."""
 
+
+class _NoViableHolder(RuntimeError):
+    """Every GCS-listed holder missed the size probe (stale locations)
+    or was unreachable — the pull round found nothing to pull from.
+    ``any_unreachable`` distinguishes "all copies verifiably gone"
+    (every miss retracted) from "holders exist but can't be reached
+    right now" — only the former may feed the no-holders fail-fast that
+    triggers lineage reconstruction."""
+
+    def __init__(self, what: str, any_unreachable: bool = False):
+        super().__init__(what)
+        self.any_unreachable = any_unreachable
+
 IDLE, LEASED, ACTOR, STARTING = "idle", "leased", "actor", "starting"
+
+# Pin tokens for raw-RPC chunk serving (distinct namespace from the
+# daemon's integer pin-lease tokens and the bulk channel's tokens).
+_raw_serve_tokens = itertools.count()
 
 
 @dataclass
@@ -158,13 +178,23 @@ class NodeManager:
         # and pays ONE store read per chunk per broadcast, not N).
         self._chunk_cache: "OrderedDict[tuple, bytes]" = OrderedDict()
         self._chunk_cache_bytes = 0
+        # Guards the chunk cache: served from the io loop (RPC chunk
+        # reads) AND from bulk-transfer handler threads.
+        import threading as _threading  # noqa: PLC0415
+
+        self._chunk_cache_lock = _threading.Lock()
         # Pull admission quota: bytes of in-flight inbound transfers
         # (ref: pull_manager.h:50 num_bytes_being_pulled quota) — callers
         # queue instead of pulling a dataset larger than memory at once.
         self._pull_bytes_inflight = 0
         self._pull_quota_cv: asyncio.Condition = asyncio.Condition()
         self.transfer_stats = {"chunk_reads": 0, "chunk_cache_hits": 0,
-                               "quota_waits": 0}
+                               "quota_waits": 0, "stripe_cache_hits": 0,
+                               "stripe_pulls": 0, "stripe_failovers": 0,
+                               "holder_failures": 0, "pull_bytes": 0}
+        # Holder-side log of served transfer-chunk requests (bounded),
+        # for stripe tests/debugging: (object_hex, offset, length).
+        self._chunk_read_log: deque = deque(maxlen=8192)
         # terminated-but-unreaped workers (retired for env mismatch)
         self._retired_procs: list[subprocess.Popen] = []
         # job_id -> (allowed_here, expires_at): virtual-cluster fencing
@@ -207,6 +237,17 @@ class NodeManager:
             "ReadLog": self._read_log,
             "Shutdown": self._shutdown_rpc,
         })
+        # Sync fast route: the raw reply is written inline (no task
+        # boundary), so an arena view can be served zero-copy — nothing
+        # can evict/recycle the range before the transport consumes it.
+        self._server.fast_route("ReadChunkRaw", self._read_chunk_raw)
+        # Bulk data channel (transfer.py): holders advertise its port
+        # via LocateObject probes; pullers that see one drain chunks
+        # over blocking sockets instead of the control-plane RPC loop.
+        from ant_ray_tpu._private.transfer import BulkServer  # noqa: PLC0415
+
+        self._bulk = BulkServer(self, host=self._server._host)
+        self._bulk_port = self._bulk.start()
         self.address = self._server.start()
         fut = asyncio.run_coroutine_threadsafe(self._register(), self._io.loop)
         fut.result(timeout=30)
@@ -460,6 +501,14 @@ class NodeManager:
         for key, value in self._available.items():
             series.append(("art_node_resource_available",
                            value, "available resource", {"resource": key}))
+        # Transfer-plane counters (windowed/striped pull scheduler +
+        # holder-side chunk cache) as gauges for the head aggregation.
+        for key, value in self.transfer_stats.items():
+            series.append((f"art_node_transfer_{key}", value,
+                           "object transfer-plane counter"))
+        series.append(("art_node_transfer_chunk_cache_bytes",
+                       self._chunk_cache_bytes,
+                       "holder-side transfer chunk cache bytes"))
         return [
             {"name": name, "type": "gauge", "value": float(value),
              "description": desc,
@@ -542,6 +591,9 @@ class NodeManager:
         # the parent's kill-grace window is short — tmpfs cleanup must
         # never lose the race.
         self.store.destroy()
+        bulk = getattr(self, "_bulk", None)
+        if bulk is not None:
+            bulk.stop()
         self._server.stop()
         for handle in list(self._workers.values()):
             if handle.proc.poll() is None:
@@ -1689,7 +1741,12 @@ class NodeManager:
                 self._pin_leases.pop(object_id, None)
 
     async def _locate_object(self, payload):
-        return self.store.locate(payload["object_id"])
+        located = self.store.locate(payload["object_id"])
+        if located is not None:
+            # Transfer-source probes learn the bulk data channel here
+            # (additive key; colocated readers ignore it).
+            located["bulk_port"] = self._bulk_port
+        return located
 
     async def _contains_object(self, payload):
         return self.store.contains(payload["object_id"])
@@ -1764,7 +1821,7 @@ class NodeManager:
         if located is not None:
             return located
         gcs = self._clients.get(self._gcs_address)
-        chunk = global_config().object_transfer_chunk_size
+        pull_failures = 0
         while time.monotonic() < deadline:
             # A colocated producer (or a concurrent EnsureLocal) may have
             # sealed the object since the last iteration.
@@ -1774,53 +1831,120 @@ class NodeManager:
             holders: list[NodeInfo] = await gcs.call_async(
                 "ObjectLocationsGet", {"object_id": object_id}, timeout=10)
             holders = [h for h in holders if h.node_id != self.node_id]
-            if not holders:
-                if fail_fast_after is not None:
-                    now = time.monotonic()
-                    if no_holders_since is None:
-                        no_holders_since = now
-                    elif now - no_holders_since >= fail_fast_after:
-                        located = _locate()
-                        return located if located is not None else {
-                            "no_holders": True}
-            else:
-                no_holders_since = None
             # Randomized holder order spreads a broadcast across every
             # node that already completed its pull, instead of every
-            # puller hammering the first-listed holder.
+            # puller hammering the first-listed holder.  (The stripe
+            # planner re-sorts deterministically; randomization still
+            # picks WHICH holder serves a small, unstriped object.)
             random.shuffle(holders)
-            for holder in holders:
+            viable = False
+            # A round with NO reachable copy feeds the fail-fast clock
+            # only when every listed holder verifiably lost the object
+            # (retracted) — a merely-unreachable holder (restarting RPC
+            # server, short partition) must not fast-track the owner
+            # into lineage reconstruction.
+            holderless = not holders
+            if holders:
                 try:
-                    remote = self._clients.get(holder.address)
-                    await self._pull_from(remote, object_id, chunk)
+                    await self._pull_object(object_id, holders)
+                    viable = True
+                    pull_failures = 0
+                except _NoViableHolder as e:
+                    # Stale misses were retracted inside _pull_object,
+                    # so the NEXT GCS round already sees an honest
+                    # list — re-locate immediately.
+                    holderless = not e.any_unreachable
+                except Exception as e:  # noqa: BLE001 — transient pull
+                    # A viable holder existed but the transfer failed
+                    # mid-flight (holder death, concurrent grant): the
+                    # holder list is refreshed right away; back off only
+                    # on CONSECUTIVE failures so one dead holder never
+                    # costs a 50 ms sleep while live ones remain.
+                    logger.debug("pull of %s failed: %s",
+                                 object_id.hex()[:8], e)
+                    viable = True
+                    pull_failures += 1
+                    if pull_failures > 1:
+                        await asyncio.sleep(
+                            min(0.02 * pull_failures, 0.5))
+            if viable:
+                no_holders_since = None
+                located = _locate()
+                if located is not None:
+                    await gcs.call_async("ObjectLocationAdd", {
+                        "object_id": object_id,
+                        "node_id": self.node_id}, timeout=10)
+                    return located
+                continue
+            # Full round with no viable holder: fail-fast bookkeeping
+            # (true holderless rounds only) and the (only) inter-round
+            # sleep.
+            if not holderless:
+                no_holders_since = None
+            elif fail_fast_after is not None:
+                now = time.monotonic()
+                if no_holders_since is None:
+                    no_holders_since = now
+                elif now - no_holders_since >= fail_fast_after:
                     located = _locate()
-                    if located is not None:
-                        await gcs.call_async("ObjectLocationAdd", {
-                            "object_id": object_id,
-                            "node_id": self.node_id}, timeout=10)
-                        return located
-                except _HolderMiss:
-                    # Stale location (holder evicted it): retract so the
-                    # next round sees an honest holder list.
-                    await gcs.oneway_async("ObjectLocationRemove", {
-                        "object_id": object_id, "node_id": holder.node_id})
-                except Exception as e:  # noqa: BLE001 — try next holder
-                    logger.debug("pull of %s from %s failed: %s",
-                                 object_id.hex()[:8], holder.address, e)
+                    return located if located is not None else {
+                        "no_holders": True}
             await asyncio.sleep(0.05)
         return {"timeout": True}
 
-    async def _pull_from(self, remote, object_id: ObjectID, chunk: int):
-        """Chunked pull from a holding node into the local store
-        (ref: ObjectManager push/pull, push_manager.h:28)."""
-        info = await remote.call_async(
-            "LocateObject", {"object_id": object_id}, timeout=10)
-        if info is None:
-            raise _HolderMiss("holder no longer has the object")
-        size = info["size"]
+    async def _pull_object(self, object_id: ObjectID, holders):
+        """One pull attempt: probe the listed holders (concurrently, one
+        RTT), retract stale locations, then stream the object in with
+        the windowed/striped chunk scheduler.  Quota accounts the whole
+        object size ONCE — stripes share the object's admission, they
+        are not independent transfers."""
+        gcs = self._clients.get(self._gcs_address)
+
+        async def probe(holder):
+            try:
+                info = await self._clients.get(holder.address).call_async(
+                    "LocateObject", {"object_id": object_id}, timeout=10)
+            except Exception:  # noqa: BLE001 — unreachable holder
+                return holder, -1
+            return holder, info
+
+        live, size, bulk_ports = [], None, {}
+        any_unreachable = False
+
+        async def absorb(holder, info) -> None:
+            nonlocal size, any_unreachable
+            if info is None:
+                # Stale location (holder evicted it): retract so the
+                # next round sees an honest holder list.
+                await gcs.oneway_async("ObjectLocationRemove", {
+                    "object_id": object_id, "node_id": holder.node_id})
+            elif info == -1:
+                any_unreachable = True
+            else:
+                live.append(holder)
+                size = info["size"]
+                bulk_ports[holder.node_id] = info.get("bulk_port")
+
+        # Probe SEQUENTIALLY until one holder answers (the common
+        # broadcast of a small object costs ONE probe per puller, like
+        # the old path — not O(holders), which would make an N-node
+        # broadcast O(N^2) control RPCs cluster-wide)...
+        remaining = list(holders)
+        while remaining and not live:
+            holder = remaining.pop(0)
+            await absorb(*(await probe(holder)))
+        if not live:
+            raise _NoViableHolder(object_id.hex()[:12], any_unreachable)
+        # ...and fan the rest out concurrently ONLY when the size makes
+        # striping possible and extra holders would add NIC lanes.
+        stripe_min = global_config().object_stripe_min_bytes
+        if remaining and stripe_min > 0 and size >= stripe_min:
+            for holder, info in await asyncio.gather(
+                    *[probe(h) for h in remaining]):
+                await absorb(holder, info)
         await self._acquire_pull_quota(size)
         try:
-            await self._pull_body(remote, object_id, chunk, size)
+            await self._pull_body(object_id, size, live, bulk_ports)
         finally:
             await self._release_pull_quota(size)
 
@@ -1847,21 +1971,12 @@ class NodeManager:
             self._pull_bytes_inflight -= size
             self._pull_quota_cv.notify_all()
 
-    async def _pull_body(self, remote, object_id: ObjectID, chunk: int,
-                         size: int):
-
-        async def fetch_into(write):
-            pos = 0
-            while pos < size:
-                data = await remote.call_async("ReadChunk", {
-                    "object_id": object_id, "offset": pos,
-                    "length": min(chunk, size - pos)}, timeout=60)
-                if not data:
-                    raise RuntimeError(
-                        f"short read at {pos}/{size} from holder")
-                write(pos, data)
-                pos += len(data)
-
+    async def _pull_body(self, object_id: ObjectID, size: int, live,
+                         bulk_ports):
+        """Create the local grant and stream the payload in; chunks land
+        position-addressed (out of order), so the write sink is a
+        random-access memoryview for both backends (bulk pumps
+        ``recv_into`` socket bytes straight into it)."""
         if self.store.uses_arena:
             from ant_ray_tpu._private.object_store import BufferExistsError  # noqa: PLC0415
 
@@ -1877,10 +1992,11 @@ class NodeManager:
             try:
                 view = self.store.view_unsealed(object_id)
 
-                def write(pos, data):
-                    view[pos:pos + len(data)] = data
+                def view_at(off, n):
+                    return view[off:off + n]
 
-                await fetch_into(write)
+                await self._pull_chunks(object_id, size, live,
+                                        bulk_ports, view_at)
             except BaseException:
                 # Includes CancelledError at shutdown: never leave a
                 # wedged half-written grant (we created it above, so it
@@ -1891,15 +2007,260 @@ class NodeManager:
             return
         tmp = self.store.path_of(object_id) + ".pull"
         try:
-            with open(tmp, "wb") as f:
-                await fetch_into(lambda _pos, data: f.write(data))
-        except Exception:
+            with open(tmp, "w+b") as f:
+                if size > 0:
+                    import mmap  # noqa: PLC0415
+
+                    f.truncate(size)
+                    m = mmap.mmap(f.fileno(), size)
+                    view = memoryview(m)
+
+                    def view_at(off, n):
+                        return view[off:off + n]
+
+                    await self._pull_chunks(object_id, size, live,
+                                            bulk_ports, view_at)
+                    m.flush()
+                    # No explicit close: a straggler pump thread may
+                    # still hold a slice; GC reclaims the mapping once
+                    # the last view dies (the file itself is renamed by
+                    # seal_file below, which mmaps don't mind).
+        except BaseException:
             try:
                 os.unlink(tmp)
             except FileNotFoundError:
                 pass
             raise
         self.store.seal_file(object_id, tmp)
+
+    async def _pull_chunks(self, object_id: ObjectID, size: int, live,
+                           bulk_ports, view_at):
+        """Streaming chunk scheduler (ref: PushManager windowed chunking,
+        push_manager.h:28, redesigned pull-side).
+
+        * **Windowed pipelining** — each holder pump keeps up to
+          ``object_pull_window`` chunk requests in flight, so
+          throughput is bounded by the wire, not chunk_size/RTT.
+        * **Bulk data channel** — holders that advertise a bulk port
+          are drained by a blocking-socket worker thread
+          (transfer.pull_chunks) that ``recv_into``-s replies straight
+          into the grant view: socket → shared memory with no event
+          loop or pickle on the hot path.  Holders without one (older
+          peers) fall back to windowed ReadChunkRaw RPCs.
+        * **Multi-holder striping** — past ``object_stripe_min_bytes``
+          with >=2 live holders, the chunk range is partitioned into
+          contiguous per-holder stripes pulled concurrently into the
+          same grant.  Holder order is DETERMINISTIC (node-id sort) so
+          every puller in a broadcast assigns the same stripe to the
+          same holder — each holder's chunk cache then serves exactly
+          its stripe and the read-each-chunk-once property survives.
+        * **Failover without re-pull** — a dying pump returns every
+          chunk it did not complete to a shared overflow queue; live
+          pumps drain it, and if none remain a spare/finished holder is
+          respawned.  No completed byte is ever transferred twice.
+        """
+        import threading  # noqa: PLC0415
+
+        from ant_ray_tpu._private import transfer  # noqa: PLC0415
+
+        cfg = global_config()
+        chunk = cfg.object_transfer_chunk_size
+        window = max(1, cfg.object_pull_window)
+        offsets = list(range(0, size, chunk))
+        striped = (cfg.object_stripe_min_bytes > 0
+                   and size >= cfg.object_stripe_min_bytes
+                   and len(live) >= 2 and len(offsets) >= 2)
+        if striped:
+            # Deterministic stripe-to-holder assignment (see docstring).
+            # Unstriped pulls keep the caller's shuffled order — the
+            # shuffle is what spreads a small-object broadcast across
+            # holders instead of hammering the lowest node id.
+            live = sorted(live, key=lambda h: h.node_id.hex())
+        k = len(live) if striped else 1
+        share = (len(offsets) + k - 1) // k
+        owns = [deque(offsets[i * share:(i + 1) * share])
+                for i in range(k)]
+        overflow: deque = deque()
+        spares = deque(live[k:])
+        stop = threading.Event()
+        if striped:
+            self.transfer_stats["stripe_pulls"] += 1
+
+        def make_take(own: deque):
+            def take():
+                if stop.is_set():
+                    return None
+                # try/except, not check-then-pop: the overflow deque is
+                # shared across pump threads and the io loop.
+                try:
+                    return own.popleft()
+                except IndexError:
+                    pass
+                try:
+                    return overflow.popleft()
+                except IndexError:
+                    return None
+            return take
+
+        async def bulk_pump(holder, own: deque, port: int):
+            host = holder.address.rsplit(":", 1)[0]
+            progress = [0]            # single writer: the pump thread
+            fut = asyncio.get_running_loop().run_in_executor(
+                None, transfer.pull_chunks, (host, port), object_id,
+                size, chunk, window, make_take(own), overflow.append,
+                view_at, striped, progress)
+            try:
+                await asyncio.shield(fut)
+            except asyncio.CancelledError:
+                # The worker thread cannot be cancelled; tell it to stop
+                # taking chunks and reap it so no writer outlives the
+                # grant this coroutine's caller is about to abort.
+                stop.set()
+                try:
+                    await fut
+                except Exception:  # noqa: BLE001 — already cancelling
+                    pass
+                raise
+            except transfer.BulkMiss as e:
+                raise _HolderMiss(str(e)) from e
+            finally:
+                # Tallied HERE (io loop), success AND failure paths —
+                # chunks a dying holder already delivered stay written
+                # (never re-pulled), so they must stay counted.  Skip
+                # only if the thread still runs (double-cancel); its
+                # write would race the read.
+                if fut.done():
+                    self.transfer_stats["pull_bytes"] += progress[0]
+
+        async def rpc_pump(holder, own: deque):
+            from ant_ray_tpu.exceptions import ObjectLostError  # noqa: PLC0415
+
+            remote = self._clients.get(holder.address)
+            take = make_take(own)
+            inflight: deque = deque()
+            method = "ReadChunkRaw"
+            try:
+                while True:
+                    while len(inflight) < window:
+                        off = take()
+                        if off is None:
+                            break
+                        n = min(chunk, size - off)
+                        try:
+                            fut = await remote.send_request(
+                                method,
+                                {"object_id": object_id, "offset": off,
+                                 "length": n, "stripe": striped})
+                        except BaseException:
+                            # The taken offset is in neither inflight
+                            # nor the queues — requeue before failing.
+                            overflow.append(off)
+                            raise
+                        inflight.append((off, n, fut))
+                    if not inflight:
+                        return
+                    off, n, fut = inflight.popleft()
+                    try:
+                        data = await asyncio.wait_for(fut, 60)
+                    except ObjectLostError:
+                        overflow.append(off)
+                        raise _HolderMiss(
+                            "holder no longer has the object") from None
+                    except RpcError as e:
+                        overflow.append(off)
+                        if "no route" in str(e) and \
+                                "ReadChunkRaw" in str(e):
+                            # Pre-raw-frame peer: fall back to the
+                            # legacy pickled ReadChunk for this holder.
+                            # Every already-pipelined raw future fails
+                            # the same way and re-enters this branch,
+                            # so window > 1 drains cleanly too.
+                            method = "ReadChunk"
+                            continue
+                        raise
+                    except BaseException:
+                        overflow.append(off)
+                        raise
+                    if data is None:
+                        overflow.append(off)
+                        raise _HolderMiss(
+                            "holder no longer has the object")
+                    if len(data) != n:
+                        overflow.append(off)
+                        raise RuntimeError(
+                            f"short read at {off}/{size} from holder")
+                    view_at(off, n)[:] = data
+                    self.transfer_stats["pull_bytes"] += n
+            except BaseException:
+                # In-flight chunks go back for survivors — exactly the
+                # not-yet-completed remainder, never a re-pulled byte.
+                overflow.extend(o for o, _n, _f in inflight)
+                raise
+
+        async def pump(holder, own: deque):
+            port = bulk_ports.get(holder.node_id)
+            try:
+                if port:
+                    await bulk_pump(holder, own, port)
+                else:
+                    await rpc_pump(holder, own)
+            except BaseException:
+                overflow.extend(own)
+                own.clear()
+                raise
+
+        tasks = {asyncio.ensure_future(pump(live[i], owns[i])): live[i]
+                 for i in range(k)}
+        healthy: list = []
+        last_err: BaseException | None = None
+        gcs = self._clients.get(self._gcs_address)
+        try:
+            while tasks:
+                done, _ = await asyncio.wait(
+                    tasks, return_when=asyncio.FIRST_COMPLETED)
+                for t in done:
+                    holder = tasks.pop(t)
+                    err = t.exception()
+                    if err is None:
+                        healthy.append(holder)
+                        continue
+                    last_err = err
+                    self.transfer_stats["holder_failures"] += 1
+                    if striped and overflow:
+                        self.transfer_stats["stripe_failovers"] += 1
+                    if isinstance(err, _HolderMiss):
+                        await gcs.oneway_async("ObjectLocationRemove", {
+                            "object_id": object_id,
+                            "node_id": holder.node_id})
+                    logger.debug("pull pump for %s on %s failed: %s",
+                                 object_id.hex()[:8], holder.address,
+                                 err)
+                if not tasks and overflow:
+                    # Every pump is gone but chunks remain: respawn on a
+                    # spare holder, else one that finished its stripe
+                    # cleanly (it is alive and still holds the object).
+                    nxt = (spares.popleft() if spares
+                           else healthy.pop() if healthy else None)
+                    if nxt is None:
+                        raise last_err or RuntimeError(
+                            "pull failed on every holder")
+                    tasks[asyncio.ensure_future(pump(nxt, deque()))] = nxt
+            if overflow or any(owns):
+                raise last_err or RuntimeError(
+                    "pull ended with chunks missing")
+        except BaseException:
+            stop.set()
+            for t in tasks:
+                t.cancel()
+            if tasks:
+                # Reap pumps (including their executor threads) BEFORE
+                # the caller aborts the grant — a straggler writer must
+                # never touch a recycled arena range.
+                try:
+                    await asyncio.gather(*tasks, return_exceptions=True)
+                except BaseException:  # noqa: BLE001 — double cancel
+                    pass
+            raise
 
     def _on_store_delete(self, object_id: ObjectID):
         """Store eviction hook: retract this node's GCS location record
@@ -1927,28 +2288,114 @@ class NodeManager:
         chunk and the bytes are shared across repliers (objects are
         immutable while they exist; deletion drops the cache entries)."""
         key = (payload["object_id"], payload["offset"], payload["length"])
-        cached = self._chunk_cache.get(key)
+        self._chunk_read_log.append((key[0].hex(), key[1], key[2]))
+        cached = self.cache_get_chunk(key)
         if cached is not None:
-            self._chunk_cache.move_to_end(key)
-            self.transfer_stats["chunk_cache_hits"] += 1
+            self._bump_stats(chunk_cache_hits=1)
             return cached
         data = self.store.read_chunk(*key)
-        self.transfer_stats["chunk_reads"] += 1
+        self._bump_stats(chunk_reads=1)
+        self.cache_put_chunk(key, data)
+        return data
+
+    def _bump_stats(self, **deltas) -> None:
+        """Transfer-counter increments under the cache lock — bulk
+        handler threads bump the same dict slots concurrently, and +=
+        on a dict slot is a read-modify-write."""
+        with self._chunk_cache_lock:
+            for key, delta in deltas.items():
+                self.transfer_stats[key] += delta
+
+    def cache_get_chunk(self, key):
+        """LRU chunk-cache lookup (io loop AND bulk threads)."""
+        with self._chunk_cache_lock:
+            cached = self._chunk_cache.get(key)
+            if cached is not None:
+                self._chunk_cache.move_to_end(key)
+            return cached
+
+    def cache_put_chunk(self, key, data) -> None:
+        """Memoize a served chunk under the byte cap (stable copy —
+        cache entries must outlive arena slots)."""
         cap = global_config().transfer_chunk_cache_bytes
-        if cap > 0 and len(data) <= cap:
+        if cap <= 0 or len(data) > cap:
+            return
+        data = bytes(data)
+        with self._chunk_cache_lock:
+            if key in self._chunk_cache:
+                return
             self._chunk_cache[key] = data
             self._chunk_cache_bytes += len(data)
             while self._chunk_cache_bytes > cap:
                 _old_key, old = self._chunk_cache.popitem(last=False)
                 self._chunk_cache_bytes -= len(old)
-        return data
+
+    def _read_chunk_raw(self, payload):
+        """Zero-copy transfer chunk serving (sync FAST route: the raw
+        reply is written before any other io-loop task can run, so an
+        arena view is handed straight to the transport — no bytes
+        materialization, no pickle round trip).  The chunk cache key
+        stays ``(object_id, offset, length)``: striped pulls use the
+        same uniform chunk offsets, so stripe reads and broadcast reads
+        memoize identically.  Replies ``None`` when the object is gone
+        (stale holder — the puller retracts the location)."""
+        key = (payload["object_id"], payload["offset"], payload["length"])
+        self._chunk_read_log.append((key[0].hex(), key[1], key[2]))
+        delay = global_config().testing_chunk_serve_delay_s
+        cached = self.cache_get_chunk(key)
+        if cached is not None:
+            self._bump_stats(chunk_cache_hits=1,
+                             **({"stripe_cache_hits": 1}
+                                if payload.get("stripe") else {}))
+            return (self._delayed_raw(cached, delay) if delay > 0
+                    else RawReply(cached))
+        # PINNED view, not a bare one: bulk handler threads mutate the
+        # store concurrently (restore -> create -> evict), so an
+        # unpinned arena window could be recycled before the transport
+        # consumes it.  The pin drops via the RawReply release hook
+        # right after the write.
+        token = ("rawrpc", next(_raw_serve_tokens))
+        data = self.store.chunk_view_pinned(*key, token)
+        if data is None:
+            return None
+        self._bump_stats(chunk_reads=1)
+        # cache_put_chunk makes its own stable copy under the cap; the
+        # reply still serves the live view (zero-copy on this route).
+        self.cache_put_chunk(key, data)
+        oid = key[0]
+        if delay > 0:
+            reply = self._delayed_raw(data, delay)
+            self.store.unpin(oid, token)   # _delayed_raw copied already
+            return reply
+        return RawReply(data,
+                        release=lambda: self.store.unpin(oid, token))
+
+    def _delayed_raw(self, data, delay: float):
+        """Test-only slow serving (testing_chunk_serve_delay_s): resolve
+        the reply future after a pause so tests can kill a holder
+        mid-transfer deterministically.  The payload is copied — the
+        synchronous-write zero-copy guarantee doesn't hold across the
+        delay."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        data = bytes(data)
+        loop.call_later(
+            delay,
+            lambda: None if fut.done() else fut.set_result(RawReply(data)))
+        return fut
 
     def _drop_cached_chunks(self, object_id: ObjectID) -> None:
-        for key in [k for k in self._chunk_cache if k[0] == object_id]:
-            self._chunk_cache_bytes -= len(self._chunk_cache.pop(key))
+        with self._chunk_cache_lock:
+            for key in [k for k in self._chunk_cache
+                        if k[0] == object_id]:
+                self._chunk_cache_bytes -= len(self._chunk_cache.pop(key))
 
-    async def _get_transfer_stats(self, _payload):
-        return dict(self.transfer_stats)
+    async def _get_transfer_stats(self, payload):
+        stats = dict(self.transfer_stats)
+        stats["chunk_cache_bytes"] = self._chunk_cache_bytes
+        if payload and payload.get("include_read_log"):
+            stats["read_log"] = list(self._chunk_read_log)
+        return stats
 
     async def _delete_object(self, payload):
         # GCS-driven delete: its location record is already retracted,

@@ -454,6 +454,39 @@ class ObjectStore:
         with self._lock:
             return list(self._entries)
 
+    def chunk_view_pinned(self, object_id: ObjectID, offset: int,
+                          length: int,
+                          token) -> memoryview | bytes | None:
+        """Serving-side chunk window for the bulk transfer channel:
+        arena-backed objects are PINNED under ``token`` and a direct
+        arena view is returned — the caller streams it to the socket
+        and then calls :meth:`unpin` (the pin keeps the range allocated
+        across a concurrent delete via the doomed list, so a mid-send
+        eviction can never recycle the bytes under the socket).
+        File-backed/spilled objects return a plain read (POSIX keeps
+        the bytes stable without a pin).  ``None`` when the object is
+        gone."""
+        with self._lock:
+            entry = self._entries.get(object_id)
+            if entry is None and self._restore_locked(object_id):
+                entry = self._entries.get(object_id)
+            if entry is None or not entry.sealed:
+                return None
+            self._entries.move_to_end(object_id)
+            if entry.offset is not None:
+                if offset >= entry.size:
+                    return b""
+                end = min(offset + length, entry.size)
+                entry.pin_tokens.add(token)
+                return self._arena.view(entry.offset + offset,
+                                        end - offset)
+        try:
+            with open(self.path_of(object_id), "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+        except FileNotFoundError:
+            return None
+
     def read_chunk(self, object_id: ObjectID, offset: int, length: int) -> bytes:
         """Read a chunk for cross-node transfer."""
         with self._lock:

@@ -37,6 +37,41 @@ PROTOCOL_VERSION = 1
 
 _HEADER = 8  # u64 big-endian frame length
 
+# Top header bit marks a RAW frame: the body is
+# ``u32 meta_len | pickle((kind, msg_id, method, None)) | payload bytes``
+# and the payload is handed to the caller as a memoryview over the read
+# buffer instead of travelling through pickle.  Raw frames are only ever
+# sent in REPLY to a method that opts in (ReadChunkRaw), so the change
+# is additive within PROTOCOL_VERSION — peers that never ask never see
+# one.
+_RAW_FLAG = 1 << 63
+
+
+class RawReply:
+    """Handler-return wrapper: reply with ``data`` as a raw out-of-band
+    frame (no pickle copy of the payload).  ``data`` may be bytes or a
+    memoryview; it is consumed synchronously by the transport write, so
+    views into shared memory are safe as long as the handler returns on
+    the io loop without an intervening await (fast routes).
+
+    ``release`` (optional) is invoked exactly once after the transport
+    consumed the payload (or the reply was dropped) — handlers use it
+    to unpin shared-memory windows they served from."""
+
+    __slots__ = ("data", "release")
+
+    def __init__(self, data, release=None):
+        self.data = data
+        self.release = release
+
+    def done(self) -> None:
+        release, self.release = self.release, None
+        if release is not None:
+            try:
+                release()
+            except Exception:  # noqa: BLE001 — reply path must not die
+                logger.exception("RawReply release hook failed")
+
 # Transport write-buffer level above which senders await drain (flow
 # control); below it, frames are written inline with no await.  Shared by
 # client sends and server replies.
@@ -116,6 +151,15 @@ class IoThread:
             inst.loop.call_soon_threadsafe(inst.loop.stop)
 
 
+def _release_raw_result(fut: "asyncio.Future") -> None:
+    try:
+        result = fut.result()
+    except Exception:  # noqa: BLE001 — handler error, nothing to free
+        return
+    if isinstance(result, RawReply):
+        result.done()
+
+
 # asyncio's loop keeps only weak refs to tasks; hold strong refs here so
 # fire-and-forget dispatch/read-loop tasks are never GC'd mid-flight.
 _background_tasks: set = set()
@@ -130,6 +174,12 @@ def _spawn(coro) -> None:
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
     header = await reader.readexactly(_HEADER)
     length = int.from_bytes(header, "big")
+    if length & _RAW_FLAG:
+        data = await reader.readexactly(length & ~_RAW_FLAG)
+        meta_len = int.from_bytes(data[:4], "big")
+        kind, msg_id, method, _ = pickle.loads(data[4:4 + meta_len])
+        # Zero-copy hand-off: a view over the (immutable) read buffer.
+        return kind, msg_id, method, memoryview(data)[4 + meta_len:]
     data = await reader.readexactly(length)
     return pickle.loads(data)
 
@@ -137,6 +187,17 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
 def _encode_frame(msg: Any) -> bytes:
     data = pickle.dumps(msg, protocol=5)
     return len(data).to_bytes(_HEADER, "big") + data
+
+
+def _encode_raw_head(kind: int, msg_id: int, method: str,
+                     payload_len: int) -> bytes:
+    """Header + meta for a raw frame; the payload bytes are written
+    separately by the caller (so an arena view never round-trips
+    through pickle)."""
+    meta = pickle.dumps((kind, msg_id, method, None), protocol=5)
+    total = 4 + len(meta) + payload_len
+    return ((total | _RAW_FLAG).to_bytes(_HEADER, "big")
+            + len(meta).to_bytes(4, "big") + meta)
 
 
 # -------------------------------------------------------------------- server
@@ -235,6 +296,9 @@ class RpcServer:
             return
         if isinstance(result, asyncio.Future):
             if kind == _ONEWAY:
+                # Nobody consumes the reply: still release any raw
+                # payload's resources (e.g. a served chunk's pin).
+                result.add_done_callback(_release_raw_result)
                 return
             result.add_done_callback(
                 lambda f: self._write_reply_of(writer, write_lock,
@@ -243,6 +307,8 @@ class RpcServer:
         if kind != _ONEWAY:
             self._write_reply(writer, write_lock,
                               (_REP, msg_id, method, result))
+        elif isinstance(result, RawReply):
+            result.done()
 
     def _write_reply_of(self, writer, write_lock, msg_id, method,
                         fut: asyncio.Future):
@@ -253,6 +319,23 @@ class RpcServer:
         self._write_reply(writer, write_lock, msg)
 
     def _write_reply(self, writer, write_lock, msg):
+        if isinstance(msg[3], RawReply):
+            data = msg[3].data
+            try:
+                # Two writes, both synchronous: the transport consumes
+                # the payload view before returning, so a shared-memory
+                # window is safe to hand over without copying.
+                writer.write(_encode_raw_head(msg[0], msg[1], msg[2],
+                                              len(data)))
+                writer.write(data)
+                if writer.transport.get_write_buffer_size() > \
+                        _DRAIN_THRESHOLD:
+                    _spawn(self._drain_locked(writer, write_lock))
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            finally:
+                msg[3].done()
+            return
         try:
             frame = _encode_frame(msg)
         except Exception:  # noqa: BLE001 — unpicklable error payload
@@ -281,6 +364,15 @@ class RpcServer:
                 raise RpcError(f"no route for method {method!r}")
             result = await handler(payload)
             if kind == _ONEWAY:
+                if isinstance(result, RawReply):
+                    result.done()
+                return
+            if isinstance(result, RawReply):
+                # NOTE: an await boundary separates the handler from
+                # this write, so async raw replies must carry bytes
+                # (not live arena views — those are fast-route only).
+                self._write_reply(writer, write_lock,
+                                  (_REP, msg_id, method, result))
                 return
             frame = _encode_frame((_REP, msg_id, method, result))
         except Exception as e:  # noqa: BLE001 — forwarded to caller

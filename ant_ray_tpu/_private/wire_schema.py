@@ -127,6 +127,9 @@ METHODS: dict[str, dict] = {
     "LocateObject": _m("node", "{object_id}",
                        "{size, ...}|None (transfer source probe)"),
     "ReadChunk": _m("node", "{object_id, offset, length}", "bytes"),
+    "ReadChunkRaw": _m("node", "{object_id, offset, length, stripe?}",
+                       "raw out-of-band frame: chunk bytes served "
+                       "zero-copy (b'' past EOF, None when missing)"),
     "EnsureLocal": _m("node",
                       "{object_id, timeout, fail_fast_after?, pin_ttl?, "
                       "prefetch?}",
@@ -140,7 +143,8 @@ METHODS: dict[str, dict] = {
     "GetNodeMetrics": _m("node", "{}", "{gauges}"),
     "GetStoreStats": _m("node", "{}", "{used, capacity, spilled}"),
     "GetSyncStats": _m("node", "{}", "{beats, views_sent, ...}"),
-    "GetTransferStats": _m("node", "{}", "{quota_waits, ...}"),
+    "GetTransferStats": _m("node", "{include_read_log?}",
+                           "{quota_waits, ..., read_log?}"),
     "ListLogs": _m("node", "{}", "[{filename, size}]"),
     "ReadLog": _m("node", "{filename, offset?, tail?, max_bytes?}",
                   "{data, next_offset, eof}|{error}"),
@@ -153,6 +157,13 @@ METHODS: dict[str, dict] = {
                     "(kind, payload) owned-object fetch"),
     "GetObjectStatus": _m("worker", "{object_id}",
                           "'ready'|'pending'|'unknown'"),
+    "GetObjectStatusBatch": _m("worker", "{object_ids: [oid]}",
+                               "{oid: 'ready'|'pending'|'unknown'}"),
+    "WaitObjects": _m("worker",
+                      "{object_ids: [oid], num_ready?, timeout?}",
+                      "{oid: status} — owner parks the reply until "
+                      "num_ready listed refs are terminal or the "
+                      "deadline fires (push-based wait)"),
     "GetObjectInfo": _m("worker", "{object_id}", "{status, size}"),
     "BorrowAdd": _m("worker", "{object_id}", "bool"),
     "BorrowRemove": _m("worker", "{object_id}", "bool"),

@@ -14,6 +14,7 @@ singleton (ref: python/ray/_private/worker.py) and delegates to a pluggable
 from __future__ import annotations
 
 import asyncio
+import inspect
 import threading
 import time
 from typing import Any, Sequence
@@ -197,7 +198,9 @@ class LocalModeRuntime(CoreRuntime):
             rargs, rkwargs = self._resolve_args(args, kwargs)
             method = getattr(instance, method_name)
             result = method(*rargs, **rkwargs)
-            if asyncio.iscoroutine(result):
+            # inspect, not asyncio: on Python < 3.12 asyncio.iscoroutine
+            # also matches plain generators (streaming actor methods).
+            if inspect.iscoroutine(result):
                 result = asyncio.run(result)
             values = self._pack(result, num_returns)
         except exceptions.ActorDiedError:

@@ -12,6 +12,7 @@ loop).
 from __future__ import annotations
 
 import asyncio
+import inspect
 import logging
 import os
 import queue
@@ -195,7 +196,11 @@ class TaskExecutor:
             else:
                 fn = self.runtime.fetch_code(spec.function_id)
                 result = fn(*args, **kwargs)
-            if asyncio.iscoroutine(result):
+            # inspect, not asyncio: on Python < 3.12 asyncio.iscoroutine
+            # also matches PLAIN GENERATORS (legacy generator-based
+            # coroutine support), which would feed a streaming task's
+            # generator to the event loop ("Task got bad yield").
+            if inspect.iscoroutine(result):
                 result = self._run_coroutine(result)
         except SystemExit:
             raise
@@ -315,6 +320,11 @@ class TaskExecutor:
 
     def _error_returns(self, spec: TaskSpec, err: Exception) -> dict:
         payload = serialization.serialize_error(err).to_payload()
+        if spec.num_returns == -1:
+            # Streaming task failed before (or instead of) producing a
+            # generator: the owner expects exactly one end-of-stream
+            # marker, never `[...] * -1 == []`.
+            return {"returns": [("stream_end", (0, payload))]}
         return {"returns": [("error", payload)] * spec.num_returns}
 
 def _report_actor_state(runtime: ClusterRuntime, spec: ActorSpec | None,

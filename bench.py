@@ -160,6 +160,68 @@ def _collective_fusion_ratio() -> float:
 
 _PROFILER_BUDGET_NS = 2000.0   # 2 µs/step — observability stays free
 
+# ---------------------------------------------------------------------------
+# Regression guard: compare a run's metrics against the committed control
+# (BENCH_control.json) instead of silently drifting.  "higher" metrics fail
+# below control/tolerance; "lower" metrics fail above control*tolerance
+# (default 2x — i.e. a 2x slowdown / 0.5x throughput drop trips it; tune
+# with ART_BENCH_REGRESSION_TOLERANCE).
+# ---------------------------------------------------------------------------
+
+_GUARDED_METRICS = {
+    "put_get_bandwidth_gb_s": "higher",
+    "object_broadcast_striped_gb_s": "higher",
+    "wait_1k_ready_refs_us": "lower",
+    "collective_allreduce_fused_naive_ratio": "higher",
+    "collective_fused_naive_ratio": "higher",   # bench.py summary alias
+    "step_profiler_overhead_ns": "lower",
+}
+
+
+def _control_values(control_path: str | None) -> dict:
+    control_path = control_path or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_control.json")
+    try:
+        with open(control_path) as f:
+            control = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {r["metric"]: r["value"]
+            for r in control.get("results", [])
+            if isinstance(r.get("value"), (int, float))}
+
+
+def check_regression(results: dict, control_path: str | None = None,
+                     tolerance: float | None = None) -> list:
+    """Compare ``{metric: value}`` against the control file; returns a
+    list of regression records (empty = within tolerance).  Only
+    metrics in _GUARDED_METRICS with a control entry are judged —
+    bench.py's summary aliases map onto their microbench names."""
+    if tolerance is None:
+        tolerance = float(os.environ.get(
+            "ART_BENCH_REGRESSION_TOLERANCE", "2.0"))
+    control = _control_values(control_path)
+    alias = {"collective_fused_naive_ratio":
+             "collective_allreduce_fused_naive_ratio"}
+    regressions = []
+    for metric, value in results.items():
+        direction = _GUARDED_METRICS.get(metric)
+        if direction is None or not isinstance(value, (int, float)):
+            continue
+        ref = control.get(alias.get(metric, metric),
+                          control.get(metric))
+        if not ref:
+            continue
+        ratio = value / ref
+        bad = (ratio < 1.0 / tolerance if direction == "higher"
+               else ratio > tolerance)
+        if bad:
+            regressions.append({
+                "metric": metric, "value": round(value, 4),
+                "control": ref, "ratio": round(ratio, 3),
+                "direction": direction, "tolerance": tolerance})
+    return regressions
+
 
 def _step_profiler_overhead_ns(n_steps: int = 20000) -> float:
     """Instrumented-vs-bare loop cost of the step profiler's hot path
@@ -242,6 +304,16 @@ def run_child() -> None:
                 f"{_PROFILER_BUDGET_NS}ns budget")
     except Exception as e:  # noqa: BLE001
         result["step_profiler_overhead_error"] = repr(e)[:120]
+    try:
+        regressions = check_regression(
+            {k: v for k, v in result.items()
+             if isinstance(v, (int, float))})
+        if regressions:
+            # An explicit record instead of silent drift; the headline
+            # metric still reports so the run is never wasted.
+            result["bench_regression"] = regressions
+    except Exception as e:  # noqa: BLE001
+        result["bench_regression_error"] = repr(e)[:120]
     print(json.dumps(result))
 
 

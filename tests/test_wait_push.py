@@ -1,0 +1,143 @@
+"""Batched + push-based waits (ref: CoreWorker::Wait): borrowed refs
+wait via one WaitObjects long-poll per owner (the owner parks the reply
+until a ref turns terminal) with GetObjectStatusBatch polling as the
+fallback; owned refs resolve through synchronous memory-store lookups.
+"""
+
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu._private.protocol import RpcClient
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    # Logical CPU slots only (sleeping stand-in tasks): generous so
+    # long-sleeping refs from earlier tests never starve later leases.
+    art.init(num_cpus=8, num_tpus=0)
+    yield None
+    art.shutdown()
+
+
+@art.remote
+def _slow(x, delay=1.0):
+    time.sleep(delay)
+    return x
+
+
+# num_cpus=2: a DIFFERENT scheduling key than the _slow producers, so
+# the submitter's per-key pipelining can never queue the waiter behind
+# a sleeping producer on one leased worker (it must observe the refs
+# while they are still pending).
+@art.remote(num_cpus=2)
+def _wait_in_worker(refs, num_returns, timeout):
+    t0 = time.perf_counter()
+    ready, not_ready = art.wait(list(refs), num_returns=num_returns,
+                                timeout=timeout)
+    return len(ready), len(not_ready), time.perf_counter() - t0
+
+
+def test_wait_owned_all_ready_is_sync_fast_path(cluster):
+    refs = [art.put(i) for i in range(500)]
+    ready, not_ready = art.wait(refs, num_returns=len(refs), timeout=60)
+    assert len(ready) == 500 and not not_ready
+    # All-ready waits resolve without tasks or RPCs: far under the old
+    # per-ref-future floor even on a loaded CI box.
+    t0 = time.perf_counter()
+    for _ in range(10):
+        ready, _ = art.wait(refs, num_returns=len(refs), timeout=60)
+    assert (time.perf_counter() - t0) / 10 < 0.05
+    assert len(ready) == 500
+
+
+def test_wait_num_returns_surplus_stays_not_ready(cluster):
+    refs = [art.put(i) for i in range(5)]
+    ready, not_ready = art.wait(refs, num_returns=2, timeout=10)
+    assert len(ready) == 2 and len(not_ready) == 3
+    # Continuation contract: every ref comes back exactly once.
+    assert {r.id for r in ready} | {r.id for r in not_ready} == \
+        {r.id for r in refs}
+
+
+def test_wait_borrowed_blocks_until_push_wakeup(cluster):
+    """A worker waiting on borrowed pending refs parks on the owner's
+    WaitObjects long-poll and wakes when the producer finishes — no
+    per-ref polling, real blocking semantics."""
+    refs = [_slow.remote(i, 1.0) for i in range(2)]
+    n_ready, n_not, _dt = art.get(
+        _wait_in_worker.remote(refs, 2, 30), timeout=90)
+    assert (n_ready, n_not) == (2, 0)
+
+
+def test_wait_borrowed_timeout_zero_polls_once(cluster):
+    # Long delay: the waiter worker may take >1s to spawn, and the
+    # producer must still be running when its wait(timeout=0) polls.
+    pending = [_slow.remote(1, 12.0)]
+    n_ready, n_not, dt = art.get(
+        _wait_in_worker.remote(pending, 1, 0), timeout=90)
+    assert (n_ready, n_not) == (0, 1)
+    assert dt < 1.0, "timeout=0 must poll, not wait"
+    ready_ref = [art.put(42)]
+    n_ready, n_not, _dt = art.get(
+        _wait_in_worker.remote(ready_ref, 1, 0), timeout=90)
+    assert (n_ready, n_not) == (1, 0)
+
+
+def test_wait_borrowed_respects_num_returns_and_timeout(cluster):
+    """num_returns semantics under the push path: return as soon as
+    enough refs are terminal, leave slower ones not_ready on timeout."""
+    fast = art.put("done")
+    slow_refs = [_slow.remote(i, 30.0) for i in range(2)]
+    n_ready, n_not, dt = art.get(
+        _wait_in_worker.remote([fast] + slow_refs, 1, 20), timeout=90)
+    assert (n_ready, n_not) == (1, 2)
+    assert dt < 10, "wait kept blocking past num_returns satisfied"
+    n_ready, n_not, dt = art.get(
+        _wait_in_worker.remote(slow_refs, 1, 0.5), timeout=90)
+    assert (n_ready, n_not) == (0, 2)
+    assert 0.3 < dt < 10
+
+
+def test_get_object_status_batch_rpc(cluster):
+    from ant_ray_tpu.api import global_worker
+
+    rt = global_worker.runtime
+    ready = art.put(1)
+    pending = _slow.remote(1, 3.0)
+    unknown_oid = ready.id.from_random()
+    cli = RpcClient(rt.address)
+    statuses = cli.call(
+        "GetObjectStatusBatch",
+        {"object_ids": [ready.id, pending.id, unknown_oid]}, timeout=10)
+    assert statuses[ready.id] == "ready"
+    assert statuses[pending.id] == "pending"
+    assert statuses[unknown_oid] == "unknown"
+
+
+def test_wait_objects_rpc_parks_until_terminal(cluster):
+    """The owner-side long-poll: a WaitObjects on a pending ref does
+    not reply until the ref turns terminal (or its deadline fires)."""
+    from ant_ray_tpu.api import global_worker
+
+    rt = global_worker.runtime
+    cli = RpcClient(rt.address)
+
+    pending = _slow.remote(7, 1.0)
+    t0 = time.perf_counter()
+    statuses = cli.call(
+        "WaitObjects", {"object_ids": [pending.id], "num_ready": 1,
+                        "timeout": 10.0}, timeout=30)
+    waited = time.perf_counter() - t0
+    assert statuses[pending.id] == "ready"
+    assert waited >= 0.3, "owner replied before the ref was terminal"
+
+    # Deadline path: still-pending refs come back as pending.
+    stuck = _slow.remote(8, 20.0)
+    t0 = time.perf_counter()
+    statuses = cli.call(
+        "WaitObjects", {"object_ids": [stuck.id], "num_ready": 1,
+                        "timeout": 0.5}, timeout=30)
+    assert statuses[stuck.id] == "pending"
+    assert time.perf_counter() - t0 < 5
