@@ -119,18 +119,29 @@ def get_tpu_metadata(key: str) -> str | None:
         return _metadata_cache[key]
     if time.monotonic() < _metadata_backoff_until:
         return None
+    ok, value = _fetch_metadata_deadline(_metadata_base_url() + key)
+    if not ok:
+        _metadata_backoff_until = time.monotonic() + _METADATA_BACKOFF_S
+        return None
+    _metadata_cache[key] = value
+    return value
+
+
+def _fetch_metadata_deadline(url: str) -> tuple[bool, str | None]:
+    """_fetch_metadata_once in a daemon thread joined with a hard
+    deadline: DNS resolution is NOT bounded by urlopen's timeout, so a
+    dead resolver would otherwise hang the caller for minutes — fatal
+    for the preemption watcher, whose whole job is reacting within an
+    announced grace window."""
     result: list[tuple[bool, str | None]] = []
-    url = _metadata_base_url() + key
     t = threading.Thread(
-        target=lambda: result.append(_fetch_metadata_once(url)), daemon=True)
+        target=lambda: result.append(_fetch_metadata_once(url)),
+        daemon=True)
     t.start()
     t.join(_METADATA_DEADLINE_S + 0.3)
     if not result or not result[0][0]:
-        _metadata_backoff_until = time.monotonic() + _METADATA_BACKOFF_S
-        return None
-    value = result[0][1]
-    _metadata_cache[key] = value
-    return value
+        return False, None
+    return result[0]
 
 
 def _metadata_cache_clear() -> None:
@@ -279,6 +290,69 @@ def hbm_gib_per_chip(generation: str | None = None) -> float:
     gen = normalize_generation(generation) if generation \
         else (detect_generation() or "v5e")
     return TPU_HARDWARE_TABLE.get(gen, TPU_HARDWARE_TABLE["v5e"])[2]
+
+
+# GCE/TPU maintenance-event surface (ref: the instance metadata
+# `maintenance-event` attribute — TPU VMs see "TERMINATE_ON_HOST_
+# MAINTENANCE" minutes before an announced preemption; the reference
+# consumes the equivalent via the TPU maintenance-event API).
+_METADATA_KEY_MAINTENANCE = "maintenance-event"
+_MAINTENANCE_NONE = "NONE"
+
+
+def maintenance_watch_possible() -> bool:
+    """Whether ANY notice source could ever fire on this host — the
+    daemon's watcher exits immediately when none can (CPU test rigs
+    must not pay a poll thread per node forever)."""
+    if global_config().testing_preemption_notice:
+        return True
+    return not os.environ.get("ART_DISABLE_GCE_METADATA") and \
+        _may_query_metadata()
+
+
+def maintenance_notice() -> "tuple[str, float] | None":
+    """A pending preemption/maintenance notice for THIS host, or None.
+
+    Returns ``(reason, deadline_s)`` — ``deadline_s`` is the announced
+    grace (seconds from now; 0.0 = none announced).  Sources, in order:
+
+    * ``testing_preemption_notice`` (chaos harness): a file path whose
+      existence IS the notice; its first line may carry
+      ``"<deadline_s> <reason...>"``.
+    * The GCE ``maintenance-event`` metadata attribute (un-memoized —
+      unlike the identity attributes, this one CHANGES over the
+      instance lifetime, so the positive-result cache must not pin it).
+    """
+    notice_path = global_config().testing_preemption_notice
+    if notice_path:
+        try:
+            with open(notice_path) as f:
+                first = f.readline().split(None, 1)
+            deadline = float(first[0]) if first else 0.0
+            reason = (first[1].strip() if len(first) > 1
+                      else "testing preemption notice")
+            return reason, deadline
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError):
+            return "testing preemption notice", 0.0
+    global _metadata_backoff_until
+    if os.environ.get("ART_DISABLE_GCE_METADATA") or \
+            not _may_query_metadata():
+        return None
+    if time.monotonic() < _metadata_backoff_until:
+        return None
+    ok, value = _fetch_metadata_deadline(
+        _metadata_base_url() + _METADATA_KEY_MAINTENANCE)
+    if not ok:
+        # Same backoff as get_tpu_metadata: an unreachable metadata
+        # server must not cost the 1 Hz preemption watcher a blocking
+        # probe (and a stuck thread) per poll forever.
+        _metadata_backoff_until = time.monotonic() + _METADATA_BACKOFF_S
+        return None
+    if value is None or value.strip() in ("", _MAINTENANCE_NONE):
+        return None
+    return value.strip(), 0.0
 
 
 def node_labels() -> dict[str, str]:

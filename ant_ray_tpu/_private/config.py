@@ -64,6 +64,11 @@ class Config:
     # Testing only: holder-side delay per served transfer chunk, so
     # tests can deterministically kill a holder mid-transfer.
     testing_chunk_serve_delay_s: float = 0.0
+    # Testing only (chaos harness): truncate every bulk-channel chunk
+    # reply to at most this many payload bytes (0 = off).  The puller
+    # sees a short reply, fails the pump, and exercises the stripe
+    # failover path deterministically.
+    testing_chunk_truncate: int = 0
     # An unsealed arena grant younger than this is presumed live (its
     # producer is still writing); only older grants are reclaimed.
     unsealed_grant_ttl_s: float = 30.0
@@ -225,9 +230,23 @@ class Config:
     fs_monitor_interval_s: float = 5.0
     local_fs_capacity_threshold: float = 0.95
 
-    # ---- accelerators ----
+    # ---- accelerators / preemption ----
     # Override detected TPU chip count (testing).
     tpu_chips_override: int = -1
+    # Node-daemon poll period for pending TPU maintenance events /
+    # preemption notices (accelerators.tpu.maintenance_notice); on a
+    # notice the daemon drains itself via the GCS DrainNode RPC.
+    # 0 disables the watcher.
+    preemption_poll_interval_s: float = 1.0
+    # Default drain grace (seconds) announced with a preemption-driven
+    # drain when the notice itself carries no deadline — consumers
+    # (Train controllers, Serve) must be off the node within it.
+    drain_deadline_s: float = 30.0
+    # Testing only (chaos harness): path of a file whose EXISTENCE is a
+    # preemption notice for this node's daemon — the deterministic
+    # stand-in for the TPU maintenance-event metadata API.  First line
+    # may carry "<deadline_s> <reason...>".
+    testing_preemption_notice: str = ""
 
     # ---- logging ----
     log_level: str = "INFO"

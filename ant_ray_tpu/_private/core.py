@@ -2123,4 +2123,7 @@ class ClusterRuntime(CoreRuntime):
             "Address": info.address,
             "Resources": info.total_resources,
             "Labels": info.labels,
+            "Draining": getattr(info, "draining", False),
+            "DrainReason": getattr(info, "drain_reason", ""),
+            "DrainDeadline": getattr(info, "drain_deadline", 0.0),
         } for info in infos.values()]

@@ -128,6 +128,7 @@ class GcsServer:
             "RegisterNode": self._register_node,
             "Heartbeat": self._heartbeat,
             "GetAllNodes": self._get_all_nodes,
+            "DrainNode": self._drain_node,
             "KVPut": self._kv_put,
             "KVGet": self._kv_get,
             "KVDel": self._kv_del,
@@ -455,6 +456,13 @@ class GcsServer:
             if version > self._node_view_versions.get(node_id, -1):
                 info.available_resources = view["available_resources"]
                 info.disk_full = view.get("disk_full", False)
+                # Drain state is STICKY here: the daemon's view can set
+                # it (preemption watcher), but never clears it — a node
+                # drained via the DrainNode RPC stays drained even if
+                # the daemon itself didn't observe the notice.
+                if view.get("draining"):
+                    self._apply_drain(info, view.get("drain_reason", ""),
+                                      view.get("drain_deadline", 0.0))
                 self._node_view_versions[node_id] = version
             reply["synced"] = self._node_view_versions[node_id]
         elif node_id not in self._node_view_versions:
@@ -466,6 +474,45 @@ class GcsServer:
 
     async def _get_all_nodes(self, _payload):
         return dict(self._nodes)
+
+    # ------------------------------------------------------------- drain
+    # (ref: the reference's DrainNode RPC + autoscaler drain protocol,
+    #  gcs.proto DrainNodeRequest — here the announced-departure plane
+    #  behind TPU maintenance events / preemption notices)
+
+    def _apply_drain(self, info: NodeInfo, reason: str,
+                     deadline: float) -> None:
+        """Idempotent drain transition: publishes exactly once."""
+        if info.draining:
+            # Keep the earliest-announced deadline; a later notice
+            # cannot push the departure time OUT.
+            if deadline and (not info.drain_deadline
+                             or deadline < info.drain_deadline):
+                info.drain_deadline = deadline
+            return
+        info.draining = True
+        info.drain_reason = reason
+        info.drain_deadline = deadline
+        self._publish("node", {"node_id": info.node_id, "alive": True,
+                               "draining": True, "reason": reason,
+                               "deadline": deadline,
+                               "address": info.address})
+        logger.info("node %s DRAINING (%s, deadline=%s)",
+                    info.node_id.hex()[:8], reason or "unspecified",
+                    deadline or "none")
+
+    async def _drain_node(self, payload):
+        """Put a node into DRAINING: schedulers skip it for new leases
+        and bundle placements, Serve migrates its replicas, and Train
+        controllers proactively checkpoint + relaunch gangs off it.
+        The node stays ALIVE (its current work keeps running) until it
+        actually departs."""
+        info = self._nodes.get(payload["node_id"])
+        if info is None or not info.alive:
+            return False
+        self._apply_drain(info, payload.get("reason", ""),
+                          float(payload.get("deadline") or 0.0))
+        return True
 
     async def _health_check_loop(self):
         cfg = global_config()
@@ -862,6 +909,8 @@ class GcsServer:
                 continue
             if getattr(info, "disk_full", False):
                 continue  # out-of-disk nodes take no new work
+            if getattr(info, "draining", False):
+                continue  # announced departures take no new work
             if allowed is not None and info.node_id not in allowed:
                 continue
             if not self._labels_match(info, label_selector):
@@ -971,6 +1020,10 @@ class GcsServer:
                 "state": r.state,
                 "address": r.address,
                 "name": r.spec.name,
+                # Where the actor runs (drain-plane consumers map
+                # replicas/gang workers to draining nodes with this).
+                "node_id": (r.node_id.hex()
+                            if r.node_id is not None else None),
                 "job_id": (r.spec.job_id.hex()
                            if r.spec.job_id is not None else None),
                 "death_reason": r.death_reason,
@@ -1184,7 +1237,8 @@ class GcsServer:
         one tpu-pod-name") behind SlicePlacementGroup (ref:
         python/ray/util/tpu.py:52, bundle_label_selector)."""
         allowed = self._allowed_nodes_for_job(job_id)
-        alive = [n for n in self._nodes.values() if n.alive
+        alive = [n for n in self._nodes.values()
+                 if n.alive and not getattr(n, "draining", False)
                  and (allowed is None or n.node_id in allowed)]
         if same_label is not None:
             # Try each value-group of the shared label independently;
@@ -1442,7 +1496,8 @@ class GcsServer:
             if node is not None and node.node_id == exclude:
                 others = [
                     n for n in self._nodes.values()
-                    if n.alive and n.node_id != exclude and (
+                    if n.alive and not getattr(n, "draining", False)
+                    and n.node_id != exclude and (
                         allowed is None or n.node_id in allowed)
                     and self._labels_match(n, selector) and all(
                         (n.available_resources if by_available
@@ -1567,7 +1622,10 @@ class GcsServer:
     async def _cluster_resources(self, _payload):
         totals: dict[str, float] = {}
         for info in self._nodes.values():
-            if info.alive:
+            # Draining nodes are excluded from BOTH capacity views: a
+            # gang sized by totals that include an announced departure
+            # would be unplaceable by the time it reserves.
+            if info.alive and not getattr(info, "draining", False):
                 for k, v in info.total_resources.items():
                     totals[k] = totals.get(k, 0.0) + v
         return totals
@@ -1575,7 +1633,10 @@ class GcsServer:
     async def _available_resources(self, _payload):
         totals: dict[str, float] = {}
         for info in self._nodes.values():
-            if info.alive:
+            # A draining node's capacity is unleaseable — reporting it
+            # as available would make elastic policies size gangs the
+            # scheduler can never place.
+            if info.alive and not getattr(info, "draining", False):
                 for k, v in info.available_resources.items():
                     totals[k] = totals.get(k, 0.0) + v
         return totals

@@ -201,6 +201,12 @@ class NodeManager:
         self._vc_cache: dict = {}
         self.address = ""
         self._disk_full = False
+        # Drain state (announced departure — TPU maintenance event,
+        # SIGTERM, operator NotifyDrain): this node takes no NEW leases
+        # but keeps serving its current work until it actually exits.
+        self._draining = False
+        self._drain_reason = ""
+        self._drain_deadline = 0.0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -227,6 +233,7 @@ class NodeManager:
             "DeleteObject": self._delete_object,
             "ContainsObject": self._contains_object,
             "GetNodeInfo": self._get_node_info,
+            "NotifyDrain": self._notify_drain,
             "DebugResources": self._debug_resources,
             "GetSyncStats": self._get_sync_stats,
             "GetAgentInfo": self._get_agent_info,
@@ -288,6 +295,9 @@ class NodeManager:
         if global_config().memory_monitor_interval_s > 0:
             self._tasks.append(asyncio.run_coroutine_threadsafe(
                 self._memory_monitor_loop(), self._io.loop))
+        if global_config().preemption_poll_interval_s > 0:
+            self._tasks.append(asyncio.run_coroutine_threadsafe(
+                self._preemption_watch_loop(), self._io.loop))
         prestart = global_config().num_prestart_workers
         if prestart < 0:
             prestart = min(2, self._max_workers)
@@ -308,6 +318,9 @@ class NodeManager:
             available_resources=dict(self._available),
             object_store_dir=self.store.directory,
             labels=dict(self._labels),
+            draining=self._draining,
+            drain_reason=self._drain_reason,
+            drain_deadline=self._drain_deadline,
         )
 
     async def _register(self):
@@ -424,6 +437,89 @@ class NodeManager:
     async def _get_node_info(self, _payload):
         return self._node_info()
 
+    # ---------------------------------------------------------- draining
+    # (announced departures: a TPU maintenance event / preemption notice
+    #  arrives MINUTES before the host dies — reacting to it is the
+    #  difference between a planned checkpoint+migrate and a surprise
+    #  gang kill.  Ref: the reference's DrainNode protocol + the TPU
+    #  maintenance-event watcher.)
+
+    def begin_drain(self, reason: str = "",
+                    deadline_s: float | None = None) -> bool:
+        """Enter DRAINING: stop taking new leases, announce to the GCS.
+        Idempotent; returns True on the first transition."""
+        if self._draining:
+            return False
+        cfg = global_config()
+        if deadline_s is None or deadline_s <= 0:
+            deadline_s = cfg.drain_deadline_s
+        self._draining = True
+        self._drain_reason = reason or "drain requested"
+        self._drain_deadline = time.time() + deadline_s
+        self._sync_wakeup.set()      # propagate via the next heartbeat
+        logger.warning("node %s draining (%s; deadline in %.0fs)",
+                       self.node_id.hex()[:8], self._drain_reason,
+                       deadline_s)
+
+        async def _announce():
+            gcs = self._clients.get(self._gcs_address)
+            payload = {"node_id": self.node_id,
+                       "reason": self._drain_reason,
+                       "deadline": self._drain_deadline}
+            for attempt in range(10):  # outlasts a head restart
+                try:
+                    await gcs.call_async("DrainNode", payload, timeout=10)
+                    return
+                except Exception:  # noqa: BLE001 — head restarting
+                    await asyncio.sleep(min(0.2 * (attempt + 1), 2.0))
+            # The heartbeat view carries the flag anyway — the direct
+            # RPC only makes propagation immediate.
+
+        # Fire-and-forget: begin_drain runs ON the io loop (NotifyDrain
+        # handler) as well as off it (signal handler, watcher) — a
+        # blocking run_coro here would deadlock the former.
+        asyncio.run_coroutine_threadsafe(_announce(), self._io.loop)
+        return True
+
+    async def _notify_drain(self, payload):
+        """Operator/test surface: drain THIS node (cluster_utils.
+        drain_node, autoscaler downscale, chaos harness)."""
+        payload = payload or {}
+        return self.begin_drain(payload.get("reason", ""),
+                                payload.get("deadline_s"))
+
+    async def _preemption_watch_loop(self):
+        """Poll for a pending TPU maintenance event / preemption notice
+        (accelerators.tpu.maintenance_notice — GCE metadata in
+        production, the testing_preemption_notice file under chaos) and
+        self-drain when one fires."""
+        from ant_ray_tpu._private.accelerators import tpu as _tpu  # noqa: PLC0415
+
+        cfg = global_config()
+        if not _tpu.maintenance_watch_possible():
+            return   # no notice source on this host: don't poll forever
+        period = cfg.preemption_poll_interval_s
+        file_knob = bool(cfg.testing_preemption_notice)
+        while not self._stopping:
+            await asyncio.sleep(period)
+            if self._draining:
+                return            # terminal: nothing left to watch
+            try:
+                if file_knob:
+                    # File-existence probe: microseconds, safe inline.
+                    notice = _tpu.maintenance_notice()
+                else:
+                    # Metadata probe can stall on DNS — off the io loop.
+                    notice = await asyncio.to_thread(
+                        _tpu.maintenance_notice)
+            except Exception:  # noqa: BLE001 — detection is best-effort
+                continue
+            if notice is not None:
+                reason, deadline_s = notice
+                self.begin_drain(f"preemption notice: {reason}",
+                                 deadline_s or None)
+                return
+
     async def _debug_resources(self, _payload):
         """Resource-ledger dump for `art stack`-style debugging: who
         holds what, which workers are blocked, and each bundle pool."""
@@ -538,7 +634,7 @@ class NodeManager:
         last_gcs_ok = time.monotonic()
         while not self._stopping:
             snap = (tuple(sorted(self._available.items())),
-                    self._disk_full)
+                    self._disk_full, self._draining)
             if snap != last_snap:
                 last_snap = snap
                 version += 1
@@ -547,6 +643,9 @@ class NodeManager:
                 payload["view"] = {
                     "available_resources": dict(self._available),
                     "disk_full": self._disk_full,
+                    "draining": self._draining,
+                    "drain_reason": self._drain_reason,
+                    "drain_deadline": self._drain_deadline,
                     "version": version,
                 }
             try:
@@ -1230,13 +1329,16 @@ class NodeManager:
                 except asyncio.TimeoutError:
                     pass
 
-        if self._disk_full:
+        if self._disk_full or self._draining:
+            what = ("draining (announced departure)" if self._draining
+                    else "out of disk")
             if pinned_here:
                 return {"infeasible": True,
-                        "reason": "node-affinity target is out of disk"}
-            # Out-of-disk node: redirect rather than accept work that
-            # would need spill/log space this node doesn't have
-            # (ref: file_system_monitor.h "Out of disk" rejections).
+                        "reason": f"node-affinity target is {what}"}
+            # Redirect rather than accept work this node can't keep:
+            # out-of-disk nodes lack spill/log space (ref:
+            # file_system_monitor.h), draining nodes are about to die
+            # (a lease granted now would be killed mid-task).
             node = await gcs.call_async(
                 "SelectNode", {"resources": demand, "job_id": job_id,
                                "exclude": self.node_id,
@@ -1244,7 +1346,7 @@ class NodeManager:
             if node is not None and node.node_id != self.node_id:
                 return {"spill": node.address}
             return {"infeasible": True,
-                    "reason": "node out of disk and no alternative "
+                    "reason": f"node {what} and no alternative "
                               "node can satisfy the request"}
 
         if not self._feasible(demand):
@@ -2437,6 +2539,15 @@ def main():  # pragma: no cover — exercised via subprocess in tests
 
     def _term(*_a):
         nonlocal stop
+        # SIGTERM is an ANNOUNCED departure (the k8s/GCE preemption
+        # path): best-effort drain announce so the head marks the node
+        # DRAINING a beat before it vanishes; the announce is async and
+        # must not delay the exit below.
+        if not stop:
+            try:
+                manager.begin_drain("SIGTERM", deadline_s=5.0)
+            except Exception:  # noqa: BLE001 — exiting regardless
+                pass
         stop = True
 
     signal.signal(signal.SIGTERM, _term)

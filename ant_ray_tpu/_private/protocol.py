@@ -94,13 +94,19 @@ class _ChaosInjector:
     """Deterministic RPC fault injection (ref: src/ray/rpc/rpc_chaos.h:24).
 
     Config string: ``"method:prob,method2:prob"``; seeded RNG so failures are
-    reproducible across runs with the same seed.
+    reproducible across runs with the same seed.  A ``seed:<n>`` entry in the
+    spec overrides the ``seed`` argument — the channel the chaos harness
+    (util/chaos.py) uses to carry its schedule seed through ``_system_config``
+    into every daemon's injector.
     """
 
     def __init__(self, spec: str, seed: int = 0):
         self._probs: dict[str, float] = {}
         for part in filter(None, (spec or "").split(",")):
             method, prob = part.split(":")
+            if method == "seed":
+                seed = int(float(prob))
+                continue
             self._probs[method] = float(prob)
         self._rng = random.Random(seed)
 

@@ -105,6 +105,16 @@ class NodeInfo:
     # Filesystem-monitor state: a disk-full node keeps its membership
     # but is skipped by scheduling (ref: file_system_monitor.h).
     disk_full: bool = False
+    # Drain state (ref: DrainNode / NodeDeathInfo in gcs.proto —
+    # announced departures: TPU maintenance events, autoscaler
+    # downscale, SIGTERM).  A DRAINING node keeps running its current
+    # work but takes no new leases/bundles; schedulers skip it and
+    # controllers migrate gangs/replicas off it before the deadline.
+    draining: bool = False
+    drain_reason: str = ""
+    # Wall-clock (time.time()) by which the node expects to be gone;
+    # 0.0 = no announced deadline.
+    drain_deadline: float = 0.0
 
 
 # Actor lifecycle states (ref: gcs_actor_manager state machine)

@@ -153,6 +153,23 @@ class BulkServer:
         delay = global_config().testing_chunk_serve_delay_s
         if delay > 0:
             time.sleep(delay)
+        trunc = global_config().testing_chunk_truncate
+        if trunc > 0 and length > trunc:
+            # Chaos harness: a torn reply — declare and send fewer
+            # bytes than the requested chunk.  The puller's length
+            # check fails the pump, exercising stripe failover.
+            view = owner.store.chunk_view_pinned(
+                object_id, offset, trunc,
+                token := ("bulk", next(_bulk_token_counter)))
+            if view is None:
+                conn.sendall(_REPLY.pack(MISS))
+                return
+            try:
+                conn.sendall(_REPLY.pack(trunc))
+                conn.sendall(view)
+            finally:
+                owner.store.unpin(object_id, token)
+            return
         key = (object_id, offset, length)
         cached = owner.cache_get_chunk(key)
         if cached is not None:

@@ -39,6 +39,9 @@ METHODS: dict[str, dict] = {
     "Heartbeat": _m("gcs", "{node_id, view_version?, view?}",
                     "{resync?, commands?}"),
     "GetAllNodes": _m("gcs", "{}", "{node_id: NodeInfo}"),
+    "DrainNode": _m("gcs", "{node_id, reason?, deadline?}",
+                    "bool (node enters DRAINING: schedulers skip it, "
+                    "Serve/Train migrate off it)"),
     "KVPut": _m("gcs", "{key, value, overwrite?}", "bool"),
     "KVGet": _m("gcs", "{key}", "bytes|None"),
     "KVDel": _m("gcs", "{key}", "bool"),
@@ -138,6 +141,9 @@ METHODS: dict[str, dict] = {
     "ReadDone": _m("node", "{object_id, pin_token}", "bool"),
     "RenewPins": _m("node", "{pins: [(oid, token)], ttl}", "{gone: []}"),
     "GetNodeInfo": _m("node", "{}", "NodeInfo"),
+    "NotifyDrain": _m("node", "{reason?, deadline_s?}",
+                      "bool (daemon self-drains + announces via "
+                      "DrainNode; the operator/chaos drain surface)"),
     "DebugResources": _m("node", "{}",
                          "{available, bundles, workers} ledger dump"),
     "GetNodeMetrics": _m("node", "{}", "{gauges}"),

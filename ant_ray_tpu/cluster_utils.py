@@ -73,6 +73,16 @@ class Cluster:
         self._procs[0] = proc
         assert address == self.gcs_address
 
+    def drain_node(self, address: str, reason: str = "preemption",
+                   deadline_s: float = 30.0) -> None:
+        """Inject a drain/preemption notice into one node daemon (the
+        announced-departure scenario: a TPU maintenance event fires
+        minutes before the host dies).  The node stops taking new
+        leases; Serve and Train migrate off it."""
+        self._pool.get(address).call(
+            "NotifyDrain", {"reason": reason, "deadline_s": deadline_s},
+            timeout=10)
+
     def remove_node(self, address: str, graceful: bool = False) -> None:
         """Kill a node daemon (simulates node failure when not graceful)."""
         index = self._node_addresses.index(address)

@@ -581,6 +581,14 @@ class ServeController:
         self._scaler = threading.Thread(
             target=self._scale_loop, daemon=True, name="serve-scaler")
         self._scaler.start()
+        # Drain plane: replicas on a DRAINING node (announced TPU
+        # preemption / maintenance event) are replaced proactively —
+        # a new replica passes readiness elsewhere, then the doomed one
+        # drains its in-flight work via _drain_then_kill.
+        self._drainer = threading.Thread(
+            target=self._node_drain_loop, daemon=True,
+            name="serve-drain-watch")
+        self._drainer.start()
 
     def _bump_version_locked(self, entry: dict) -> None:
         entry["version"] = entry.get("version", 0) + 1
@@ -610,7 +618,8 @@ class ServeController:
                     return {}
                 self._version_cv.wait(remaining)
 
-    def _make_replicas(self, deployment: Deployment, args, kwargs, n: int):
+    def _make_replicas(self, deployment: Deployment, args, kwargs, n: int,
+                       timeout: float | None = None):
         art = _art()
         # Default is SERIALIZED user code (max_concurrency=1, matching
         # plain actors).  Autoscaling needs overlapping requests for a
@@ -627,7 +636,23 @@ class ServeController:
             replica_cls.remote(deployment.cls_or_fn, args, kwargs)
             for _ in range(n)
         ]
-        art.get([r.health.remote() for r in replicas])  # readiness gate
+        try:
+            # Readiness gate.  ``timeout`` lets retry-loop callers (the
+            # drain watcher) bound an unplaceable replica instead of
+            # wedging their thread forever.
+            art.get([r.health.remote() for r in replicas],
+                    timeout=timeout)
+        except BaseException:
+            # Never leak half-placed replicas: handles aren't reaped on
+            # GC, and a retrying caller would compound the leak — worse,
+            # the leaked actors hold exactly the capacity the retry
+            # needs, guaranteeing it never succeeds.
+            for r in replicas:
+                try:
+                    art.kill(r)
+                except Exception:  # noqa: BLE001
+                    pass
+            raise
         return replicas
 
     def deploy(self, deployment: Deployment, args, kwargs) -> dict:
@@ -841,6 +866,81 @@ class ServeController:
             threading.Thread(target=self._drain_then_kill,
                              args=(replica,), daemon=True).start()
 
+    # -------------------------------------------------- node drain plane
+
+    def _node_drain_loop(self):
+        """Watch for DRAINING nodes (announced preemption/maintenance)
+        and migrate their replicas: spin up replacements — the
+        scheduler already skips draining nodes — and hand the doomed
+        replicas to the existing ``_drain_then_kill`` machinery so
+        in-flight requests finish before the node dies."""
+        art = _art()
+        while not self._stopping:
+            time.sleep(1.0)
+            try:
+                draining = {n["NodeID"] for n in art.nodes()
+                            if n["Alive"] and n.get("Draining")}
+                if not draining:
+                    continue
+                from ant_ray_tpu.api import global_worker  # noqa: PLC0415
+
+                on_node = {rec["actor_id"]: rec.get("node_id")
+                           for rec in global_worker.runtime._gcs.call(
+                               "ListActors", retries=3)
+                           if rec.get("state") != "DEAD"}
+            except Exception:  # noqa: BLE001 — control plane blip
+                continue
+            with self._lock:
+                names = list(self._deployments)
+            for name in names:
+                try:
+                    self._migrate_off_draining(name, draining, on_node)
+                except Exception:  # noqa: BLE001 — retried next tick
+                    pass
+
+    def _migrate_off_draining(self, name: str, draining: set,
+                              on_node: dict) -> None:
+        art = _art()
+        with self._lock:
+            entry = self._deployments.get(name)
+            if entry is None:
+                return
+            doomed = [r for r in entry["replicas"]
+                      if on_node.get(r.actor_id.hex()) in draining]
+            deployment, args, kwargs = (entry["deployment"],
+                                        entry["args"], entry["kwargs"])
+        if not doomed:
+            return
+        # Replacements pass their readiness gate BEFORE any doomed
+        # replica starts draining — the serving count never dips (the
+        # same no-dip invariant as _rolling_redeploy).
+        fresh = self._make_replicas(deployment, args, kwargs, len(doomed),
+                                    timeout=60.0)
+        swapped = []
+        with self._lock:
+            entry = self._deployments.get(name)
+            if entry is None:              # deleted mid-migration
+                for r in fresh:
+                    try:
+                        art.kill(r)
+                    except Exception:  # noqa: BLE001
+                        pass
+                return
+            for old_r, new_r in zip(doomed, fresh):
+                try:
+                    idx = entry["replicas"].index(old_r)
+                except ValueError:   # autoscaler removed it meanwhile
+                    entry["replicas"].append(new_r)
+                    entry["ongoing"].append(0)
+                    continue
+                entry["replicas"][idx] = new_r
+                entry["ongoing"][idx] = 0
+                swapped.append(old_r)
+            self._bump_version_locked(entry)
+        for replica in swapped:
+            threading.Thread(target=self._drain_then_kill,
+                             args=(replica,), daemon=True).start()
+
     def _drain_then_kill(self, replica):
         art = _art()
         # Handles learn about the shrink via the long-poll push within
@@ -898,18 +998,28 @@ class ServeController:
 
     def shutdown_all(self):
         art = _art()
-        for entry in self._deployments.values():
-            for r in entry["replicas"]:
-                try:
-                    art.kill(r)
-                except Exception:  # noqa: BLE001
-                    pass
+        # Stop the background scaler/drain watchers first: a watcher
+        # migrating replicas mid-shutdown would resurrect actors the
+        # loop below is killing.
+        self._stopping = True
+        # Snapshot + clear UNDER the lock: an in-flight drain migration
+        # swaps its fresh replicas into the entry under this same lock,
+        # so they land either in the snapshot (killed below) or after
+        # the clear (its deleted-entry branch kills them) — never in a
+        # leaked gap between an unlocked kill loop and the clear.
         with self._lock:
+            doomed = [r for entry in self._deployments.values()
+                      for r in entry["replicas"]]
             self._deployments.clear()
             # Wake parked listeners: their deployments now read as
             # deleted, so listener threads exit instead of waiting out
             # the poll window against a dead controller.
             self._version_cv.notify_all()
+        for r in doomed:
+            try:
+                art.kill(r)
+            except Exception:  # noqa: BLE001
+                pass
         for proxy in (self._proxy, getattr(self, "_grpc_proxy", None)):
             if proxy is not None:
                 try:

@@ -10,6 +10,7 @@ from ant_ray_tpu.train.config import (
     ScalingConfig,
 )
 from ant_ray_tpu.train.session import (
+    PreemptionInterrupt,
     get_checkpoint,
     get_context,
     get_dataset_shard,
@@ -27,6 +28,7 @@ __all__ = [
     "DataParallelTrainer",
     "FailureConfig",
     "JaxTrainer",
+    "PreemptionInterrupt",
     "Result",
     "RunConfig",
     "ScalingConfig",

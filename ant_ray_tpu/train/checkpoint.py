@@ -4,23 +4,67 @@ storage.py; orbax replaces torch.save as the native TPU path)."""
 
 from __future__ import annotations
 
+import io
+import logging
 import os
 import shutil
+import tarfile
+import tempfile
+import uuid
 from dataclasses import dataclass
+
+logger = logging.getLogger(__name__)
+
+# Non-final artifacts of the atomic-save / replica-materialize dance;
+# restore scans MUST ignore them (and may sweep stale ones).
+_TMP_MARKERS = (".tmp-", ".old-")
 
 
 @dataclass(frozen=True)
 class Checkpoint:
-    """A handle to a checkpoint directory (ref: ray.train.Checkpoint)."""
+    """A handle to a checkpoint directory (ref: ray.train.Checkpoint).
+
+    ``replica``: optional ObjectRef of the packed directory in the
+    in-cluster object store (CheckpointConfig.replicate).  When the
+    directory path is not visible from the reading process's node (no
+    shared storage), ``as_directory``/``to_pytree`` materialize the
+    checkpoint from the replica — pulled over the bulk transfer
+    channel at object-plane bandwidth."""
 
     path: str
+    replica: "object | None" = None
 
     @classmethod
     def from_directory(cls, path: str) -> "Checkpoint":
         return cls(path=os.path.abspath(path))
 
+    def with_replica(self, ref) -> "Checkpoint":
+        return Checkpoint(path=self.path, replica=ref)
+
     def as_directory(self) -> str:
-        return self.path
+        if os.path.isdir(self.path) or self.replica is None:
+            return self.path
+        return self._materialize_replica()
+
+    def _materialize_replica(self) -> str:
+        """Unpack the object-store replica into a node-local cache dir
+        (shared by colocated readers).  Keyed by the replica ref's
+        object id, not just the checkpoint path — a later fit reusing
+        the same storage_path/name/index must never restore a previous
+        run's weights from a stale cache entry."""
+        import ant_ray_tpu as art  # noqa: PLC0415
+
+        dest = os.path.join(
+            tempfile.gettempdir(), "art_ckpt_replicas",
+            f"{self.path.strip(os.sep).replace(os.sep, '_')}"
+            f"-{self.replica.hex()[:16]}")
+        if os.path.isdir(dest):
+            return dest
+        data = art.get(self.replica)
+        unpack_checkpoint(data, dest)
+        logger.info("materialized checkpoint replica for %s (%d bytes)",
+                    self.path, len(data))
+        return dest
 
     # ---- jax pytree convenience (orbax)
 
@@ -30,22 +74,94 @@ class Checkpoint:
         return cls(path=os.path.abspath(path))
 
     def to_pytree(self, abstract_tree=None):
-        return load_pytree(self.path, abstract_tree)
+        return load_pytree(self.as_directory(), abstract_tree)
 
 
 def save_pytree(tree, path: str) -> None:
+    """Atomic orbax save: write to a ``.tmp-`` sibling, then rename
+    into place — a crash mid-save can never destroy the previous
+    checkpoint at ``path`` (the old destroy-then-save order lost it),
+    and a torn write is never visible under the final name.  Restore
+    scans ignore ``.tmp-``/``.old-`` leftovers."""
     import orbax.checkpoint as ocp  # noqa: PLC0415
 
     path = os.path.abspath(path)
+    nonce = uuid.uuid4().hex[:8]
+    tmp = f"{path}.tmp-{nonce}"
+    try:
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(tmp, tree)
+        if os.path.exists(path):
+            # Two renames, no window where neither copy exists: the old
+            # dir steps aside (ignored by restores), the complete new
+            # one takes the name, then the old is reaped.
+            old = f"{path}.old-{nonce}"
+            os.rename(path, old)
+            os.rename(tmp, path)
+            shutil.rmtree(old, ignore_errors=True)
+        else:
+            os.rename(tmp, path)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
+def pack_checkpoint_dir(path: str) -> bytes:
+    """Checkpoint directory -> one replicable blob (tar, uncompressed —
+    checkpoints are mostly incompressible array bytes and the object
+    plane moves them at wire speed)."""
+    buf = io.BytesIO()
+    with tarfile.open(fileobj=buf, mode="w") as tar:
+        tar.add(path, arcname=".")
+    return buf.getvalue()
+
+
+def unpack_checkpoint(data: bytes, dest: str) -> str:
+    """Atomically materialize a packed checkpoint at ``dest`` (unpack
+    into a tmp sibling, rename; a concurrent reader either sees the
+    complete directory or none)."""
+    dest = os.path.abspath(dest)
+    os.makedirs(os.path.dirname(dest), exist_ok=True)
+    tmp = f"{dest}.tmp-{uuid.uuid4().hex[:8]}"
+    try:
+        with tarfile.open(fileobj=io.BytesIO(data)) as tar:
+            try:
+                tar.extractall(tmp, filter="data")
+            except TypeError:       # pre-3.12 tarfile: no filter arg
+                tar.extractall(tmp)  # noqa: S202 — self-produced blob
+        try:
+            os.rename(tmp, dest)
+        except OSError:
+            if not os.path.isdir(dest):   # lost a race to a peer: fine
+                raise
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return dest
+
+
+def _adopt_orphaned_old(path: str) -> None:
+    """Close save_pytree's two-rename crash window: a kill between
+    `rename(path, old)` and `rename(tmp, path)` leaves the complete
+    previous checkpoint ONLY under the ``.old-`` name — adopt it back
+    so the acked steps it represents are not lost."""
     if os.path.exists(path):
-        shutil.rmtree(path)
-    with ocp.PyTreeCheckpointer() as ckptr:
-        ckptr.save(path, tree)
+        return
+    import glob as _glob  # noqa: PLC0415
+
+    orphans = sorted(_glob.glob(path + ".old-*"), key=os.path.getmtime)
+    if orphans:
+        try:
+            os.rename(orphans[-1], path)
+            logger.warning("adopted orphaned checkpoint %s -> %s "
+                           "(crash mid-swap)", orphans[-1], path)
+        except OSError:   # lost a race to a concurrent adopter: fine
+            pass
 
 
 def load_pytree(path: str, abstract_tree=None):
     import orbax.checkpoint as ocp  # noqa: PLC0415
 
+    _adopt_orphaned_old(os.path.abspath(path))
     with ocp.PyTreeCheckpointer() as ckptr:
         if abstract_tree is not None:
             return ckptr.restore(os.path.abspath(path),
@@ -86,6 +202,13 @@ class CheckpointManager:
                         self._token = f.read().strip()
                 except OSError:
                     self._token = ""
+            # A crash inside save_pytree's two-rename swap can leave
+            # the newest complete checkpoint only under its .old- name
+            # — rescue those before scanning (see _adopt_orphaned_old).
+            for name in os.listdir(storage_path):
+                base, sep, _rest = name.partition(".old-")
+                if sep and base.startswith("checkpoint_"):
+                    _adopt_orphaned_old(os.path.join(storage_path, base))
             # Restore — OPT-IN (a recreated controller after controller
             # death): adopt this fit's checkpoints, identified by token.
             for name in sorted(os.listdir(storage_path)):
@@ -149,6 +272,13 @@ class CheckpointManager:
                 "could not stamp run token into %s (%s); this "
                 "checkpoint will not be adopted by a restore",
                 checkpoint.path, e)
+        # Only the LATEST checkpoint keeps an object-store replica:
+        # dropping older entries' refs frees their packed blobs, so a
+        # keep-all run doesn't pin every checkpoint in store memory
+        # (recovery only ever restores the newest).
+        for i, stale in enumerate(self._checkpoints):
+            if getattr(stale, "replica", None) is not None:
+                self._checkpoints[i] = Checkpoint(path=stale.path)
         self._checkpoints.append(checkpoint)
         if self._num_to_keep is not None:
             # Normalized containment check: checkpoint paths are

@@ -45,6 +45,14 @@ class FailureConfig:
     # (node loss); a separate budget — multiplying it into max_failures
     # would turn 2 worker retries into 9 gang launches.
     max_controller_failures: int = 1
+    # Base wait between group-restart attempts after a FAILURE: gives
+    # failure detection a beat so the next capacity read sees the dead
+    # node as dead.  Grows exponentially (x2 per consecutive failure,
+    # capped at 16x base, +/-20% jitter so restarting gangs don't
+    # stampede the scheduler in lockstep).  Drain-triggered restarts
+    # skip the wait entirely — the workers checkpointed and exited
+    # cleanly, and the draining node is already fenced off.
+    group_restart_backoff_s: float = 2.0
 
 
 @dataclasses.dataclass
@@ -81,7 +89,27 @@ class DataConfig:
 
 @dataclasses.dataclass
 class CheckpointConfig:
+    """Checkpoint retention + durability plane.
+
+    ``async_save``: reported pytree checkpoints are saved by a
+    controller-side background thread instead of inside the report RPC,
+    so the gang's step loop never blocks on orbax/storage I/O.  Saves
+    complete in report order; restore (group restart / fit result)
+    waits for in-flight saves, and a torn save is never adopted — the
+    on-disk rename and the run-token stamp both happen only after a
+    complete write.
+
+    ``replicate``: each completed checkpoint is also packed into the
+    in-cluster object store (pulled over the bulk transfer channel,
+    striped across holders) — recovery then restores at object-plane
+    bandwidth from any node, and no shared ``storage_path`` is needed:
+    a restarted worker whose node can't see the original directory
+    materializes the checkpoint from the replica.
+    """
+
     num_to_keep: int | None = None      # None = keep all
+    async_save: bool = True
+    replicate: bool = True
 
 
 @dataclasses.dataclass

@@ -13,14 +13,34 @@ failure_handling/).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 
-from ant_ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ant_ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    pack_checkpoint_dir,
+    save_pytree,
+)
 from ant_ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
-from ant_ray_tpu.train.session import TrainContext, _set_context
+from ant_ray_tpu.train.session import (
+    PreemptionInterrupt,
+    TrainContext,
+    _set_context,
+)
 
 logger = logging.getLogger(__name__)
+
+# Sentinel return of a worker that unwound on a drain notice (its last
+# report's checkpoint is registered; nothing was lost).
+_PREEMPTED = "__preempted__"
+
+
+class _DrainRestart(Exception):
+    """Group interrupted by a node drain — relaunch off the draining
+    node WITHOUT consuming a failure-budget attempt or a backoff wait
+    (the workers checkpointed and exited cleanly)."""
 
 
 class TrainWorker:
@@ -86,6 +106,10 @@ class TrainWorker:
             if loop_config is None:
                 return loop_fn()
             return loop_fn(loop_config)
+        except PreemptionInterrupt:
+            # Controlled drain exit: the controller told report() to
+            # stop; the checkpoint that report carried is registered.
+            return _PREEMPTED
         finally:
             _set_context(None)  # type: ignore[arg-type]
 
@@ -120,6 +144,16 @@ class TrainController:
         # must not reuse their directories).
         self._report_index = self._ckpt_manager.next_index
         self._lock = threading.Lock()
+        # Drain plane: set by the drain monitor when a node hosting the
+        # gang got a preemption notice; report() acks carry it to every
+        # rank, whose next report becomes the zero-step-loss exit.
+        self._drain_stop = False
+        self._drain_deadline = 0.0
+        # Async checkpoint plane: one background save thread (order-
+        # preserving) + in-flight save futures the restart/result paths
+        # flush before reading `latest`.
+        self._save_pool = None
+        self._pending_saves: list = []
 
     # ---- called by workers (concurrently with run())
 
@@ -132,12 +166,7 @@ class TrainController:
                 self._latest_metrics = metrics
                 self._metrics_history.append(metrics)
                 if checkpoint is not None:
-                    if not isinstance(checkpoint, Checkpoint):
-                        checkpoint = Checkpoint.from_pytree(
-                            checkpoint,
-                            self._ckpt_manager.next_checkpoint_dir(
-                                self._report_index))
-                    self._ckpt_manager.register(checkpoint)
+                    self._accept_checkpoint(checkpoint)
                 self._report_index += 1
         # Emit once per step, not once per rank-report: N ranks each
         # re-aggregating N records would make telemetry cost quadratic
@@ -146,7 +175,102 @@ class TrainController:
         # subset of ranks runs a profiler).
         if step_record is not None and rank == min(self._step_records):
             self._emit_step_gauges()
-        return True
+        # The ack doubles as the drain channel (see session.report).
+        return {"ok": True, "stop": self._drain_stop}
+
+    # ---- checkpoint save/replication (CheckpointConfig knobs)
+
+    def _accept_checkpoint(self, checkpoint) -> None:
+        """Queue or perform the save+replicate+register of a reported
+        checkpoint.  Called under self._lock (report path)."""
+        cfg = self._run_config.checkpoint_config
+        if isinstance(checkpoint, Checkpoint):
+            # Already a directory handle: nothing to save off-thread —
+            # but the optional replication pack is real I/O, and a
+            # mixed run (pytree reports queued behind this one) must
+            # register in REPORT order or `latest` regresses when the
+            # queued save lands later.  Under async_save both concerns
+            # route it through the same single-thread pool.
+            if not getattr(cfg, "async_save", True):
+                self._finish_checkpoint(checkpoint,
+                                        registered_under_lock=True)
+                return
+            self._ensure_save_pool()
+            self._pending_saves = [f for f in self._pending_saves
+                                   if not f.done()]
+            self._pending_saves.append(
+                self._save_pool.submit(self._finish_checkpoint,
+                                       checkpoint))
+            return
+        path = self._ckpt_manager.next_checkpoint_dir(self._report_index)
+        if not getattr(cfg, "async_save", True):
+            save_pytree(checkpoint, path)
+            self._finish_checkpoint(Checkpoint.from_directory(path),
+                                    registered_under_lock=True)
+            return
+        # Background save: the report RPC (and with it the gang's step
+        # loop) returns immediately; the single-thread pool preserves
+        # report order, and `latest` only ever sees COMPLETED saves —
+        # a controller restart flushes the queue first, so restore can
+        # never adopt a torn save.
+        self._ensure_save_pool()
+
+        def _save(tree=checkpoint, path=path):
+            try:
+                save_pytree(tree, path)
+            except Exception:  # noqa: BLE001 — a failed save must not
+                logger.exception(   # kill the save thread; the PREVIOUS
+                    "background checkpoint save to %s failed", path)
+                return              # checkpoint stays `latest`
+            self._finish_checkpoint(Checkpoint.from_directory(path))
+
+        self._pending_saves = [f for f in self._pending_saves
+                               if not f.done()]
+        self._pending_saves.append(self._save_pool.submit(_save))
+
+    def _ensure_save_pool(self) -> None:
+        if self._save_pool is None:
+            from concurrent.futures import ThreadPoolExecutor  # noqa: PLC0415
+
+            self._save_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="art-ckpt-save")
+
+    def _finish_checkpoint(self, ckpt: Checkpoint,
+                           registered_under_lock: bool = False) -> None:
+        """Replicate (best-effort) then register a COMPLETED save."""
+        if getattr(self._run_config.checkpoint_config, "replicate", True) \
+                and not os.path.isdir(ckpt.path):
+            # A directory handle the controller can't see (worker-local
+            # path, no shared storage): nothing to pack from here —
+            # skip quietly rather than raise-and-warn every report.
+            logger.debug("checkpoint %s not visible from the controller; "
+                         "skipping replication", ckpt.path)
+        elif getattr(self._run_config.checkpoint_config, "replicate", True):
+            try:
+                import ant_ray_tpu as art  # noqa: PLC0415
+
+                ckpt = ckpt.with_replica(
+                    art.put(pack_checkpoint_dir(ckpt.path)))
+            except Exception as e:  # noqa: BLE001 — replication is a
+                # durability bonus; the on-disk copy is the authority.
+                logger.warning("checkpoint replication failed: %s", e)
+        if registered_under_lock:
+            self._ckpt_manager.register(ckpt)
+        else:
+            with self._lock:
+                self._ckpt_manager.register(ckpt)
+
+    def _flush_checkpoints(self, timeout: float = 300.0) -> None:
+        """Wait for in-flight background saves — every path that READS
+        ``latest`` (group restart, fit result) flushes first, so a
+        restore reflects every acked report."""
+        with self._lock:
+            pending, self._pending_saves = self._pending_saves, []
+        for fut in pending:
+            try:
+                fut.result(timeout=timeout)
+            except Exception:  # noqa: BLE001 — logged by the save job
+                pass
 
     # ---- step telemetry (observability/step_profiler.py records)
 
@@ -256,18 +380,35 @@ class TrainController:
         failure_config: FailureConfig = self._run_config.failure_config
         attempts = failure_config.max_failures + 1
         last_error: Exception | None = None
-        for attempt in range(attempts):
+        failures = 0
+        incarnation = 0       # every launch, drains included — feeds
+        while True:           # attempt-unique collective-group names
             world = policy.workers_for_attempt(
                 self._scaling, art.available_resources(),
-                art.cluster_resources(), attempt=attempt)
+                art.cluster_resources(), attempt=failures)
             try:
-                self._run_worker_group(art, self_handle, world, attempt)
+                self._run_worker_group(art, self_handle, world,
+                                       incarnation)
                 return self._result(error=None)
+            except _DrainRestart as e:
+                # An ANNOUNCED departure costs neither a failure-budget
+                # attempt nor a backoff wait: every rank checkpointed
+                # through its last report and exited cleanly, and the
+                # draining node is already fenced off the scheduler —
+                # relaunch immediately, resuming at the exact step.
+                incarnation += 1
+                logger.info(
+                    "worker group drained (%s); relaunching off the "
+                    "draining node (failure budget untouched: %d/%d)",
+                    e, failures, attempts - 1)
+                continue
             # RuntimeError covers gang-reservation failures (an
             # infeasible PG after a node died is an attempt, not a
             # crash of the controller itself).
             except (art.exceptions.ArtError, RuntimeError) as e:
                 last_error = e
+                failures += 1
+                incarnation += 1
                 if (hasattr(policy, "note_unplaceable")
                         and isinstance(e, RuntimeError)
                         and ("reserve" in str(e)
@@ -277,13 +418,29 @@ class TrainController:
                     policy.note_unplaceable(world)
                 logger.warning(
                     "worker group (world=%d) failed (attempt %d/%d): %s",
-                    world, attempt + 1, attempts, e)
+                    world, failures, attempts, e)
+                if failures >= attempts:
+                    return self._result(error=last_error)
                 # Give failure detection a beat: the next attempt's
                 # capacity read must see the dead node as dead, or an
                 # elastic resize would re-request the old world size.
-                time.sleep(2.0 if getattr(self._scaling, "min_workers", 0)
-                           else 0.5)
-        return self._result(error=last_error)
+                # Capped exponential backoff + jitter (FailureConfig.
+                # group_restart_backoff_s) so a crash-looping gang
+                # doesn't hammer the scheduler at a fixed cadence.
+                time.sleep(self._restart_backoff_s(failure_config,
+                                                   failures))
+
+    def _restart_backoff_s(self, failure_config, failures: int) -> float:
+        import random  # noqa: PLC0415
+
+        base = getattr(failure_config, "group_restart_backoff_s", 2.0)
+        if not getattr(self._scaling, "min_workers", 0):
+            # Fixed-size groups don't resize by a capacity read, so
+            # they keep the historical snappy retry: a quarter of the
+            # base (0.5s at the default), scaling with the knob.
+            base = base / 4.0
+        delay = min(base * (2 ** (failures - 1)), base * 16, 60.0)
+        return delay * random.uniform(0.8, 1.2)
 
     def _run_worker_group(self, art, self_handle, world: int | None = None,
                           attempt: int = 0):
@@ -291,10 +448,13 @@ class TrainController:
 
         scaling = self._scaling
         world = world if world is not None else scaling.num_workers
+        self._drain_stop = False      # fresh gang, fresh drain state
+        self._drain_deadline = 0.0
         pg, slice_pg = self._reserve_gang(scaling, world)
         self._worker_pg = pg          # set BEFORE anything can fail, so
         self._worker_slice = slice_pg  # the finally always releases it
         workers = []
+        drain_watch_stop = threading.Event()
         try:
             base_opts = {"resources": scaling.worker_resources(),
                          "num_cpus": 0}
@@ -329,6 +489,10 @@ class TrainController:
                     workers[0].propose_coordinator.remote())
             art.get([w.setup_distributed.remote(coordinator)
                      for w in workers])
+            # Adopt every acked report before reading `latest` — an
+            # async save still in flight from the PREVIOUS incarnation
+            # must land first or the resume point regresses.
+            self._flush_checkpoints()
             latest = self._ckpt_manager.latest
             shards = self._make_dataset_shards(art, world)
             run_refs = [
@@ -336,16 +500,46 @@ class TrainController:
                              self_handle, latest, attempt, shards[rank])
                 for rank, w in enumerate(workers)
             ]
+            # Preemption watcher: a drain notice on any node hosting a
+            # gang worker flips _drain_stop, which the report acks
+            # relay to every rank (see session.report).
+            threading.Thread(
+                target=self._watch_for_drain,
+                args=(art, drain_watch_stop,
+                      {f"{self._run_config.pg_name()}-w{rank}-{tag}"
+                       for rank in range(world)}),
+                daemon=True, name="art-train-drain-watch").start()
             # Fail FAST on the first rank failure (ref: worker_group
             # poll_status aborts the group on any error) — a plain
             # gather would sit behind the healthy ranks' remaining work
             # before surfacing a death, delaying recovery by minutes.
+            # The short wait timeout is the drain poll: on _drain_stop
+            # the loop keeps collecting ranks until the drain deadline,
+            # then abandons stragglers (the finally kills them — their
+            # progress is already checkpointed through rank 0).
             pending = list(run_refs)
+            interrupted = False
             while pending:
                 done, pending = art.wait(pending, num_returns=1,
-                                         timeout=None)
-                art.get(done[0])
+                                         timeout=0.5)
+                if done and art.get(done[0]) == _PREEMPTED:
+                    interrupted = True
+                if self._drain_stop and pending and \
+                        time.time() >= self._drain_deadline:
+                    logger.warning(
+                        "drain deadline passed with %d rank(s) still "
+                        "running; abandoning them (progress is "
+                        "checkpointed)", len(pending))
+                    interrupted = True
+                    break
+            # Restart ONLY if a rank actually unwound on the notice: a
+            # drain observed after every rank already finished its loop
+            # is a completed fit, not one to re-execute.
+            if self._drain_stop and interrupted:
+                raise _DrainRestart(
+                    "preemption notice on a gang node")
         finally:
+            drain_watch_stop.set()
             for w in workers:
                 try:
                     art.kill(w)
@@ -353,6 +547,54 @@ class TrainController:
                     pass
             self._release_gang()
             self._kill_data_coordinators(art)
+
+    def _watch_for_drain(self, art, stop: threading.Event,
+                         worker_names: set) -> None:
+        """Poll node drain state while a gang runs; when a DRAINING
+        node hosts one of this gang's workers, order the proactive
+        stop.  Every rank then unwinds at its next report — WITH its
+        checkpoint registered — and the control loop relaunches the
+        gang on the remaining nodes before the announced deadline."""
+        from ant_ray_tpu.api import global_worker  # noqa: PLC0415
+
+        while not stop.wait(0.5):
+            if self._drain_stop:
+                return
+            try:
+                draining = {n["NodeID"]: n.get("DrainDeadline", 0.0)
+                            for n in art.nodes()
+                            if n["Alive"] and n.get("Draining")}
+                if not draining:
+                    continue
+                gcs = global_worker.runtime._gcs
+                hit = [rec for rec in gcs.call("ListActors", retries=3)
+                       if (rec.get("name") or "") in worker_names
+                       and rec.get("state") != "DEAD"
+                       and rec.get("node_id") in draining]
+                if not hit:
+                    continue
+                deadline = min(filter(None,
+                                      (draining[r["node_id"]]
+                                       for r in hit)),
+                               default=0.0)
+                # A watcher from a PREVIOUS incarnation can reach here
+                # seconds after its gang ended (ListActors retries) —
+                # it must not drain-stop the fresh gang, which was
+                # already placed off the draining node.
+                if stop.is_set():
+                    return
+                # No announced deadline -> a generous local one: the
+                # stop order still reaches ranks at their next report.
+                self._drain_deadline = deadline or (time.time() + 30.0)
+                self._drain_stop = True
+                logger.warning(
+                    "drain notice on node(s) hosting %d gang worker(s); "
+                    "ordering proactive checkpoint + migration "
+                    "(deadline in %.0fs)", len(hit),
+                    self._drain_deadline - time.time())
+                return
+            except Exception as e:  # noqa: BLE001 — monitoring only
+                logger.debug("drain watch poll failed: %s", e)
 
     def _make_dataset_shards(self, art, world: int) -> list:
         """Per-rank {name: DataIterator} from the trainer's datasets=.
@@ -472,6 +714,9 @@ class TrainController:
     def _result(self, error):
         from ant_ray_tpu.train.config import Result  # noqa: PLC0415
 
+        # Every acked report's checkpoint must be visible in the
+        # result, async saves included.
+        self._flush_checkpoints()
         return Result(
             metrics=dict(self._latest_metrics),
             checkpoint=self._ckpt_manager.latest,

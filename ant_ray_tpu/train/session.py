@@ -34,6 +34,19 @@ class TrainContext:
     _report_lock: threading.Lock = field(default_factory=threading.Lock)
 
 
+class PreemptionInterrupt(BaseException):
+    """Raised inside a train loop by :func:`report` when the controller
+    has ordered a proactive drain stop (a node hosting the gang got a
+    preemption/maintenance notice).  The checkpoint carried by that
+    very report was already registered, so unwinding here loses zero
+    steps — the controller relaunches the gang off the draining node
+    and resumes from it.
+
+    Derives from ``BaseException`` so a user loop's broad
+    ``except Exception`` cannot swallow the drain; the worker shim
+    (TrainWorker.run) catches it."""
+
+
 _ctx = threading.local()
 
 
@@ -71,8 +84,14 @@ def report(metrics: dict, checkpoint=None) -> None:
             metrics["_step_record"] = last.as_dict()
         prof.flush()
     with ctx._report_lock:
-        art.get(ctx.controller.report_from_worker.remote(
+        reply = art.get(ctx.controller.report_from_worker.remote(
             ctx.world_rank, metrics, checkpoint))
+    # The ack doubles as the drain channel: when the controller has a
+    # preemption notice for this gang's node(s), it replies stop=True —
+    # the checkpoint this report carried is already registered, so
+    # unwinding NOW is the zero-step-loss exit point.
+    if isinstance(reply, dict) and reply.get("stop"):
+        raise PreemptionInterrupt
 
 
 def get_dataset_shard(name: str = "train", device_feed: dict | None = None):

@@ -149,6 +149,33 @@ class JaxTrainer:
         return result
 
 
+    # A controller usually dies WITH its node — often in the same event
+    # (GCS restart, head blip) that makes the first cleanup RPCs fail.
+    # The GCS persists and daemons reconnect well within this window,
+    # so the sweeps retry with backoff instead of leaking the gang.
+    _CLEANUP_RETRY_WINDOW_S = 30.0
+
+    @classmethod
+    def _retry_cleanup(cls, what: str, sweep) -> None:
+        """Run ``sweep`` until it succeeds or the GCS-restart window
+        closes (capped exponential backoff between tries)."""
+        import time as _time  # noqa: PLC0415
+
+        deadline = _time.monotonic() + cls._CLEANUP_RETRY_WINDOW_S
+        delay = 0.25
+        while True:
+            try:
+                sweep()
+                return
+            except Exception as e:  # noqa: BLE001 — GCS may be restarting
+                if _time.monotonic() >= deadline:
+                    logger.warning("%s failed (giving up after %.0fs): %s",
+                                   what, cls._CLEANUP_RETRY_WINDOW_S, e)
+                    return
+                logger.info("%s hit %s; retrying in %.2fs", what, e, delay)
+                _time.sleep(delay)
+                delay = min(delay * 2, 4.0)
+
     def _release_leaked_groups(self, art) -> None:
         """A controller that died with its node never ran its PG
         release — remove this run's leftover reservations so the
@@ -162,7 +189,8 @@ class JaxTrainer:
         )
 
         pg_name = self._run_config.pg_name()
-        try:
+
+        def sweep():
             my_job_hex = self._my_job_hex()
             for pg_hex, rec in placement_group_table().items():
                 if rec.get("name") != pg_name or \
@@ -174,8 +202,8 @@ class JaxTrainer:
                     id=PlacementGroupID.from_hex(pg_hex),
                     bundles=tuple(rec.get("bundles", ())),
                     strategy=rec.get("strategy", "PACK")))
-        except Exception as e:  # noqa: BLE001 — best-effort cleanup
-            logger.warning("leaked placement-group cleanup failed: %s", e)
+
+        self._retry_cleanup("leaked placement-group cleanup", sweep)
 
     @staticmethod
     def _my_job_hex() -> str | None:
@@ -200,7 +228,8 @@ class JaxTrainer:
         from ant_ray_tpu.api import global_worker  # noqa: PLC0415
 
         prefix = f"{self._run_config.pg_name()}-w"
-        try:
+
+        def sweep():
             my_job_hex = self._my_job_hex()
             gcs = global_worker.runtime._gcs
             for rec in gcs.call("ListActors", retries=3):
@@ -212,8 +241,8 @@ class JaxTrainer:
                 gcs.call("KillActor", {
                     "actor_id": ActorID.from_hex(rec["actor_id"]),
                     "no_restart": True}, retries=3)
-        except Exception as e:  # noqa: BLE001 — best-effort cleanup
-            logger.warning("leaked worker cleanup failed: %s", e)
+
+        self._retry_cleanup("leaked worker cleanup", sweep)
 
 
 # Alias mirroring the reference's generic data-parallel trainer name.

@@ -32,6 +32,8 @@ class ActorState:
     name: str
     death_reason: str
     job_id: str | None = None
+    # Hosting node (drain-plane consumers map actors to DRAINING nodes).
+    node_id: str | None = None
 
 
 def list_nodes() -> list[NodeState]:

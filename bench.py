@@ -175,6 +175,12 @@ _GUARDED_METRICS = {
     "collective_allreduce_fused_naive_ratio": "higher",
     "collective_fused_naive_ratio": "higher",   # bench.py summary alias
     "step_profiler_overhead_ns": "lower",
+    # Resilience plane (PR 6): failure-detection + gang-relaunch +
+    # restore latency, and productive-step fraction under an induced
+    # mid-run crash.  Recovery time IS a throughput term at scale
+    # (arxiv 2510.20171) — regressions here are regressions in goodput.
+    "train_recovery_time_s": "lower",
+    "goodput_under_chaos": "higher",
 }
 
 

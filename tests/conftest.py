@@ -46,6 +46,10 @@ import pytest  # noqa: E402
 
 import ant_ray_tpu as art  # noqa: E402
 
+# Chaos-harness fixture (util/chaos.py): importing it into conftest
+# registers `chaos_schedule` for the whole suite.
+from ant_ray_tpu.util.chaos import chaos_schedule  # noqa: E402, F401
+
 
 @pytest.fixture
 def shutdown_only():

@@ -1,0 +1,544 @@
+"""Preemption-aware resilience plane: node drain, proactive checkpoint
++ gang migration, replicated-checkpoint restore, GCS restart mid-fit,
+and the deterministic chaos harness (util/chaos.py).
+
+All chaos is seeded/logically-triggered — no wall-clock assertions;
+deadlines below are generous upper bounds for polling only.
+"""
+
+import glob
+import os
+import shutil
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu import train
+from ant_ray_tpu.train import (
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+from ant_ray_tpu.util.chaos import ChaosSchedule
+
+
+@pytest.fixture
+def shutdown_only():
+    yield None
+    art.shutdown()
+
+
+# --------------------------------------------------------- chaos harness
+
+
+def test_chaos_schedule_unifies_knobs(chaos_schedule):
+    chaos_schedule.rpc_failure("Heartbeat", 0.2)
+    chaos_schedule.rpc_failure("LeaseWorker", 0.1)
+    chaos_schedule.chunk_serve_delay(0.01)
+    chaos_schedule.chunk_truncate(1024)
+    notice = chaos_schedule.preemption_notice()
+    cfg = chaos_schedule.system_config()
+    assert cfg["testing_rpc_failure"] == \
+        "seed:0,Heartbeat:0.2,LeaseWorker:0.1"
+    assert cfg["testing_chunk_serve_delay_s"] == 0.01
+    assert cfg["testing_chunk_truncate"] == 1024
+    assert cfg["testing_preemption_notice"] == notice
+    # Every knob the schedule writes must be a real config flag.
+    from ant_ray_tpu._private.config import Config
+
+    for key in cfg:
+        assert hasattr(Config(), key), f"unknown config flag {key}"
+
+
+def test_chaos_schedule_fire_order_and_determinism(chaos_schedule):
+    fired = []
+    chaos_schedule.at_step(5, lambda: fired.append("late"), "late")
+    chaos_schedule.at_step(2, lambda: fired.append("early"), "early")
+    chaos_schedule.at_step(2, lambda: fired.append("early2"), "early2")
+    assert chaos_schedule.fire(1) == []
+    assert chaos_schedule.pending == ["early", "early2", "late"]
+    # Catch-up fire runs everything due, in (step, registration) order,
+    # exactly once.
+    assert chaos_schedule.fire(6) == ["early", "early2", "late"]
+    assert fired == ["early", "early2", "late"]
+    assert chaos_schedule.fire(7) == []
+
+
+def test_chaos_rpc_failure_spec_is_seeded_deterministic():
+    from ant_ray_tpu._private.protocol import _ChaosInjector
+
+    spec = (ChaosSchedule(seed=3).rpc_failure("Ping", 0.5)
+            .system_config()["testing_rpc_failure"])
+    assert spec.startswith("seed:3,")
+    # The spec itself carries the seed: injectors built from it alone
+    # (as every daemon does, via _system_config) replay identically.
+    injector, injector2 = (_ChaosInjector(spec) for _ in range(2))
+    rolls = [injector.should_fail("Ping") for _ in range(64)]
+    rolls2 = [injector2.should_fail("Ping") for _ in range(64)]
+    assert rolls == rolls2          # same seed, same schedule
+    assert any(rolls) and not all(rolls)
+    # A different schedule seed produces a DIFFERENT fault sequence.
+    other = _ChaosInjector(ChaosSchedule(seed=4).rpc_failure("Ping", 0.5)
+                           .system_config()["testing_rpc_failure"])
+    assert [other.should_fail("Ping") for _ in range(64)] != rolls
+
+
+def test_preemption_notice_file_drains_daemon(chaos_schedule,
+                                              shutdown_only):
+    """The testing_preemption_notice file (the maintenance-event
+    stand-in) fires the daemon's watcher, which self-drains via the
+    GCS DrainNode RPC."""
+    from ant_ray_tpu.cluster_utils import Cluster
+
+    chaos_schedule.preemption_notice()
+    cluster = Cluster(head_node_args={
+        "num_cpus": 1,
+        "_system_config": {**chaos_schedule.system_config(),
+                           "preemption_poll_interval_s": 0.1}})
+    cluster.connect()
+    try:
+        assert not any(n["Draining"] for n in art.nodes())
+        chaos_schedule.trigger_preemption(deadline_s=17.5,
+                                          reason="maintenance window")
+        deadline = time.monotonic() + 30
+        node = None
+        while time.monotonic() < deadline:
+            node = next(n for n in art.nodes())
+            if node["Draining"]:
+                break
+            time.sleep(0.1)
+        assert node is not None and node["Draining"]
+        assert "maintenance window" in node["DrainReason"]
+        assert node["DrainDeadline"] > 0
+        assert node["Alive"]      # draining, not dead
+    finally:
+        art.shutdown()
+        cluster.shutdown()
+
+
+# ------------------------------------------------------ drain: zero loss
+
+
+def test_drain_notice_zero_step_loss(shutdown_only, tmp_path):
+    """A drain notice mid-fit migrates the gang off the draining node
+    with ZERO steps lost or re-executed (proactive checkpoint: the
+    stop rides the report ack, whose checkpoint is already
+    registered), without touching the failure budget
+    (max_failures=0)."""
+    from ant_ray_tpu.cluster_utils import Cluster
+
+    steplog = tmp_path / "steps.log"
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"gang": 1})
+    cluster.add_node(num_cpus=2, resources={"gang": 1})
+    cluster.connect()
+    try:
+        def loop(config):
+            ctx = train.get_context()
+            start = 0
+            if ctx.latest_checkpoint is not None:
+                start = int(ctx.latest_checkpoint
+                            .to_pytree()["step"]) + 1
+            for step in range(start, 8):
+                with open(config["steplog"], "a") as f:
+                    f.write(f"{step} "
+                            f"{os.environ.get('ART_NODE_ID', '')}\n")
+                time.sleep(0.3)   # real step work; drain lands mid-run
+                train.report({"step": step}, checkpoint={"step": step})
+
+        trainer = JaxTrainer(
+            loop, train_loop_config={"steplog": str(steplog)},
+            scaling_config=ScalingConfig(
+                num_workers=1,
+                resources_per_worker={"CPU": 1.0, "gang": 0.5}),
+            run_config=RunConfig(
+                name="drain-zero-loss",
+                storage_path=str(tmp_path / "store"),
+                failure_config=FailureConfig(max_failures=0)))
+
+        import threading
+
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(result=trainer.fit()), daemon=True)
+        t.start()
+        # Once the gang demonstrably runs (>= 3 steps logged), drain
+        # the node hosting the worker.
+        deadline = time.monotonic() + 90
+        node_hex = None
+        while time.monotonic() < deadline:
+            if steplog.exists():
+                lines = steplog.read_text().splitlines()
+                if len(lines) >= 3:
+                    node_hex = lines[-1].split()[1]
+                    break
+            time.sleep(0.2)
+        assert node_hex, "gang never started"
+        target = next(n for n in art.nodes()
+                      if n["NodeID"] == node_hex)
+        cluster.drain_node(target["Address"], reason="maintenance",
+                           deadline_s=60)
+        t.join(timeout=120)
+        assert not t.is_alive(), "fit never finished after drain"
+        result = box["result"]
+        assert result.error is None
+        rows = [line.split() for line in
+                steplog.read_text().splitlines()]
+        steps = [int(r[0]) for r in rows]
+        # ZERO step loss AND zero re-execution: every step ran exactly
+        # once, across two distinct nodes.
+        assert sorted(steps) == list(range(8))
+        assert len(steps) == len(set(steps))
+        assert len({r[1] for r in rows}) == 2, "gang did not migrate"
+        assert result.metrics["step"] == 7
+        # The drained node is fenced but still alive.
+        assert next(n for n in art.nodes()
+                    if n["NodeID"] == node_hex)["Draining"]
+    finally:
+        art.shutdown()
+        cluster.shutdown()
+
+
+# ------------------------------------- replicated-checkpoint restore
+
+
+def test_worker_kill_replica_restore(shutdown_only, tmp_path):
+    """A worker crash recovers from the IN-CLUSTER checkpoint replica
+    when the storage copy is gone (the no-shared-storage_path
+    scenario: node-local checkpoint dirs died with the node)."""
+    art.init(num_cpus=2)
+
+    def loop(config):
+        ctx = train.get_context()
+        start = 0
+        restored_from = ""
+        if ctx.latest_checkpoint is not None:
+            restored_from = ctx.latest_checkpoint.as_directory()
+            start = int(ctx.latest_checkpoint.to_pytree()["step"]) + 1
+        for step in range(start, 6):
+            train.report({"step": step,
+                          "restored_from": restored_from},
+                         checkpoint={"step": step})
+            if step == 3 and ctx.attempt == 0:
+                # Wait for the step-3 save to be REGISTERED (run-token
+                # stamped after the complete write), then destroy every
+                # on-disk checkpoint and crash: restore must come from
+                # the object-store replica.
+                token = os.path.join(ctx.storage_path,
+                                     "checkpoint_000003", ".run_token")
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline and \
+                        not os.path.exists(token):
+                    time.sleep(0.05)
+                assert os.path.exists(token), "save never registered"
+                for d in glob.glob(os.path.join(ctx.storage_path,
+                                                "checkpoint_*")):
+                    shutil.rmtree(d, ignore_errors=True)
+                raise RuntimeError("chaos: induced worker crash")
+
+    result = JaxTrainer(
+        loop, train_loop_config={},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(
+            name="replica-restore", storage_path=str(tmp_path),
+            failure_config=FailureConfig(
+                max_failures=1, group_restart_backoff_s=0.4))).fit()
+    assert result.error is None
+    assert result.metrics["step"] == 5
+    # Resumed from step 3 (not from scratch), materialized from the
+    # replica cache — NOT the (destroyed) storage directory.
+    assert "art_ckpt_replicas" in result.metrics["restored_from"]
+
+
+def test_save_pytree_atomic_preserves_previous_checkpoint(tmp_path):
+    """save_pytree to an existing path never destroys the previous
+    checkpoint before the new one is completely written (the old
+    rmtree-then-save order lost it on a mid-save crash)."""
+    from ant_ray_tpu.train.checkpoint import load_pytree, save_pytree
+
+    path = str(tmp_path / "ckpt")
+    save_pytree({"step": 1}, path)
+
+    # A save that crashes mid-write must leave the old checkpoint
+    # intact and no torn copy under the final name.
+    class Boom(RuntimeError):
+        pass
+
+    import orbax.checkpoint as ocp
+
+    orig_save = ocp.PyTreeCheckpointer.save
+
+    def exploding_save(self, directory, *a, **k):
+        raise Boom("torn write")
+
+    ocp.PyTreeCheckpointer.save = exploding_save
+    try:
+        with pytest.raises(Boom):
+            save_pytree({"step": 2}, path)
+    finally:
+        ocp.PyTreeCheckpointer.save = orig_save
+    assert int(load_pytree(path)["step"]) == 1      # old copy intact
+    assert glob.glob(path + ".tmp-*") == []         # no leftovers
+    # A successful overwrite replaces it atomically.
+    save_pytree({"step": 3}, path)
+    assert int(load_pytree(path)["step"]) == 3
+    assert glob.glob(path + ".*") == []
+
+
+def test_load_pytree_adopts_orphaned_old(tmp_path):
+    """A kill between save_pytree's two renames leaves the previous
+    checkpoint only under the .old- name; the load path adopts it back
+    instead of losing the acked steps it represents."""
+    from ant_ray_tpu.train.checkpoint import load_pytree, save_pytree
+
+    path = str(tmp_path / "ckpt")
+    save_pytree({"step": 4}, path)
+    os.rename(path, path + ".old-dead0")     # crash mid-swap
+    assert int(load_pytree(path)["step"]) == 4
+    assert os.path.isdir(path)               # adopted back into place
+    assert glob.glob(path + ".old-*") == []
+
+
+def test_checkpoint_pack_unpack_roundtrip(tmp_path):
+    from ant_ray_tpu.train.checkpoint import (
+        pack_checkpoint_dir,
+        save_pytree,
+        unpack_checkpoint,
+    )
+    from ant_ray_tpu.train.checkpoint import load_pytree
+
+    src = str(tmp_path / "src")
+    save_pytree({"w": [1.0, 2.0], "step": 9}, src)
+    blob = pack_checkpoint_dir(src)
+    dest = str(tmp_path / "nested" / "dest")
+    assert unpack_checkpoint(blob, dest) == dest
+    restored = load_pytree(dest)
+    assert int(restored["step"]) == 9
+
+
+# ------------------------------------------------- GCS restart mid-fit
+
+
+def test_gcs_restart_during_fit(shutdown_only, tmp_path):
+    """The head dies and restarts DURING an active fit: daemons
+    reconnect, reports (worker -> controller actor, direct RPC) keep
+    flowing through the outage, the checkpoint reported during the
+    outage is adopted, and the fit completes."""
+    from ant_ray_tpu.cluster_utils import Cluster
+
+    gate = tmp_path / "resume.flag"
+    steplog = tmp_path / "steps.log"
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2)
+    cluster.connect()
+    try:
+        def loop(config):
+            ctx = train.get_context()
+            assert ctx.latest_checkpoint is None  # no restarts expected
+            for step in range(6):
+                if step == 4:
+                    # Park until the driver finished the GCS bounce —
+                    # steps 2-3 are reported during the outage.
+                    deadline = time.monotonic() + 90
+                    while time.monotonic() < deadline and \
+                            not os.path.exists(config["gate"]):
+                        time.sleep(0.1)
+                    assert os.path.exists(config["gate"])
+                train.report({"step": step}, checkpoint={"step": step})
+                with open(config["steplog"], "a") as f:
+                    f.write(f"{step}\n")
+
+        trainer = JaxTrainer(
+            loop, train_loop_config={"gate": str(gate),
+                                     "steplog": str(steplog)},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="gcs-bounce", storage_path=str(tmp_path / "store"),
+                failure_config=FailureConfig(max_failures=0)))
+
+        import threading
+
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(result=trainer.fit()), daemon=True)
+        t.start()
+        # Kill the head once the run demonstrably progresses.
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if steplog.exists() and \
+                    len(steplog.read_text().splitlines()) >= 2:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("fit never reached step 2")
+        cluster.kill_gcs()
+        time.sleep(1.0)       # reports for steps 2-3 land in the outage
+        cluster.restart_gcs()
+        gate.write_text("go")
+        t.join(timeout=120)
+        assert not t.is_alive(), "fit wedged across the GCS restart"
+        result = box["result"]
+        assert result.error is None
+        assert result.metrics["step"] == 5
+        # The checkpoint reported during the outage was not lost.
+        assert result.checkpoint is not None
+        assert int(result.checkpoint.to_pytree()["step"]) == 5
+        # Daemons re-registered with the restarted head.
+        assert sum(1 for n in art.nodes() if n["Alive"]) == 2
+    finally:
+        art.shutdown()
+        cluster.shutdown()
+
+
+# ------------------------------------------------------- serve drain
+
+
+def test_serve_migrates_replicas_off_draining_node(shutdown_only):
+    """Serve's drain watcher replaces a draining node's replicas
+    (readiness-gated elsewhere first) and the deployment keeps
+    serving."""
+    from ant_ray_tpu import serve
+    from ant_ray_tpu.api import global_worker
+    from ant_ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 4})
+    cluster.add_node(num_cpus=4)
+    cluster.connect()
+    try:
+        @serve.deployment
+        def echo(req):
+            return {"ok": req}
+
+        handle = serve.run(echo.options(num_replicas=3).bind())
+        gcs = global_worker.runtime._gcs
+
+        def replica_nodes():
+            return {rec["actor_id"]: rec.get("node_id")
+                    for rec in gcs.call("ListActors", retries=3)
+                    if rec.get("class_name") == "Replica"
+                    and rec.get("state") == "ALIVE"}
+
+        deadline = time.monotonic() + 30
+        before = {}
+        while time.monotonic() < deadline and len(before) < 3:
+            before = replica_nodes()
+            time.sleep(0.2)
+        assert len(before) == 3
+        target = next(iter(before.values()))
+        target_addr = next(n["Address"] for n in art.nodes()
+                           if n["NodeID"] == target)
+        cluster.drain_node(target_addr, reason="maintenance",
+                           deadline_s=60)
+        deadline = time.monotonic() + 60
+        migrated = False
+        while time.monotonic() < deadline:
+            now = replica_nodes()
+            if len(now) >= 3 and target not in now.values():
+                migrated = True
+                break
+            time.sleep(0.5)
+        assert migrated, f"replicas still on draining node: " \
+                         f"{replica_nodes()}"
+        assert art.get(handle.remote({"x": 1}), timeout=30) == \
+            {"ok": {"x": 1}}
+    finally:
+        try:
+            from ant_ray_tpu import serve as _s
+
+            _s.shutdown()
+        except Exception:  # noqa: BLE001
+            pass
+        art.shutdown()
+        cluster.shutdown()
+
+
+# --------------------------------------------------- long chaos soak
+
+
+@pytest.mark.slow
+def test_chaos_soak_drain_and_crash_cycles(shutdown_only, tmp_path):
+    """Soak: repeated drain + crash cycles under RPC chaos — the fit
+    survives an announced drain, an unannounced worker crash, and a
+    lossy control plane in one run."""
+    from ant_ray_tpu.cluster_utils import Cluster
+
+    chaos = ChaosSchedule(seed=11)
+    chaos.rpc_failure("Heartbeat", 0.05)
+    steplog = tmp_path / "steps.log"
+    cluster = Cluster(head_node_args={
+        "num_cpus": 2, "_system_config": chaos.system_config()})
+    cluster.add_node(num_cpus=2, resources={"gang": 1})
+    cluster.add_node(num_cpus=2, resources={"gang": 1})
+    cluster.connect()
+    try:
+        def loop(config):
+            ctx = train.get_context()
+            start = 0
+            if ctx.latest_checkpoint is not None:
+                start = int(ctx.latest_checkpoint
+                            .to_pytree()["step"]) + 1
+            for step in range(start, 16):
+                with open(config["steplog"], "a") as f:
+                    f.write(f"{ctx.attempt} {step}\n")
+                time.sleep(0.2)
+                # The drain restart below bumps the incarnation to 1,
+                # so the unannounced crash must fire in attempt 1 (an
+                # attempt-0 gate would be dead code — the drain always
+                # lands first).  `>=` keeps it live even if the drain
+                # unwind slips a step or two past 11.
+                if step >= 11 and ctx.attempt == 1:
+                    raise RuntimeError("chaos: unannounced crash")
+                train.report({"step": step}, checkpoint={"step": step})
+
+        trainer = JaxTrainer(
+            loop, train_loop_config={"steplog": str(steplog)},
+            scaling_config=ScalingConfig(
+                num_workers=1,
+                resources_per_worker={"CPU": 1.0, "gang": 0.5}),
+            run_config=RunConfig(
+                name="chaos-soak", storage_path=str(tmp_path / "store"),
+                failure_config=FailureConfig(
+                    max_failures=1, group_restart_backoff_s=0.4)))
+
+        import threading
+
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(result=trainer.fit()), daemon=True)
+        t.start()
+        # Announced drain once the gang passes step 4.
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            if steplog.exists() and len(
+                    steplog.read_text().splitlines()) >= 5:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("soak never reached step 4")
+        node_ids = {n["NodeID"]: n["Address"] for n in art.nodes()}
+        from ant_ray_tpu.api import global_worker
+
+        gcs = global_worker.runtime._gcs
+        worker_node = next(
+            rec.get("node_id") for rec in gcs.call("ListActors",
+                                                   retries=3)
+            if (rec.get("name") or "").startswith("train-chaos-soak-w")
+            and rec.get("state") == "ALIVE")
+        cluster.drain_node(node_ids[worker_node], reason="soak drain",
+                           deadline_s=60)
+        t.join(timeout=240)
+        assert not t.is_alive()
+        result = box["result"]
+        assert result.error is None
+        assert result.metrics["step"] == 15
+        steps = [int(line.split()[1])
+                 for line in steplog.read_text().splitlines()]
+        # The announced drain lost nothing; the unannounced crash may
+        # re-execute at most the crashed step.
+        assert sorted(set(steps)) == list(range(16))
+        assert len(steps) <= 17
+    finally:
+        art.shutdown()
+        cluster.shutdown()

@@ -1,5 +1,9 @@
 """Tune layer tests (ref test model: tune/tests)."""
 
+import glob
+import os
+import time
+
 import pytest
 
 import ant_ray_tpu as art
@@ -97,13 +101,32 @@ def test_random_sampling_num_samples(cluster):
 
 class _StepDecay(tune.Trainable):
     """loss = offset + 1/iter — trials with larger offset are strictly
-    worse at every iteration, the shape ASHA separates immediately."""
+    worse at every iteration, the shape ASHA separates immediately.
+
+    Optional ``rendezvous`` config: a shared directory the trial marks
+    itself up in and waits (bounded) for ``rendezvous_count`` peers
+    before its first step.  Async PBT only exploits while the
+    population OVERLAPS — without this, a trial that wins the
+    worker-spawn race can finish its whole (sub-millisecond-per-step)
+    run before its peer reports a single score, and the exploitation
+    test becomes a coin flip under suite load."""
 
     def setup(self, config):
         self.offset = config["offset"]
         self.iter = 0
+        self._rendezvous = config.get("rendezvous")
+        self._rendezvous_count = config.get("rendezvous_count", 2)
+        if self._rendezvous:
+            open(os.path.join(self._rendezvous,
+                              f"up_{config['offset']}"), "w").close()
 
     def step(self):
+        if self._rendezvous and self.iter == 0:
+            deadline = time.monotonic() + 60
+            pattern = os.path.join(self._rendezvous, "up_*")
+            while time.monotonic() < deadline and \
+                    len(glob.glob(pattern)) < self._rendezvous_count:
+                time.sleep(0.02)      # fail-open: proceed at deadline
         self.iter += 1
         return {"loss": self.offset + 1.0 / self.iter}
 
@@ -152,10 +175,11 @@ def test_median_stopping_rule_decisions():
     assert decision == "STOP"
 
 
-def test_pbt_exploits_checkpoint_and_mutates_config(cluster):
+def test_pbt_exploits_checkpoint_and_mutates_config(cluster, tmp_path):
     tuner = tune.Tuner(
         _StepDecay,
-        param_space={"offset": tune.grid_search([0.0, 5.0])},
+        param_space={"offset": tune.grid_search([0.0, 5.0]),
+                     "rendezvous": str(tmp_path)},
         tune_config=tune.TuneConfig(
             stop={"training_iteration": 8},
             scheduler=tune.PopulationBasedTraining(
