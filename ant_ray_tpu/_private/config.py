@@ -214,6 +214,11 @@ class Config:
     # Deterministic RPC fault injection: "method:prob,method:prob" (chaos
     # testing — ref: src/ray/rpc/rpc_chaos.h).
     testing_rpc_failure: str = ""
+    # Deterministic RPC latency injection: "method:seconds,method:seconds"
+    # (chaos harness — slow-replica / slow-network scenarios; the delay
+    # is added client-side before the frame is written, so it rides the
+    # same per-daemon env channel as testing_rpc_failure).
+    testing_rpc_latency_s: str = ""
 
     # ---- memory monitor (ref: src/ray/common/memory_monitor.h +
     # worker_killing_policy.h) ----

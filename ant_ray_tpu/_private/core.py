@@ -1938,9 +1938,42 @@ class ClusterRuntime(CoreRuntime):
                 self._unpin_locked(pins)
 
     def cancel(self, ref, force=False, recursive=True):
-        # Round 1: cancellation of queued (not yet leased) tasks only is
-        # not yet implemented; running tasks cannot be interrupted.
-        logger.warning("cancel() is not yet implemented; ignoring")
+        """Best-effort cancellation of a not-yet-executing ACTOR task.
+
+        A call still queued client-side is failed locally with
+        :class:`TaskCancelledError`; one already pushed is dropped
+        worker-side if its executor has not started it.  Running tasks
+        are never interrupted — user code cannot be preempted safely, so
+        layers that need in-flight bounds (Serve) shed at dequeue via
+        request deadlines and call this for the queued remainder."""
+        task_id = ref.id.task_id()
+        actor_id = task_id.actor_id()
+        nil_fill = b"\xff" * (ActorID.SIZE - JobID.SIZE)
+        if actor_id._bytes[JobID.SIZE:] == nil_fill:
+            # Normal (non-actor) task: the lease path has no cancel
+            # channel yet; keep the round-1 no-op there.
+            logger.warning(
+                "cancel() supports actor tasks only; ignoring %s", ref)
+            return
+        self._post_submit(self._cancel_actor_task, actor_id, task_id)
+
+    def _cancel_actor_task(self, actor_id, task_id) -> None:
+        """io-loop only: fail the call locally if still queued, else ask
+        the worker to drop it before execution (ordered behind the
+        already-shipped PushTask on the same connection)."""
+        state = self._actor_states.get(actor_id)
+        if state is not None:
+            for i, (spec, pinned, _attempt) in enumerate(state.queue):
+                if spec.task_id == task_id:
+                    del state.queue[i]
+                    self._store_error(
+                        spec, exceptions.TaskCancelledError(
+                            task_id, "cancelled before dispatch"))
+                    self._unpin(pinned)
+                    return
+        address = state.address if state is not None else ""
+        if address:
+            self._send_oneway(address, "CancelTask", {"task_id": task_id})
 
     def submit_actor_task(self, handle, method_name, args, kwargs,
                           options: TaskOptions):

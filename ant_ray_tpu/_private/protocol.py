@@ -100,7 +100,7 @@ class _ChaosInjector:
     into every daemon's injector.
     """
 
-    def __init__(self, spec: str, seed: int = 0):
+    def __init__(self, spec: str, seed: int = 0, latency_spec: str = ""):
         self._probs: dict[str, float] = {}
         for part in filter(None, (spec or "").split(",")):
             method, prob = part.split(":")
@@ -109,10 +109,22 @@ class _ChaosInjector:
                 continue
             self._probs[method] = float(prob)
         self._rng = random.Random(seed)
+        # Per-method injected latency (testing_rpc_latency_s): applied
+        # client-side before the request frame is written — the
+        # deterministic stand-in for a slow replica / congested link.
+        self._delays: dict[str, float] = {}
+        for part in filter(None, (latency_spec or "").split(",")):
+            method, secs = part.split(":")
+            if method == "seed":
+                continue
+            self._delays[method] = float(secs)
 
     def should_fail(self, method: str) -> bool:
         prob = self._probs.get(method, 0.0)
         return prob > 0 and self._rng.random() < prob
+
+    def delay_for(self, method: str) -> float:
+        return self._delays.get(method, 0.0) if self._delays else 0.0
 
 
 # ------------------------------------------------------------------- io loop
@@ -443,7 +455,9 @@ class RpcClient:
         # pushes); discard_deferred() fails the futures of frames that
         # were never shipped so callers can retry instead of hanging.
         self._outbox: list[tuple[bytes, asyncio.Future]] = []
-        self._chaos = _ChaosInjector(global_config().testing_rpc_failure)
+        self._chaos = _ChaosInjector(
+            global_config().testing_rpc_failure,
+            latency_spec=global_config().testing_rpc_latency_s)
         self._closed = False
 
     async def _ensure_connected(self):
@@ -531,6 +545,9 @@ class RpcClient:
         """
         if self._chaos.should_fail(method):
             raise RpcConnectionError(f"[chaos] injected failure for {method}")
+        delay = self._chaos.delay_for(method)
+        if delay > 0:
+            await asyncio.sleep(delay)
         await self._ensure_connected()
         msg_id = next(self._counter)
         fut = asyncio.get_running_loop().create_future()

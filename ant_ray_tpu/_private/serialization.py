@@ -41,6 +41,17 @@ def _maybe_jax():
     return _jax or None
 
 
+def _jax_if_loaded():
+    """jax, ONLY if this process already imported it: a value can be a
+    jax Array only when jax is loaded, so the SERIALIZE-side probe must
+    not pull the ~1s jax import onto a reply path — a serve replica's
+    first error reply (e.g. an admission shed that must return in
+    milliseconds) would otherwise eat the whole import."""
+    if _jax is None and "jax" not in sys.modules:
+        return None
+    return _maybe_jax()
+
+
 @dataclass
 class SerializedObject:
     """A serialized value: a metadata pickle stream + raw buffers."""
@@ -231,7 +242,7 @@ class _ValuePickler(cloudpickle.Pickler):
             if len(obj) > _OOB_BYTES_THRESHOLD:
                 return (t, (pickle.PickleBuffer(obj),))
             return NotImplemented
-        jax = _maybe_jax()
+        jax = _jax_if_loaded()
         if jax is not None and isinstance(obj, jax.Array):
             import numpy as np  # noqa: PLC0415
 

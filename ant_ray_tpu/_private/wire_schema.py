@@ -157,6 +157,10 @@ METHODS: dict[str, dict] = {
 
     # ---- worker / owner (core runtime) --------------------------------
     "PushTask": _m("worker", "TaskSpec (fast route)", "result payload"),
+    "CancelTask": _m("worker", "{task_id}",
+                     "bool — drop the task if it has not started "
+                     "executing (oneway from owners; cooperative: "
+                     "running tasks are never interrupted)"),
     "InstantiateActor": _m("worker", "ActorSpec", "bool"),
     "Ping": _m("worker|store", "{}", "'pong'"),
     "GetObject": _m("worker", "{object_id, timeout}",

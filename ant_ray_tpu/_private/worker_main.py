@@ -44,11 +44,20 @@ class TaskExecutor:
     """Executes tasks for this worker; one main executor thread (actor order
     preserved), optional thread pool for max_concurrency > 1 actors."""
 
+    # Cancelled-id memory bound: ids for tasks that already ran (or
+    # never arrive) must not accumulate forever.
+    _CANCEL_CAP = 4096
+
     def __init__(self, runtime: ClusterRuntime):
         self.runtime = runtime
         # SimpleQueue: C-implemented, ~5x cheaper per put/get than
         # queue.Queue — this hop is on every task execution.
         self.queue: "queue.SimpleQueue[tuple]" = queue.SimpleQueue()
+        # Task ids cancelled via art.cancel before execution started
+        # (CancelTask RPC); checked at both dequeue points so a task
+        # parked in the pool's backlog is dropped, not run.
+        self._cancelled: "dict[bytes, bool]" = {}
+        self._cancel_lock = threading.Lock()
         self.actor_instance = None
         self.actor_spec: ActorSpec | None = None
         self._async_loop: asyncio.AbstractEventLoop | None = None
@@ -124,7 +133,24 @@ class TaskExecutor:
             self._group_pools[group] = pool
         return pool
 
+    def cancel(self, task_id) -> None:
+        """Mark a task cancelled; it is dropped if not yet executing.
+        Running tasks are unaffected (cooperative model)."""
+        with self._cancel_lock:
+            self._cancelled[task_id._bytes] = True
+            while len(self._cancelled) > self._CANCEL_CAP:
+                self._cancelled.pop(next(iter(self._cancelled)))
+
+    def _take_cancelled(self, spec: TaskSpec) -> bool:
+        with self._cancel_lock:
+            return self._cancelled.pop(spec.task_id._bytes, False)
+
     def _execute_safely(self, spec: TaskSpec, fut: asyncio.Future):
+        if self._take_cancelled(spec):
+            self._reply(fut, self._error_returns(
+                spec, exceptions.TaskCancelledError(
+                    spec.task_id, "cancelled before execution")))
+            return
         try:
             self._reply(fut, self._execute(spec))
         except SystemExit:
@@ -439,9 +465,14 @@ def main():  # pragma: no cover — exercised via subprocess in tests
     async def handle_ping(_payload):
         return "pong"
 
+    async def handle_cancel(payload):
+        executor.cancel(payload["task_id"])
+        return True
+
     runtime.server.routes({
         "InstantiateActor": handle_instantiate,
         "Ping": handle_ping,
+        "CancelTask": handle_cancel,
     })
     runtime.server.fast_route("PushTask", handle_push_task)
 

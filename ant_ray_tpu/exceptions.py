@@ -66,6 +66,50 @@ class GetTimeoutError(ArtError, TimeoutError):
     """`get(timeout=...)` expired before the object was ready."""
 
 
+class TaskCancelledError(ArtError):
+    """The task was cancelled (``art.cancel``) before it executed."""
+
+    def __init__(self, task_id=None, reason: str = ""):
+        self.task_id = task_id
+        self.reason = reason
+        shown = task_id.hex() if hasattr(task_id, "hex") else (
+            task_id or "<unknown>")
+        super().__init__(
+            f"Task {shown} cancelled{': ' + reason if reason else ''}")
+
+    def __reduce__(self):
+        return (TaskCancelledError, (self.task_id, self.reason))
+
+
+class BackPressureError(ArtError):
+    """A bounded queue refused new work (admission control).
+
+    Raised replica-side when a Serve deployment's
+    ``max_ongoing_requests``/``max_queued_requests`` bounds are hit and
+    by the LLM engine when its KV slots and waiting queue are full.
+    Ingresses map it to HTTP 429 + ``Retry-After`` / gRPC
+    ``RESOURCE_EXHAUSTED``.  ``retry_after_s`` is the server's hint for
+    when capacity is likely to free up."""
+
+    def __init__(self, message: str = "queue at capacity",
+                 retry_after_s: float = 1.0):
+        self.retry_after_s = float(retry_after_s)
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (BackPressureError, (str(self.args[0]) if self.args
+                                    else "queue at capacity",
+                                    self.retry_after_s))
+
+
+class DeadlineExceededError(ArtError, TimeoutError):
+    """The request's end-to-end deadline expired.
+
+    Expired work is SHED, never executed: routers and replicas check the
+    stamped deadline before dequeue, and ingresses map this to HTTP 504 /
+    gRPC ``DEADLINE_EXCEEDED``."""
+
+
 class RuntimeEnvSetupError(ArtError):
     pass
 

@@ -71,7 +71,7 @@ class LLMEngine:
     def __init__(self, model="tiny", params=None, *, slots: int = 8,
                  max_seq: int | None = None, tokenizer=None,
                  seed: int = 0, tensor_parallel_size: int = 1,
-                 mesh=None):
+                 mesh=None, max_waiting: int | None = None):
         """``tensor_parallel_size > 1`` makes the ENGINE build a tp mesh
         over this process's local devices and shard params + KV slabs
         itself (ref: vllm_models.py:222 tensor_parallel_size — serving
@@ -131,6 +131,11 @@ class LLMEngine:
         # loop costs one host→device transfer per step instead of one
         # tiny device op per slot.
         self._last_np = np.zeros((slots,), np.int32)
+        # Admission bound: with every KV slot busy, at most this many
+        # requests may wait for one (None = unbounded, legacy).  Serving
+        # paths set it so a traffic spike sheds typed BackPressureError
+        # at admission instead of queueing prompts toward OOM.
+        self._max_waiting = max_waiting
         self._free_slots = list(range(slots))
         self._active: dict[int, _Seq] = {}        # slot -> seq
         self._waiting: list[_Seq] = []
@@ -182,8 +187,27 @@ class LLMEngine:
     # ------------------------------------------------------------ public
 
     def add_request(self, prompt, sampling: SamplingParams | None = None,
-                    request_id: str | None = None) -> str:
-        """prompt: str (tokenized here) or token-id list."""
+                    request_id: str | None = None, *,
+                    admit: bool = True) -> str:
+        """prompt: str (tokenized here) or token-id list.
+
+        With ``max_waiting`` configured and ``admit=True`` (the serving
+        default), a request arriving while every KV slot is busy and the
+        waiting line is full is REJECTED with
+        :class:`~ant_ray_tpu.exceptions.BackPressureError` — admission
+        control at the engine boundary, so overload sheds instead of
+        growing an unbounded prompt queue toward OOM.  Offline batch
+        paths (``generate``) pass ``admit=False``: a caller handing the
+        engine a fixed batch wants queueing."""
+        if (admit and self._max_waiting is not None
+                and not self._free_slots
+                and len(self._waiting) >= self._max_waiting):
+            from ant_ray_tpu.exceptions import BackPressureError  # noqa: PLC0415
+
+            raise BackPressureError(
+                f"engine at capacity: {self.slots} KV slots busy, "
+                f"{len(self._waiting)} waiting (max_waiting="
+                f"{self._max_waiting})", retry_after_s=0.5)
         sampling = sampling or SamplingParams()
         if isinstance(prompt, str):
             token_ids = self.tokenizer.encode(prompt)
@@ -244,7 +268,8 @@ class LLMEngine:
     def generate(self, prompts, sampling: SamplingParams | None = None,
                  ) -> list[RequestOutput]:
         """Run a batch of prompts to completion (offline inference)."""
-        order = [self.add_request(p, sampling) for p in prompts]
+        order = [self.add_request(p, sampling, admit=False)
+                 for p in prompts]
         outputs: dict[str, RequestOutput] = {}
         while self.has_unfinished():
             for out in self.step():

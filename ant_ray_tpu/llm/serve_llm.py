@@ -19,7 +19,8 @@ class LLMServer:
 
     def __init__(self, model="tiny", *, slots: int = 8,
                  max_seq: int | None = None, tokenizer_name: str | None =
-                 None, seed: int = 0, tensor_parallel_size: int = 1):
+                 None, seed: int = 0, tensor_parallel_size: int = 1,
+                 max_waiting: int | None = None):
         import threading  # noqa: PLC0415
 
         from ant_ray_tpu.llm.tokenizer import get_tokenizer  # noqa: PLC0415
@@ -27,11 +28,57 @@ class LLMServer:
         self.engine = LLMEngine(
             model, slots=slots, max_seq=max_seq,
             tokenizer=get_tokenizer(tokenizer_name), seed=seed,
-            tensor_parallel_size=tensor_parallel_size)
+            tensor_parallel_size=tensor_parallel_size,
+            max_waiting=max_waiting)
         # The engine mutates shared slot/cache state; replicas may run
         # requests on overlapping threads (max_concurrency > 1), so all
-        # engine access serializes here.
+        # engine access serializes here.  Because of that serialization
+        # the LOCK QUEUE is the serving-path prompt line: `max_waiting`
+        # bounds it in _acquire_engine (the engine's own add_request
+        # gate covers direct engine users).
         self._engine_lock = threading.Lock()
+        self._max_waiting = max_waiting
+        self._lock_waiters = 0
+        self._waiters_lock = threading.Lock()
+
+    def _acquire_engine(self) -> None:
+        """Admission at the engine boundary: with the engine busy, at
+        most ``max_waiting`` requests may line up for the lock — excess
+        sheds a typed :class:`BackPressureError` (429 at the ingress)
+        instead of piling up blocked replica threads without bound."""
+        from ant_ray_tpu.exceptions import BackPressureError  # noqa: PLC0415
+
+        if self._engine_lock.acquire(blocking=False):
+            return
+        with self._waiters_lock:
+            if (self._max_waiting is not None
+                    and self._lock_waiters >= self._max_waiting):
+                raise BackPressureError(
+                    f"llm engine busy: {self._lock_waiters} requests "
+                    f"already waiting (max_waiting={self._max_waiting})",
+                    retry_after_s=0.5)
+            self._lock_waiters += 1
+        try:
+            self._engine_lock.acquire()
+        finally:
+            with self._waiters_lock:
+                self._lock_waiters -= 1
+
+    @staticmethod
+    def _check_deadline(where: str) -> None:
+        """Shed a request whose end-to-end deadline (stamped by the
+        serve ingress/handle) already expired — generating tokens
+        nobody is waiting for would hold the engine lock for nothing."""
+        import time  # noqa: PLC0415
+
+        from ant_ray_tpu.exceptions import DeadlineExceededError  # noqa: PLC0415
+        from ant_ray_tpu.serve.api import get_request_deadline  # noqa: PLC0415
+
+        deadline = get_request_deadline()
+        if deadline is not None and time.time() >= deadline:
+            raise DeadlineExceededError(
+                f"request deadline expired before {where} — shed, "
+                "not executed")
 
     @staticmethod
     def _is_chat(request: dict) -> bool:
@@ -51,8 +98,13 @@ class LLMServer:
             prompts[0], int)
         batch = prompts if many else [prompts]
         sampling = self._sampling(request)
-        with self._engine_lock:
+        self._check_deadline("generation")
+        self._acquire_engine()
+        try:
+            self._check_deadline("generation")  # lock wait can expire it
             outs = self.engine.generate(batch, sampling)
+        finally:
+            self._engine_lock.release()
         return {
             "object": "text_completion",
             "choices": [
@@ -69,8 +121,13 @@ class LLMServer:
         token_ids = render_chat(self.engine.tokenizer,
                                 request.get("messages", []))
         sampling = self._sampling(request)
-        with self._engine_lock:
+        self._check_deadline("generation")
+        self._acquire_engine()
+        try:
+            self._check_deadline("generation")  # lock wait can expire it
             out = self.engine.generate([token_ids], sampling)[0]
+        finally:
+            self._engine_lock.release()
         return {
             "object": "chat.completion",
             "choices": [{
@@ -114,12 +171,14 @@ class LLMServer:
             prompt = prompts[0] if isinstance(prompts, list) and prompts \
                 and not isinstance(prompts[0], int) else prompts
         sampling = self._sampling(request)
+        self._check_deadline("streaming generation")
         # The lock spans the generator's whole life (tokens must stream
         # while generation runs, and no other request may touch the
         # engine mid-stream); the finally releases it even if the
         # consumer abandons the generator (GeneratorExit).
-        self._engine_lock.acquire()
+        self._acquire_engine()
         try:
+            self._check_deadline("streaming generation")  # lock wait
             deltas = self.engine.stream(prompt, sampling)
             yield from (self._chat_chunks(deltas) if chat
                         else self._chunks(deltas))
@@ -166,15 +225,29 @@ def build_llm_deployment(model="tiny", *, name: str = "llm",
                          max_seq: int | None = None,
                          tokenizer_name: str | None = None,
                          tensor_parallel_size: int = 1,
-                         route_prefix: str | None = "/v1"):
+                         route_prefix: str | None = "/v1",
+                         max_ongoing_requests: int | None = None,
+                         max_queued_requests: int = 0,
+                         request_timeout_s: float | None = None,
+                         max_waiting: int | None = None):
     """Application for ``serve.run`` exposing the engine under the
     OpenAI surface: POST /v1/completions and /v1/chat/completions
-    (+ streaming via {"stream": true})."""
+    (+ streaming via {"stream": true}).
+
+    The overload knobs compose: ``max_ongoing_requests`` /
+    ``max_queued_requests`` bound the replica's request gate,
+    ``request_timeout_s`` stamps the default end-to-end deadline, and
+    ``max_waiting`` bounds the ENGINE's prompt line once every KV slot
+    is busy — all sheds surface as 429/RESOURCE_EXHAUSTED."""
     from ant_ray_tpu import serve  # noqa: PLC0415
 
     dep = serve.deployment(
         LLMServer, name=name, num_replicas=num_replicas,
-        route_prefix=route_prefix)
+        route_prefix=route_prefix,
+        max_ongoing_requests=max_ongoing_requests,
+        max_queued_requests=max_queued_requests,
+        request_timeout_s=request_timeout_s)
     return dep.bind(model, slots=slots, max_seq=max_seq,
                     tokenizer_name=tokenizer_name,
-                    tensor_parallel_size=tensor_parallel_size)
+                    tensor_parallel_size=tensor_parallel_size,
+                    max_waiting=max_waiting)

@@ -5,11 +5,14 @@ from ant_ray_tpu.serve.api import (
     CONTROLLER_NAME,
     Application,
     AutoscalingConfig,
+    CircuitBreakerConfig,
     Deployment,
     DeploymentHandle,
+    RequestRetryConfig,
     batch,
     deployment,
     get_multiplexed_model_id,
+    get_request_deadline,
     multiplexed,
     run,
     shutdown,
@@ -19,11 +22,14 @@ __all__ = [
     "CONTROLLER_NAME",
     "Application",
     "AutoscalingConfig",
+    "CircuitBreakerConfig",
     "Deployment",
     "DeploymentHandle",
+    "RequestRetryConfig",
     "batch",
     "deployment",
     "get_multiplexed_model_id",
+    "get_request_deadline",
     "multiplexed",
     "run",
     "shutdown",

@@ -18,11 +18,18 @@ import collections
 import contextvars
 import functools
 import itertools
+import math
 import random
 import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
+
+from ant_ray_tpu.exceptions import (
+    BackPressureError,
+    DeadlineExceededError,
+    GetTimeoutError,
+)
 
 CONTROLLER_NAME = "_serve_controller"
 
@@ -31,6 +38,88 @@ def _art():
     import ant_ray_tpu as art  # noqa: PLC0415
 
     return art
+
+
+# ------------------------------------------------------------- observability
+
+_METRICS: dict | None = None
+_METRICS_LOCK = threading.Lock()
+
+
+def _metrics() -> dict:
+    """Lazy ``art_serve_*`` instruments (PR 4 metrics plane: recorded to
+    the GCS metrics table, exported by the dashboard's /metrics).  Lazy
+    so importing serve never touches the worker runtime; emission is
+    best-effort and a no-op outside a cluster."""
+    global _METRICS
+    if _METRICS is None:
+        with _METRICS_LOCK:
+            if _METRICS is None:
+                from ant_ray_tpu.util.metrics import Counter, Gauge  # noqa: PLC0415
+
+                _METRICS = {
+                    "shed": Counter(
+                        "art_serve_shed_requests_total",
+                        "Requests shed by admission control / deadlines "
+                        "(reason: backpressure|deadline)",
+                        tag_keys=("deployment", "reason")),
+                    "queue_depth": Gauge(
+                        "art_serve_queue_depth",
+                        "Sum of per-replica ongoing+queued requests",
+                        tag_keys=("deployment",)),
+                    "breaker": Gauge(
+                        "art_serve_breaker_state",
+                        "Per-replica circuit breaker state "
+                        "(0=closed 1=half-open 2=open)",
+                        tag_keys=("deployment", "replica")),
+                    "suspect": Gauge(
+                        "art_serve_suspect_replicas",
+                        "Replicas ejected for repeated ongoing-poll "
+                        "timeouts", tag_keys=("deployment",)),
+                    "retries": Counter(
+                        "art_serve_retries_total",
+                        "Handle-level retries re-picked to another "
+                        "replica", tag_keys=("deployment",)),
+                    "retry_exhausted": Counter(
+                        "art_serve_retry_budget_exhausted_total",
+                        "Retries suppressed by an empty token bucket",
+                        tag_keys=("deployment",)),
+                }
+    return _METRICS
+
+
+def _emit(name: str, value: float, tags: dict) -> None:
+    try:
+        metric = _metrics()[name]
+        if hasattr(metric, "inc"):
+            metric.inc(value, tags)
+        else:
+            metric.set(value, tags)
+    except Exception:  # noqa: BLE001 — observability must never fail a request
+        pass
+
+
+def _typed_cause(exc: BaseException):
+    """Unwrap the typed overload error from an actor-task error chain
+    (a replica-raised BackPressureError arrives as
+    ``ActorError(cause=BackPressureError)``)."""
+    for c in (exc, getattr(exc, "cause", None)):
+        if isinstance(c, (BackPressureError, DeadlineExceededError)):
+            return c
+    return None
+
+
+def _record_result(routing, replica, exc: BaseException | None = None):
+    """Feed a request outcome into the replica's breaker.  Typed
+    overload sheds are the admission gate speaking, not a health
+    outcome; any other error (handler raise, actor death, connection
+    loss) counts as a failure — per-replica corruption usually
+    manifests as handler errors, and the ejection CAP
+    (``max_eject_fraction``) is what protects a healthy fleet from a
+    deterministic bad-input stream, not the error taxonomy."""
+    if exc is not None and _typed_cause(exc) is not None:
+        return
+    routing.record_outcome(replica, exc is None)
 
 
 # ---------------------------------------------------------------- public
@@ -50,6 +139,48 @@ class AutoscalingConfig:
     downscale_patience: int = 4
 
 
+@dataclass(frozen=True)
+class RequestRetryConfig:
+    """Opt-in handle-level retries for IDEMPOTENT handlers, bounded by
+    a token-bucket retry budget (ref in spirit: the reference router's
+    retryable-request semantics + SRE retry-budget practice).  Each
+    completed request earns ``budget_fraction`` tokens (capped at
+    ``budget_burst``); a retry spends one — a full outage can never
+    amplify offered load by more than ~``budget_fraction``."""
+
+    max_attempts: int = 3
+    budget_fraction: float = 0.1
+    budget_burst: float = 10.0
+    # Also retry replica-side BackPressureError sheds on a different
+    # replica (a re-pick, not a re-execution: the shed request never
+    # ran).
+    retry_backpressure: bool = True
+
+
+@dataclass(frozen=True)
+class CircuitBreakerConfig:
+    """Per-replica circuit breaker in the router (ref capability:
+    envoy-style outlier ejection; the reference routes around failing
+    replicas via health checks).  Opens on failure rate over a sliding
+    outcome window or on controller 'suspect' marks (repeated
+    ongoing-poll timeouts); after ``cooldown_s`` one probation probe is
+    allowed through (half-open) and a success closes the breaker."""
+
+    window: int = 20
+    min_outcomes: int = 5
+    failure_rate: float = 0.5
+    cooldown_s: float = 2.0
+    # Ejection cap (envoy max_ejection_percent): failure-RATE opens
+    # never eject more than this fraction of the replica set, so a
+    # deterministic bad-input stream (which fails on EVERY replica)
+    # cannot breaker-open a healthy deployment into a 429 outage.  A
+    # single-replica deployment is never rate-ejected (cap rounds to
+    # 0) — its errors surface to the client as themselves.  Liveness
+    # (controller suspect) opens bypass the cap: a genuinely dead
+    # replica must be ejected no matter how many already are.
+    max_eject_fraction: float = 0.5
+
+
 @dataclass
 class Deployment:
     cls_or_fn: Any
@@ -64,6 +195,15 @@ class Deployment:
     # extra replicas alive at once (ref: deployment_state.py:2597
     # rolling updates + max surge).
     rolling_max_surge: int = 1
+    # ---- overload-resilience knobs (ref: DeploymentConfig
+    # max_ongoing_requests / max_queued_requests + proxy
+    # request_timeout_s).  None max_ongoing_requests = no admission
+    # gate (legacy behavior).
+    max_ongoing_requests: int | None = None
+    max_queued_requests: int = 0
+    request_timeout_s: float | None = None
+    retry_config: RequestRetryConfig | None = None
+    breaker_config: CircuitBreakerConfig | None = None
 
     def bind(self, *args, **kwargs) -> "Application":
         return Application(self, args, kwargs)
@@ -73,9 +213,18 @@ class Deployment:
                 name: str | None = None,
                 autoscaling_config: AutoscalingConfig | dict | None = None,
                 rolling_max_surge: int | None = None,
+                max_ongoing_requests: int | None = None,
+                max_queued_requests: int | None = None,
+                request_timeout_s: float | None = None,
+                retry_config: "RequestRetryConfig | dict | None" = None,
+                breaker_config: "CircuitBreakerConfig | dict | None" = None,
                 ) -> "Deployment":
         if isinstance(autoscaling_config, dict):
             autoscaling_config = AutoscalingConfig(**autoscaling_config)
+        if isinstance(retry_config, dict):
+            retry_config = RequestRetryConfig(**retry_config)
+        if isinstance(breaker_config, dict):
+            breaker_config = CircuitBreakerConfig(**breaker_config)
         return Deployment(
             cls_or_fn=self.cls_or_fn,
             name=name or self.name,
@@ -90,7 +239,27 @@ class Deployment:
             rolling_max_surge=(rolling_max_surge
                                if rolling_max_surge is not None
                                else self.rolling_max_surge),
+            max_ongoing_requests=(max_ongoing_requests
+                                  if max_ongoing_requests is not None
+                                  else self.max_ongoing_requests),
+            max_queued_requests=(max_queued_requests
+                                 if max_queued_requests is not None
+                                 else self.max_queued_requests),
+            request_timeout_s=(request_timeout_s
+                               if request_timeout_s is not None
+                               else self.request_timeout_s),
+            retry_config=retry_config or self.retry_config,
+            breaker_config=breaker_config or self.breaker_config,
         )
+
+    def overload_config(self) -> dict:
+        """The routing-relevant knobs, pushed to every handle through
+        the controller's long-poll channel."""
+        return {
+            "request_timeout_s": self.request_timeout_s,
+            "retry": self.retry_config,
+            "breaker": self.breaker_config or CircuitBreakerConfig(),
+        }
 
 
 @dataclass
@@ -103,10 +272,19 @@ class Application:
 def deployment(_cls=None, *, name: str | None = None, num_replicas: int = 1,
                route_prefix: str | None = None,
                ray_actor_options: dict | None = None,
-               autoscaling_config: AutoscalingConfig | dict | None = None):
+               autoscaling_config: AutoscalingConfig | dict | None = None,
+               max_ongoing_requests: int | None = None,
+               max_queued_requests: int = 0,
+               request_timeout_s: float | None = None,
+               retry_config: "RequestRetryConfig | dict | None" = None,
+               breaker_config: "CircuitBreakerConfig | dict | None" = None):
     """``@serve.deployment`` decorator (ref: serve/api.py)."""
     if isinstance(autoscaling_config, dict):
         autoscaling_config = AutoscalingConfig(**autoscaling_config)
+    if isinstance(retry_config, dict):
+        retry_config = RequestRetryConfig(**retry_config)
+    if isinstance(breaker_config, dict):
+        breaker_config = CircuitBreakerConfig(**breaker_config)
 
     def wrap(cls_or_fn):
         return Deployment(
@@ -116,11 +294,23 @@ def deployment(_cls=None, *, name: str | None = None, num_replicas: int = 1,
             route_prefix=route_prefix,
             ray_actor_options=dict(ray_actor_options or {}),
             autoscaling_config=autoscaling_config,
+            max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
+            request_timeout_s=request_timeout_s,
+            retry_config=retry_config,
+            breaker_config=breaker_config,
         )
 
     if _cls is not None:
         return wrap(_cls)
     return wrap
+
+
+# How far AHEAD of the earliest request deadline the flusher fires: a
+# flush at exactly the deadline would shed the item it was pulled
+# forward for (the expiry check runs at flush time), so fire with this
+# much runway for the model call to complete and the reply to ship.
+_BATCH_FLUSH_MARGIN_S = 0.1
 
 
 def batch(_fn=None, *, max_batch_size: int = 8,
@@ -141,12 +331,30 @@ def batch(_fn=None, *, max_batch_size: int = 8,
         def get_state(self_obj):
             state = getattr(self_obj, state_attr, None)
             if state is None:
+                cv = threading.Condition()
                 state = self_obj.__dict__.setdefault(
-                    state_attr, {"lock": threading.Lock(), "items": []})
+                    state_attr, {"cv": cv, "items": []})
             return state
 
         def flush(self_obj, my_batch):
-            items = [it for it, _ in my_batch]
+            # Deadline-aware flush: items whose end-to-end deadline
+            # already expired are SHED (typed error, event set) without
+            # ever reaching the model — executing them would waste a
+            # model invocation slot on work nobody is waiting for.
+            now = time.time()
+            live = []
+            for item, slot in my_batch:
+                dl = slot["deadline_ts"]
+                if dl is not None and now >= dl:
+                    slot["result"] = DeadlineExceededError(
+                        f"request deadline expired "
+                        f"{now - dl:.3f}s before batch flush")
+                    slot["event"].set()
+                else:
+                    live.append((item, slot))
+            if not live:
+                return
+            items = [it for it, _ in live]
             try:
                 results = fn(self_obj, items)
                 if len(results) != len(items):
@@ -155,30 +363,49 @@ def batch(_fn=None, *, max_batch_size: int = 8,
                         f"results for {len(items)} items")
             except Exception as e:  # noqa: BLE001 — fan the error out
                 results = [e] * len(items)
-            for (_, slot), result in zip(my_batch, results):
+            for (_, slot), result in zip(live, results):
                 slot["result"] = result
                 slot["event"].set()
 
         def wrapper(self_obj, item):
             state = get_state(self_obj)
-            lock = state["lock"]
-            slot = {"event": threading.Event(), "result": None}
-            with lock:
+            cv = state["cv"]
+            # NB: read the deadline via the module-level accessor, not
+            # the ContextVar itself — this closure is cloudpickled by
+            # value with the user's class, and ContextVars can't be
+            # pickled (the accessor is resolved by reference).
+            slot = {"event": threading.Event(), "result": None,
+                    "deadline_ts": get_request_deadline()}
+            with cv:
                 state["items"].append((item, slot))
                 is_flusher = len(state["items"]) == 1
+                cv.notify_all()
             if is_flusher:
-                deadline = time.monotonic() + batch_wait_timeout_s
-                while time.monotonic() < deadline:
-                    with lock:
-                        if len(state["items"]) >= max_batch_size:
+                # Event-driven wait (no polling tax): arrivals notify
+                # the condition, so a full batch flushes the moment its
+                # last item lands, and an item with a tight end-to-end
+                # deadline pulls the flush forward so it is served
+                # before it expires.
+                wait_deadline = time.monotonic() + batch_wait_timeout_s
+                with cv:
+                    while len(state["items"]) < max_batch_size:
+                        remaining = wait_deadline - time.monotonic()
+                        req_dls = [s["deadline_ts"]
+                                   for _, s in state["items"]
+                                   if s["deadline_ts"] is not None]
+                        if req_dls:
+                            remaining = min(
+                                remaining, min(req_dls) - time.time()
+                                - _BATCH_FLUSH_MARGIN_S)
+                        if remaining <= 0:
                             break
-                    time.sleep(batch_wait_timeout_s / 10)
+                        cv.wait(remaining)
                 # Drain in ≤max_batch_size chunks until empty: the model
                 # never sees an oversized batch, and late arrivals that
                 # saw a non-empty queue (so didn't become flushers) are
                 # never stranded.
                 while True:
-                    with lock:
+                    with cv:
                         my_batch = state["items"][:max_batch_size]
                         state["items"] = state["items"][max_batch_size:]
                     if not my_batch:
@@ -207,11 +434,24 @@ def batch(_fn=None, *, max_batch_size: int = 8,
 _multiplexed_model_id: contextvars.ContextVar = contextvars.ContextVar(
     "serve_multiplexed_model_id", default="")
 
+# Absolute (time.time) end-to-end deadline of the in-flight request,
+# stamped by the ingress/handle and set by the replica around user-code
+# invocation so nested machinery (@serve.batch, the LLM engine) can
+# shed expired work instead of executing it.
+_request_deadline: contextvars.ContextVar = contextvars.ContextVar(
+    "serve_request_deadline", default=None)
+
 
 def get_multiplexed_model_id() -> str:
     """Model id of the in-flight request, inside a replica method
     (ref: serve.get_multiplexed_model_id)."""
     return _multiplexed_model_id.get()
+
+
+def get_request_deadline() -> float | None:
+    """Absolute ``time.time()`` deadline of the in-flight request (None
+    when the caller set no deadline), inside a replica method."""
+    return _request_deadline.get()
 
 
 def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
@@ -264,6 +504,20 @@ def multiplexed(_fn=None, *, max_num_models_per_replica: int = 3):
 
 
 
+class _Breaker:
+    """Per-replica circuit state inside a routing family (closed →
+    open → half-open → closed).  Mutated only under the routing lock."""
+
+    __slots__ = ("state", "outcomes", "opened_at", "last_probe_at")
+
+    def __init__(self, window: int):
+        self.state = "closed"
+        self.outcomes: collections.deque = collections.deque(
+            maxlen=max(1, window))
+        self.opened_at = 0.0
+        self.last_probe_at = 0.0
+
+
 class _RoutingState:
     """Replica set + queue snapshot shared by an options()-derived
     handle family, kept fresh by ONE controller long-poll listener
@@ -271,7 +525,12 @@ class _RoutingState:
     controller blocks the listen call until the deployment's version
     advances, so scale-ups/downs reach every handle within one push —
     no TTL staleness window.  A slow TTL poll remains as fallback for
-    the window before the listener's first reply (or if it dies)."""
+    the window before the listener's first reply (or if it dies).
+
+    Also owns the deployment's ROUTER RESILIENCE state: per-replica
+    circuit breakers (opened by observed failure rate or by controller
+    'suspect' marks from repeated ongoing-poll timeouts, re-entered via
+    half-open probation probes) and the token-bucket retry budget."""
 
     def __init__(self, name: str, replicas: list, controller):
         self.lock = threading.Lock()
@@ -279,10 +538,23 @@ class _RoutingState:
         self.replicas = list(replicas)
         self.ongoing: list = [0] * len(replicas)
         self.local_extra: dict[int, int] = {}
-        self.version = 0
+        # -1 = "never synced": the first listen_for_change round trip
+        # returns immediately with the deployment's CURRENT state —
+        # critically the overload config (request_timeout_s / retry /
+        # breaker) — instead of blocking until the next version bump.
+        # Construction sites that already hold a get_handle_info
+        # payload apply() it synchronously and skip this window.
+        self.version = -1
         self.controller = controller
         self._listener: threading.Thread | None = None
         self._last_poll = time.monotonic()
+        # Overload-plane config pushed by the controller (deployment
+        # defaults); present before the first push so raw handles work.
+        self.config: dict = {"request_timeout_s": None, "retry": None,
+                             "breaker": CircuitBreakerConfig()}
+        self.suspect: set = set()           # actor ids, controller-fed
+        self.breakers: dict = {}            # actor id -> _Breaker
+        self.retry_tokens: float | None = None
 
     def apply(self, info: dict) -> None:
         with self.lock:
@@ -305,7 +577,125 @@ class _RoutingState:
                                          [0] * len(new_replicas)))
             self.local_extra = extra
             self.version = info.get("version", self.version)
+            if info.get("config") is not None:
+                self.config = info["config"]
+            self._apply_suspects_locked(
+                set(info.get("suspect", ()) or ()), set(new_index))
         self._last_poll = time.monotonic()
+
+    # ------------------------------------------------- circuit breakers
+
+    def _apply_suspects_locked(self, new_suspect: set, live: set) -> None:
+        """Controller liveness verdicts are authoritative: a replica
+        whose ongoing polls time out repeatedly is force-opened (sticky
+        while suspect); when the controller's poll succeeds again the
+        breaker drops to half-open so the next request is a probation
+        probe, not a stampede."""
+        new_suspect &= live
+        now = time.monotonic()
+        for aid in new_suspect - self.suspect:
+            br = self._breaker_locked(aid)
+            if br.state != "open":
+                self._set_state_locked(aid, br, "open")
+                br.opened_at = now
+        for aid in self.suspect - new_suspect:
+            br = self.breakers.get(aid)
+            if br is not None and br.state == "open":
+                self._set_state_locked(aid, br, "half_open")
+                br.last_probe_at = 0.0
+        self.suspect = new_suspect
+        for aid in list(self.breakers):
+            if aid not in live:
+                del self.breakers[aid]
+
+    def _breaker_locked(self, aid) -> _Breaker:
+        br = self.breakers.get(aid)
+        if br is None:
+            br = _Breaker(self.config["breaker"].window)
+            self.breakers[aid] = br
+        return br
+
+    def _set_state_locked(self, aid, br: _Breaker, state: str) -> None:
+        br.state = state
+        _emit("breaker", {"closed": 0, "half_open": 1, "open": 2}[state],
+              {"deployment": self.name, "replica": aid.hex()[:12]})
+
+    def _probe_due_locked(self, aid, br: _Breaker, now: float) -> bool:
+        """True when an ejected replica has earned its probation probe:
+        never while the controller still suspects it, and at most one
+        probe per cooldown interval."""
+        if aid in self.suspect:
+            return False
+        cooldown = self.config["breaker"].cooldown_s
+        if br.state == "open":
+            if now - br.opened_at < cooldown:
+                return False
+            self._set_state_locked(aid, br, "half_open")
+            br.last_probe_at = 0.0
+        return now - br.last_probe_at >= cooldown
+
+    def record_outcome(self, replica, ok: bool) -> None:
+        """Feed a request outcome (observed wherever results are read:
+        handle.call(), the ingresses) into the replica's breaker and
+        earn retry-budget tokens."""
+        with self.lock:
+            rcfg = self.config.get("retry")
+            if rcfg is not None:
+                if self.retry_tokens is None:
+                    self.retry_tokens = float(rcfg.budget_burst)
+                self.retry_tokens = min(float(rcfg.budget_burst),
+                                        self.retry_tokens
+                                        + rcfg.budget_fraction)
+            aid = replica.actor_id
+            br = self._breaker_locked(aid)
+            if br.state != "closed":
+                # Only a HALF-OPEN success closes the breaker: a
+                # success landing while still "open" is a stale
+                # in-flight request dispatched before the trip, not a
+                # probation verdict — closing on it would bypass the
+                # cooldown and flap the breaker under concurrent
+                # traffic.  Failures always (re-)open.
+                if (ok and br.state == "half_open"
+                        and aid not in self.suspect):
+                    self._set_state_locked(aid, br, "closed")
+                    br.outcomes.clear()
+                elif not ok:
+                    self._set_state_locked(aid, br, "open")
+                    br.opened_at = time.monotonic()
+                return
+            br.outcomes.append(ok)
+            if ok:
+                return
+            bcfg = self.config["breaker"]
+            fails = sum(1 for o in br.outcomes if not o)
+            if (len(br.outcomes) >= bcfg.min_outcomes
+                    and fails / len(br.outcomes) >= bcfg.failure_rate):
+                # Ejection cap: rate-driven opens stop once the open
+                # share would exceed max_eject_fraction — a failure
+                # mode shared by EVERY replica (bad input) then keeps
+                # most of the fleet routable (suspect/liveness opens
+                # bypass this in _apply_suspects_locked).
+                already_open = sum(1 for o in self.breakers.values()
+                                   if o.state == "open")
+                cap = int(bcfg.max_eject_fraction * len(self.replicas))
+                if already_open < cap:
+                    self._set_state_locked(aid, br, "open")
+                    br.opened_at = time.monotonic()
+
+    def take_retry_token(self) -> bool:
+        with self.lock:
+            rcfg = self.config.get("retry")
+            if rcfg is None:
+                return False
+            if self.retry_tokens is None:
+                self.retry_tokens = float(rcfg.budget_burst)
+            if self.retry_tokens >= 1.0:
+                self.retry_tokens -= 1.0
+                return True
+            return False
+
+    def default_timeout(self) -> float | None:
+        return self.config.get("request_timeout_s")
 
     def ensure_listener(self) -> None:
         if self.controller is None or self._listener is not None:
@@ -357,6 +747,13 @@ class _RoutingState:
 # Controller-side long-poll window; client waits a bit longer.
 _LISTEN_TIMEOUT_S = 30.0
 
+# Ongoing-poll liveness: per-replica answer budget, and how many
+# consecutive failed polls make a replica SUSPECT (force-opens its
+# breaker in every handle).  ~3 × (0.25s loop + 2s budget) ≈ a wedge is
+# ejected within ~7s of going dark.
+_POLL_TIMEOUT_S = 2.0
+_POLL_STRIKE_LIMIT = 3
+
 
 class DeploymentHandle:
     """Client handle routing calls across a deployment's replicas with
@@ -376,7 +773,8 @@ class DeploymentHandle:
                  method_name: str = "__call__", stream: bool = False,
                  controller=None, multiplexed_model_id: str = "",
                  _mux_affinity: dict | None = None,
-                 _routing: "_RoutingState | None" = None):
+                 _routing: "_RoutingState | None" = None,
+                 _info: dict | None = None):
         self._name = deployment_name
         self._method = method_name
         self._stream = stream
@@ -392,6 +790,12 @@ class DeploymentHandle:
         self._routing = (_routing if _routing is not None
                          else _RoutingState(deployment_name, replicas,
                                             controller))
+        if _info is not None and _routing is None:
+            # Seed the overload config (deadline default, retry budget,
+            # breaker knobs) synchronously from the construction-time
+            # get_handle_info payload — the very first call must honor
+            # request_timeout_s, not wait for the listener's push.
+            self._routing.apply(_info)
         # Arm the push listener NOW, not on first use: a scale-down can
         # kill a replica from this handle's constructor-time list before
         # the first request, and the drain grace assumes every live
@@ -435,24 +839,68 @@ class DeploymentHandle:
         return self._routing.local_extra
 
     def _maybe_refresh(self):
+        if self._routing.version < 0 and self._controller is not None:
+            # Never-synced routing state (a handle reconstructed from a
+            # pickle — serve composition embeds handles in downstream
+            # deployments' args): the overload config must govern the
+            # FIRST dispatch, so fetch it synchronously once instead of
+            # racing the listener's first push.
+            try:
+                info = _art().get(
+                    self._controller.get_handle_info.remote(self._name),
+                    timeout=5)
+            except Exception:  # noqa: BLE001 — poll fallback covers it
+                pass
+            else:
+                if info is not None:
+                    self._routing.apply(info)
         self._routing.ensure_listener()
         self._routing.poll_fallback()
 
-    def _pick(self):
-        """Two random candidates, route to the shorter queue (cached
-        depth + dispatches this handle made since the last refresh).
-        Returns the replica HANDLE, resolved inside the critical
-        section — the listener thread may swap the replica list at any
-        moment, so an index is stale the instant the lock drops."""
+    def _pick(self, exclude: set | None = None):
+        """Two random candidates among breaker-ALLOWED replicas, route
+        to the shorter queue (cached depth + dispatches this handle made
+        since the last refresh).  An ejected replica due for its
+        probation probe is chosen deliberately (exactly one request per
+        cooldown) so breakers can close again; if every replica is
+        ejected the caller gets a typed BackPressureError instead of a
+        request lobbed at a known-bad replica.  Returns the replica
+        HANDLE, resolved inside the critical section — the listener
+        thread may swap the replica list at any moment, so an index is
+        stale the instant the lock drops."""
         with self._lock:
+            routing = self._routing
             n = len(self._replicas)
             if n == 0:
                 raise RuntimeError(
                     f"deployment {self._name} has no replicas")
-            if n == 1:
-                index = 0
+            now = time.monotonic()
+            candidates = []
+            for k in range(n):
+                aid = self._replicas[k].actor_id
+                if exclude and aid in exclude:
+                    continue
+                br = routing.breakers.get(aid)
+                if br is None or br.state == "closed":
+                    candidates.append(k)
+                elif routing._probe_due_locked(aid, br, now):
+                    # Probation probe: route THIS request to it.
+                    br.last_probe_at = now
+                    self._local_extra[k] = self._local_extra.get(k, 0) + 1
+                    return self._replicas[k]
+            if not candidates:
+                cooldown = routing.config["breaker"].cooldown_s
+                remaining = [max(0.0, cooldown - (now - br.opened_at))
+                             for br in routing.breakers.values()
+                             if br.state == "open"]
+                raise BackPressureError(
+                    f"deployment {self._name}: all replicas unavailable "
+                    "(circuit open / excluded)",
+                    retry_after_s=min(remaining, default=1.0))
+            if len(candidates) == 1:
+                index = candidates[0]
             else:
-                i, j = random.sample(range(n), 2)
+                i, j = random.sample(candidates, 2)
 
                 def load(k):
                     depth = (self._ongoing[k]
@@ -464,32 +912,137 @@ class DeploymentHandle:
                 self._local_extra.get(index, 0) + 1
             return self._replicas[index]
 
-    def remote(self, *args, **kwargs):
-        self._maybe_refresh()
-        model_id = self._mux_model_id
-        if model_id:
-            # Affinity is by replica IDENTITY: handles refresh their
-            # replica lists independently, so a stored index could point
-            # at a different replica after a resize.
-            replica = None
-            with self._lock:
-                target = self._mux_affinity.get(model_id)
-                if target is not None:
-                    for r in self._replicas:
-                        if r.actor_id == target.actor_id:
-                            replica = r
-                            break
-            if replica is None:
-                replica = self._pick()
-                with self._lock:
-                    self._mux_affinity[model_id] = replica
-        else:
-            replica = self._pick()
+    def _request_meta(self, timeout_s: float | None = None) -> dict | None:
+        """Stamp the end-to-end deadline carried to the replica: an
+        explicit per-call timeout wins, else the deployment's
+        ``request_timeout_s`` default pushed by the controller."""
+        timeout = (timeout_s if timeout_s is not None
+                   else self._routing.default_timeout())
+        if timeout is None:
+            return None
+        # NB: 0 is a real (already-expired) deadline — a gRPC client
+        # whose native deadline just hit zero must be shed, not granted
+        # unbounded time.
+        return {"deadline_ts": time.time() + float(timeout)}
+
+    def _dispatch(self, replica, args, kwargs, model_id: str,
+                  meta: dict | None):
         if self._stream:
             return replica.handle_request_streaming.remote(
-                self._method, args, kwargs, model_id)
+                self._method, args, kwargs, model_id, meta)
         return replica.handle_request.remote(self._method, args, kwargs,
-                                             model_id)
+                                             model_id, meta)
+
+    def _pick_affine(self, exclude: set | None = None):
+        """``_pick`` honoring multiplexed-model affinity.  Affinity is
+        by replica IDENTITY: handles refresh their replica lists
+        independently, so a stored index could point at a different
+        replica after a resize.  The remembered replica is skipped when
+        it is retry-excluded or breaker-ejected — the re-pick then
+        migrates the affinity (one model reload beats routing into a
+        known-bad replica)."""
+        model_id = self._mux_model_id
+        if not model_id:
+            return self._pick(exclude=exclude)
+        with self._lock:
+            target = self._mux_affinity.get(model_id)
+            if target is not None and not (exclude
+                                           and target.actor_id in exclude):
+                br = self._routing.breakers.get(target.actor_id)
+                if br is None or br.state == "closed":
+                    for r in self._replicas:
+                        if r.actor_id == target.actor_id:
+                            return r
+        replica = self._pick(exclude=exclude)
+        with self._lock:
+            self._mux_affinity[model_id] = replica
+        return replica
+
+    def remote(self, *args, **kwargs):
+        self._maybe_refresh()
+        replica = self._pick_affine()
+        return self._dispatch(replica, args, kwargs, self._mux_model_id,
+                              self._request_meta())
+
+    def call(self, *args, timeout_s: float | None = None, **kwargs):
+        """Blocking dispatch with the full resilience contract: the
+        deadline bounds the WHOLE request (queueing included), queued
+        work past deadline is cancelled via ``art.cancel`` so it never
+        executes, outcomes feed the per-replica circuit breakers, and —
+        when the deployment opts in via ``retry_config`` (idempotent
+        handlers only) — failures re-pick a different replica under the
+        token-bucket retry budget.  The ingresses route through here;
+        ``remote()`` stays the raw ref-returning path."""
+        art = _art()
+        self._maybe_refresh()
+        rcfg = self._routing.config.get("retry")
+        timeout = (timeout_s if timeout_s is not None
+                   else self._routing.default_timeout())
+        deadline = (time.time() + float(timeout)
+                    if timeout is not None else None)
+        attempts = rcfg.max_attempts if rcfg is not None else 1
+        exclude: set = set()
+        last_exc: Exception | None = None
+        for attempt in range(max(1, attempts)):
+            if deadline is not None and time.time() >= deadline:
+                raise last_exc or DeadlineExceededError(
+                    f"deadline expired before dispatch to {self._name}")
+            try:
+                replica = self._pick_affine(exclude=exclude)
+            except BackPressureError:
+                if last_exc is not None:
+                    # A retry that excluded every replica (e.g. a
+                    # single-replica deployment): surface the REAL
+                    # failure, not a misleading retriable 429.
+                    raise last_exc from None
+                raise
+            meta = ({"deadline_ts": deadline}
+                    if deadline is not None else None)
+            ref = self._dispatch(replica, args, kwargs,
+                                 self._mux_model_id, meta)
+            try:
+                remaining = (None if deadline is None
+                             else max(0.0, deadline - time.time()))
+                result = art.get(ref, timeout=remaining)
+            except GetTimeoutError:
+                # The deadline fired while the call was queued or
+                # running.  Cancel reaps it if it has not started —
+                # expired work is shed, not executed; running work
+                # cannot be preempted and is left to finish into the
+                # void.  Not a breaker outcome: slowness under load is
+                # the admission gate's problem, ejection is for
+                # *broken* replicas (errors / liveness strikes).
+                try:
+                    art.cancel(ref)
+                except Exception:  # noqa: BLE001 — best-effort reap
+                    pass
+                raise DeadlineExceededError(
+                    f"{self._name}: no reply within {timeout}s "
+                    f"(attempt {attempt + 1})") from None
+            except Exception as e:  # noqa: BLE001 — classified below
+                typed = _typed_cause(e)
+                if isinstance(typed, DeadlineExceededError):
+                    raise typed  # replica shed expired work; no retry
+                if isinstance(typed, BackPressureError):
+                    last_exc = typed
+                    retryable = (rcfg is not None
+                                 and rcfg.retry_backpressure)
+                else:
+                    _record_result(self._routing, replica, e)
+                    last_exc = e
+                    retryable = rcfg is not None
+                if not retryable or attempt >= attempts - 1:
+                    raise last_exc
+                if not self._routing.take_retry_token():
+                    _emit("retry_exhausted", 1,
+                          {"deployment": self._name})
+                    raise last_exc
+                exclude.add(replica.actor_id)
+                _emit("retries", 1, {"deployment": self._name})
+                continue
+            self._routing.record_outcome(replica, True)
+            return result
+        raise last_exc  # pragma: no cover — loop always returns/raises
 
     def __reduce__(self):
         return (DeploymentHandle,
@@ -501,58 +1054,178 @@ class DeploymentHandle:
 
 class Replica:
     """One replica actor wrapping the user's callable/class
-    (ref: serve/_private/replica.py:1124)."""
+    (ref: serve/_private/replica.py:1124).
 
-    def __init__(self, cls_or_fn, args, kwargs):
+    ADMISSION CONTROL lives here, replica-side, where the bound is
+    enforceable no matter how many handles/proxies dispatch (client-side
+    counting can always over-admit under fan-in): at most
+    ``max_ongoing_requests`` invocations execute user code concurrently,
+    at most ``max_queued_requests`` more may wait for a slot, and the
+    rest fast-fail with a typed :class:`BackPressureError` (429 /
+    RESOURCE_EXHAUSTED at the ingresses).  Queued work whose stamped
+    end-to-end deadline expires while waiting is SHED — never executed
+    (ref: DeploymentConfig.max_ongoing_requests/max_queued_requests)."""
+
+    def __init__(self, cls_or_fn, args, kwargs, limits: dict | None = None):
         if isinstance(cls_or_fn, type):
             self._instance = cls_or_fn(*args, **kwargs)
         else:
             self._instance = cls_or_fn  # plain function deployment
-        self._ongoing = 0
-        self._ongoing_lock = threading.Lock()
+        limits = limits or {}
+        self._deployment = limits.get("deployment", "")
+        self._max_ongoing = limits.get("max_ongoing_requests")
+        self._max_queued = int(limits.get("max_queued_requests", 0) or 0)
+        # One condition guards _running (user code executing now) and
+        # the FIFO wait line (_waiters: one opaque token per queued
+        # request, head owns the next freed slot).
+        self._admit_cv = threading.Condition()
+        self._running = 0
+        self._waiters: collections.deque = collections.deque()
+        # EWMA of service seconds — the basis for the Retry-After hint
+        # (how long until a slot plausibly frees).
+        self._ewma_service_s = 0.05
 
-    def _invoke(self, method_name: str, args, kwargs, model_id: str = ""):
+    # ------------------------------------------------------ admission
+
+    def _retry_after_locked(self) -> float:
+        """Server-side hint: roughly one service time per request that
+        must drain before new capacity appears."""
+        waiting = len(self._waiters) + 1
+        slots = max(1, self._max_ongoing or 1)
+        return max(0.05, self._ewma_service_s * waiting / slots)
+
+    def _admit(self, deadline_ts: float | None):
+        """Block until a user-code slot frees (bounded FIFO queue), or
+        shed: BackPressureError when the queue is full,
+        DeadlineExceededError when the deadline expires while queued.
+        No-op (count only) when the deployment sets no bound (legacy
+        behavior)."""
+        with self._admit_cv:
+            if self._max_ongoing is None:
+                self._running += 1
+                return
+            # Barge-free FIFO: with waiters present a fresh arrival
+            # lines up behind them even if a slot just freed (the head
+            # waiter owns it) — else a steady arrival stream starves a
+            # queued request into a deadline shed FIFO would have
+            # served.  The head check in the wait loop enforces it: a
+            # non-head waiter that wakes first goes back to sleep.
+            if self._running < self._max_ongoing and not self._waiters:
+                self._running += 1
+                return
+            if len(self._waiters) >= self._max_queued:
+                _emit("shed", 1, {"deployment": self._deployment,
+                                  "reason": "backpressure"})
+                raise BackPressureError(
+                    f"replica at capacity ({self._running} running, "
+                    f"{len(self._waiters)} queued)",
+                    retry_after_s=self._retry_after_locked())
+            token = object()
+            self._waiters.append(token)
+            try:
+                while (self._running >= self._max_ongoing
+                       or self._waiters[0] is not token):
+                    remaining = (None if deadline_ts is None
+                                 else deadline_ts - time.time())
+                    if remaining is not None and remaining <= 0:
+                        _emit("shed", 1,
+                              {"deployment": self._deployment,
+                               "reason": "deadline"})
+                        raise DeadlineExceededError(
+                            "deadline expired while queued for a "
+                            "replica slot — request shed, not "
+                            "executed")
+                    self._admit_cv.wait(remaining)
+            except BaseException:
+                self._waiters.remove(token)
+                # This waiter may have consumed a wakeup meant for a
+                # sibling (and its exit may promote a new head): pass
+                # it on or a queued request sleeps forever beside a
+                # free slot.
+                self._admit_cv.notify_all()
+                raise
+            self._waiters.remove(token)
+            self._running += 1
+
+    def _release(self, started: float) -> None:
+        with self._admit_cv:
+            self._running -= 1
+            elapsed = time.monotonic() - started
+            self._ewma_service_s += 0.2 * (elapsed - self._ewma_service_s)
+            # notify_all, not notify: only the FIFO head may take the
+            # slot, and a single notify could land on a non-head waiter
+            # (which re-sleeps), stranding the head.  Wait lines are
+            # bounded by max_queued, so the herd is small.
+            self._admit_cv.notify_all()
+
+    def _check_deadline(self, deadline_ts: float | None) -> None:
+        if deadline_ts is not None and time.time() >= deadline_ts:
+            _emit("shed", 1, {"deployment": self._deployment,
+                              "reason": "deadline"})
+            raise DeadlineExceededError(
+                "request deadline expired before execution — shed, "
+                "not executed")
+
+    # ------------------------------------------------------ dispatch
+
+    def _invoke(self, method_name: str, args, kwargs, model_id: str = "",
+                deadline_ts: float | None = None):
         token = _multiplexed_model_id.set(model_id) if model_id else None
+        dl_token = _request_deadline.set(deadline_ts)
         try:
             if method_name == "__call__":
                 return self._instance(*args, **kwargs)
             return getattr(self._instance, method_name)(*args, **kwargs)
         finally:
+            _request_deadline.reset(dl_token)
             if token is not None:
                 _multiplexed_model_id.reset(token)
 
     def handle_request(self, method_name: str, args, kwargs,
-                       model_id: str = ""):
-        with self._ongoing_lock:
-            self._ongoing += 1
+                       model_id: str = "", meta: dict | None = None):
+        deadline_ts = (meta or {}).get("deadline_ts")
+        self._check_deadline(deadline_ts)      # shed before queueing
+        self._admit(deadline_ts)               # bounded queue / shed
+        started = time.monotonic()
         try:
-            return self._invoke(method_name, args, kwargs, model_id)
+            self._check_deadline(deadline_ts)  # shed before execution
+            return self._invoke(method_name, args, kwargs, model_id,
+                                deadline_ts)
         finally:
-            with self._ongoing_lock:
-                self._ongoing -= 1
+            self._release(started)
 
     def handle_request_streaming(self, method_name: str, args, kwargs,
-                                 model_id: str = ""):
+                                 model_id: str = "",
+                                 meta: dict | None = None):
         """Streaming dispatch: the target method must return a generator;
         its items flow back as a streaming actor call.  The ongoing
         count covers the WHOLE stream — a replica mid-generation must
         look busy to routing and must not be an autoscaler down-scale
         victim."""
-        with self._ongoing_lock:
-            self._ongoing += 1
+        deadline_ts = (meta or {}).get("deadline_ts")
+        self._check_deadline(deadline_ts)
+        self._admit(deadline_ts)
+        started = time.monotonic()
+        # Tokens span the WHOLE stream: the generator body runs during
+        # iteration, long after _invoke (which only creates it, with
+        # the same context) has returned.
         token = _multiplexed_model_id.set(model_id) if model_id else None
+        dl_token = _request_deadline.set(deadline_ts)
         try:
-            yield from self._invoke(method_name, args, kwargs)
+            yield from self._invoke(method_name, args, kwargs, model_id,
+                                    deadline_ts)
         finally:
+            _request_deadline.reset(dl_token)
             if token is not None:
                 _multiplexed_model_id.reset(token)
-            with self._ongoing_lock:
-                self._ongoing -= 1
+            self._release(started)
 
     def ongoing(self) -> int:
         """Queue-depth metric feeding autoscaling and po2 routing
-        (ref: replica queue-length metrics, autoscaling_state.py)."""
-        return self._ongoing
+        (ref: replica queue-length metrics, autoscaling_state.py):
+        executing AND queued — an admitted-but-waiting request is load
+        the router must see."""
+        return self._running + len(self._waiters)
 
     def health(self):
         return "ok"
@@ -610,7 +1283,10 @@ class ServeController:
                         changed[name] = {
                             "version": entry["version"],
                             "replicas": list(entry["replicas"]),
-                            "ongoing": list(entry["ongoing"])}
+                            "ongoing": list(entry["ongoing"]),
+                            "config":
+                                entry["deployment"].overload_config(),
+                            "suspect": set(entry.get("suspect", ()))}
                 if changed:
                     return changed
                 remaining = deadline - time.monotonic()
@@ -628,12 +1304,24 @@ class ServeController:
         # be thread-safe.  @serve.batch also requires an explicit
         # max_concurrency.
         default_conc = 8 if deployment.autoscaling_config is not None else 1
+        if deployment.max_ongoing_requests is not None:
+            # Admission control moves the execution bound into the
+            # replica's gate (max_ongoing slots + max_queued waiters),
+            # so the actor's thread pool must be WIDER than the gate:
+            # excess calls need a thread to reach the gate and
+            # fast-fail, and ongoing()/health() polls must not starve
+            # behind queued work (+8 headroom for both).
+            default_conc = (deployment.max_ongoing_requests
+                            + max(deployment.max_queued_requests, 0) + 8)
         replica_cls = art.remote(Replica).options(
             **{"num_cpus": deployment.ray_actor_options.get("num_cpus", 0),
                "max_concurrency": deployment.ray_actor_options.get(
                    "max_concurrency", default_conc)})
+        limits = {"deployment": deployment.name,
+                  "max_ongoing_requests": deployment.max_ongoing_requests,
+                  "max_queued_requests": deployment.max_queued_requests}
         replicas = [
-            replica_cls.remote(deployment.cls_or_fn, args, kwargs)
+            replica_cls.remote(deployment.cls_or_fn, args, kwargs, limits)
             for _ in range(n)
         ]
         try:
@@ -675,6 +1363,11 @@ class ServeController:
                 "ongoing": [0] * len(replicas),
                 "low_streak": 0,
                 "version": 0,
+                # Per-replica consecutive ongoing-poll failures; at
+                # _POLL_STRIKE_LIMIT the replica is marked suspect and
+                # every handle's breaker force-opens against it.
+                "strikes": {},
+                "suspect": set(),
             }
             self._deployments[deployment.name] = entry
             self._bump_version_locked(entry)
@@ -760,35 +1453,112 @@ class ServeController:
                 return None
             return {"replicas": list(entry["replicas"]),
                     "ongoing": list(entry["ongoing"]),
-                    "version": entry.get("version", 0)}
+                    "version": entry.get("version", 0),
+                    "config": entry["deployment"].overload_config(),
+                    "suspect": set(entry.get("suspect", ()))}
 
     # ------------------------------------------------------ autoscaling
 
-    def _scale_loop(self):
-        import math  # noqa: PLC0415
-
+    def _poll_ongoing_all(self, entries: list) -> dict:
+        """Issue EVERY deployment's per-replica ``ongoing()`` polls up
+        front and bound them with ONE combined wait: a wedged replica
+        costs _POLL_TIMEOUT_S once per loop iteration, not once per
+        deployment, so strike cadence (and healthy deployments' queue
+        snapshots) never degrade with deployment count."""
         art = _art()
+        polls = [(name, replicas,
+                  [r.ongoing.remote() for r in replicas])
+                 for name, replicas in entries]
+        all_refs = [ref for _, _, refs in polls for ref in refs]
+        if not all_refs:
+            return {}
+        try:
+            art.wait(all_refs, num_returns=len(all_refs),
+                     timeout=_POLL_TIMEOUT_S)
+        except Exception:  # noqa: BLE001 — control plane blip
+            return {}
+        out = {}
+        for name, replicas, refs in polls:
+            counts = self._collect_ongoing(name, replicas, refs)
+            if counts is not None:
+                out[name] = counts
+        return out
+
+    def _collect_ongoing(self, name: str, replicas: list,
+                         refs: list) -> "list | None":
+        """Per-replica queue-depth poll with STRIKE accounting.  The old
+        loop did one batched ``art.get`` and swallowed every exception —
+        a single wedged replica froze the whole deployment's queue
+        snapshot at its last value, and po2 kept routing to the wedge
+        forever.  Now each replica answers (or fails) individually:
+        consecutive failures count strikes, and at _POLL_STRIKE_LIMIT
+        the replica is marked SUSPECT — pushed to every handle, whose
+        breaker force-opens against it until a later poll succeeds."""
+        art = _art()
+        counts: list = [None] * len(replicas)
+        failed: list = []
+        for i, (replica, ref) in enumerate(zip(replicas, refs)):
+            try:
+                counts[i] = int(art.get(ref, timeout=0))
+            except Exception:  # noqa: BLE001 — timeout, died, wedged
+                failed.append(replica.actor_id)
+        suspect_changed = False
+        with self._lock:
+            entry = self._deployments.get(name)
+            if entry is None or entry["replicas"] != replicas:
+                return None
+            strikes = entry["strikes"]
+            suspect = entry["suspect"]
+            live = {r.actor_id for r in replicas}
+            for aid in list(strikes):
+                if aid not in live:
+                    strikes.pop(aid)
+            suspect_stale = suspect - live
+            for i, replica in enumerate(replicas):
+                aid = replica.actor_id
+                if counts[i] is None:
+                    strikes[aid] = strikes.get(aid, 0) + 1
+                    if (strikes[aid] >= _POLL_STRIKE_LIMIT
+                            and aid not in suspect):
+                        suspect.add(aid)
+                        suspect_changed = True
+                    # Keep the last known depth for the snapshot; the
+                    # breaker (not a stale low count) removes a suspect
+                    # replica from routing.
+                    counts[i] = (entry["ongoing"][i]
+                                 if i < len(entry["ongoing"]) else 0)
+                else:
+                    strikes.pop(aid, None)
+                    if aid in suspect:
+                        suspect.discard(aid)
+                        suspect_changed = True
+            if suspect_stale:
+                suspect -= suspect_stale
+                suspect_changed = True
+            entry["ongoing"] = counts
+            if suspect_changed:
+                # Suspect verdicts ride the same long-poll push as
+                # replica-set changes: every handle hears within one
+                # listen round trip.
+                self._bump_version_locked(entry)
+            n_suspect = len(suspect)
+        _emit("queue_depth", sum(counts), {"deployment": name})
+        _emit("suspect", n_suspect, {"deployment": name})
+        return counts
+
+    def _scale_loop(self):
         while not self._stopping:
             time.sleep(0.25)
             with self._lock:
-                names = list(self._deployments)
-            for name in names:
-                with self._lock:
-                    entry = self._deployments.get(name)
-                    if entry is None:
-                        continue
-                    replicas = list(entry["replicas"])
-                    cfg = entry["deployment"].autoscaling_config
-                try:
-                    counts = art.get(
-                        [r.ongoing.remote() for r in replicas], timeout=5)
-                except Exception:  # noqa: BLE001 — replicas mid-change
+                snapshot = [(name, list(entry["replicas"]),
+                             entry["deployment"].autoscaling_config)
+                            for name, entry in self._deployments.items()]
+            polled = self._poll_ongoing_all(
+                [(name, replicas) for name, replicas, _ in snapshot])
+            for name, replicas, cfg in snapshot:
+                counts = polled.get(name)
+                if counts is None:
                     continue
-                with self._lock:
-                    entry = self._deployments.get(name)
-                    if entry is None or entry["replicas"] != replicas:
-                        continue
-                    entry["ongoing"] = counts
                 if cfg is None:
                     continue
                 with self._lock:
@@ -1066,36 +1836,74 @@ class HttpProxy:
                                     name))
                             handle = DeploymentHandle(
                                 name, info["replicas"],
-                                controller=self._controller)
+                                controller=self._controller,
+                                _info=info)
                             self._handles[name] = handle
                     return handle
             return None
 
-        def dispatch(path: str, body):
+        def shed_response(e: BaseException):
+            """Typed overload errors → the documented HTTP statuses:
+            429 + Retry-After (seconds, integral and >= 1 per RFC 9110)
+            for sheds, 504 for deadline misses; None for anything else.
+            The ONE place the HTTP shed contract is rendered — unary
+            and streaming both route through it."""
+            typed = _typed_cause(e)
+            if isinstance(typed, BackPressureError):
+                return web.json_response(
+                    {"error": str(typed),
+                     "retry_after_s": typed.retry_after_s},
+                    status=429,
+                    headers={"Retry-After": str(
+                        max(1, math.ceil(typed.retry_after_s)))})
+            if isinstance(typed, DeadlineExceededError):
+                return web.json_response({"error": str(typed)},
+                                         status=504)
+            return None
+
+        def dispatch(path: str, body, timeout_s: float | None):
             """Blocking route+call (runs on an executor thread so the
-            aiohttp loop stays free)."""
+            aiohttp loop stays free; building an unprepared Response
+            off-loop is fine).  Routes through ``handle.call`` for the
+            full overload contract."""
             handle = resolve_handle(path)
             if handle is None:
-                return {"error": f"no route for {path}"}, 404
+                return web.json_response(
+                    {"error": f"no route for {path}"}, status=404)
             if isinstance(body, dict):
                 # Deployments that serve several REST endpoints under
                 # one prefix (e.g. /v1/completions + /v1/chat/...)
                 # dispatch on the request path (ref: proxy passes the
                 # scope through to the replica).
                 body.setdefault("__route_path__", path)
-            return {"result": art.get(handle.remote(body))}, 200
+            try:
+                return web.json_response(
+                    {"result": handle.call(body, timeout_s=timeout_s)})
+            except Exception as e:  # noqa: BLE001 — classified below
+                resp = shed_response(e)
+                if resp is not None:
+                    return resp
+                return web.json_response({"error": repr(e)}, status=500)
 
-        def stream_start(path: str, body):
-            """Start a streaming call; returns the ObjectRefGenerator
-            (convention: ``{"stream": true}`` requests dispatch to the
-            deployment's ``stream`` method as a generator)."""
+        def stream_start(path: str, body, timeout_s: float | None):
+            """Start a streaming call; returns (handle, replica,
+            ObjectRefGenerator) — the replica so the caller can feed
+            the stream's outcome into its breaker (convention:
+            ``{"stream": true}`` requests dispatch to the deployment's
+            ``stream`` method as a generator).  The end-to-end deadline
+            (explicit header or deployment default) is stamped on the
+            dispatch like the unary path."""
             handle = resolve_handle(path)
             if handle is None:
                 return None
             if isinstance(body, dict):
                 body.setdefault("__route_path__", path)
-            return handle.options(method_name="stream",
-                                  stream=True).remote(body)
+            h = handle.options(method_name="stream", stream=True)
+            h._maybe_refresh()
+            replica = h._pick()     # may raise typed BackPressureError
+            return (h, replica,
+                    h._dispatch(replica, (body,), {}, h._mux_model_id,
+                                h._request_meta(timeout_s)))
 
         def next_chunk(gen):
             try:
@@ -1112,33 +1920,83 @@ class HttpProxy:
             except Exception:  # noqa: BLE001
                 body = {}
             loop_ = asyncio.get_running_loop()
+            # Client-requested end-to-end deadline: seconds from now in
+            # the X-Request-Timeout-S header (wins over the
+            # deployment's request_timeout_s default).  Parsed before
+            # the stream branch — streaming requests carry deadlines
+            # too.
+            timeout_s = None
+            raw_timeout = request.headers.get("X-Request-Timeout-S")
+            if raw_timeout:
+                try:
+                    timeout_s = float(raw_timeout)
+                except ValueError:
+                    return web.json_response(
+                        {"error": "X-Request-Timeout-S must be a "
+                                  "float (seconds)"}, status=400)
             if isinstance(body, dict) and body.get("stream"):
                 # Server-sent events: one `data:` frame per produced
                 # chunk, flowing while the model still generates
                 # (ref: serve streaming HTTP responses).
-                gen = await loop_.run_in_executor(
-                    None, stream_start, request.path, body)
-                if gen is None:
+                try:
+                    started = await loop_.run_in_executor(
+                        None, stream_start, request.path, body,
+                        timeout_s)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    # _pick with every replica ejected raises typed
+                    # BackPressureError: same shed contract as unary.
+                    # NB: explicit None check — an unprepared
+                    # web.Response is FALSY (it has __len__), so `or`
+                    # would silently discard the 429.
+                    resp_t = shed_response(e)
+                    if resp_t is not None:
+                        return resp_t
+                    return web.json_response({"error": repr(e)},
+                                             status=500)
+                if started is None:
                     return web.json_response(
                         {"error": f"no route for {request.path}"},
                         status=404)
+                sh, replica, gen = started
+                # Pull the FIRST chunk before sending SSE headers: the
+                # replica's admission gate / deadline check fires on
+                # generator start, so a shed must surface as the
+                # documented typed status — not a 200 that dies
+                # mid-stream with no Retry-After.
+                try:
+                    chunk = await loop_.run_in_executor(
+                        None, next_chunk, gen)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    _record_result(sh._routing, replica, e)
+                    resp_t = shed_response(e)
+                    if resp_t is not None:
+                        return resp_t
+                    return web.json_response({"error": repr(e)},
+                                             status=500)
                 resp = web.StreamResponse(
                     headers={"Content-Type": "text/event-stream",
                              "Cache-Control": "no-cache"})
                 await resp.prepare(request)
-                while True:
-                    chunk = await loop_.run_in_executor(
-                        None, next_chunk, gen)
-                    if chunk is None:
-                        break
+                while chunk is not None:
                     await resp.write(
                         b"data: " + _json.dumps(chunk).encode() + b"\n\n")
+                    try:
+                        chunk = await loop_.run_in_executor(
+                            None, next_chunk, gen)
+                    except Exception as e:  # noqa: BLE001 — mid-stream
+                        # Headers already went out: feed the breaker
+                        # and end the stream (the client sees the
+                        # missing [DONE]).  resp.write failures (client
+                        # gone) are NOT replica outcomes and propagate.
+                        _record_result(sh._routing, replica, e)
+                        await resp.write_eof()
+                        return resp
+                _record_result(sh._routing, replica)
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()
                 return resp
-            payload, status = await loop_.run_in_executor(
-                None, dispatch, request.path, body)
-            return web.json_response(payload, status=status)
+            return await loop_.run_in_executor(
+                None, dispatch, request.path, body, timeout_s)
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", handler)
@@ -1195,7 +2053,7 @@ class GrpcProxy:
                             self._controller.get_handle_info.remote(name))
                         handle = DeploymentHandle(
                             name, info["replicas"],
-                            controller=self._controller)
+                            controller=self._controller, _info=info)
                         self._handles[name] = handle
                 return handle
         return None
@@ -1217,20 +2075,52 @@ class GrpcProxy:
             body.setdefault("__route_path__", route)
         return route, body
 
+    @staticmethod
+    def _abort_overload(context, e: BaseException) -> None:
+        """abort() with the documented typed mapping — the ONE place
+        the gRPC shed contract is rendered (RESOURCE_EXHAUSTED + the
+        retry hint in a ``retry-after-s`` trailer / DEADLINE_EXCEEDED).
+        Returns (without aborting) when ``e`` is not an overload error;
+        the caller handles it."""
+        import grpc  # noqa: PLC0415
+
+        typed = _typed_cause(e)
+        if isinstance(typed, BackPressureError):
+            context.set_trailing_metadata(
+                (("retry-after-s", f"{typed.retry_after_s:.3f}"),))
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(typed))
+        if isinstance(typed, DeadlineExceededError):
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(typed))
+
     def _call(self, request_bytes, context):
         import json  # noqa: PLC0415
 
         import grpc  # noqa: PLC0415
 
-        art = _art()
         route, body = self._parse(request_bytes, context)
         handle = self._resolve_handle(route)
         if handle is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"no route for {route}")
+        # End-to-end deadline: the native gRPC deadline (time_remaining)
+        # and/or an explicit {"timeout_s": ...} in the payload — the
+        # tighter one wins; the deployment default applies when neither
+        # is set.
+        timeout_s = None
+        if isinstance(body, dict) and body.get("timeout_s") is not None:
+            try:
+                timeout_s = float(body["timeout_s"])
+            except (TypeError, ValueError):
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              "timeout_s must be a number (seconds)")
+        native = context.time_remaining()
+        if native is not None:
+            timeout_s = (native if timeout_s is None
+                         else min(timeout_s, native))
         try:
-            result = art.get(handle.remote(body))
-        except Exception as e:  # noqa: BLE001 — user code error
+            result = handle.call(body, timeout_s=timeout_s)
+        except Exception as e:  # noqa: BLE001 — classified below
+            self._abort_overload(context, e)
             context.abort(grpc.StatusCode.INTERNAL, repr(e))
         return json.dumps({"result": result}).encode("utf-8")
 
@@ -1245,10 +2135,26 @@ class GrpcProxy:
         if handle is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
                           f"no route for {route}")
-        gen = handle.options(method_name="stream",
-                             stream=True).remote(body)
-        for ref in gen:
-            yield json.dumps(art.get(ref)).encode("utf-8")
+        # Deadline rides the native gRPC call deadline; sheds map to
+        # the same typed statuses as the unary path (the replica's
+        # admission gate fires on generator start, i.e. the first get).
+        h = handle.options(method_name="stream", stream=True)
+        h._maybe_refresh()
+        try:
+            replica = h._pick()
+        except BackPressureError as e:
+            # Every replica ejected: same shed contract as unary.
+            self._abort_overload(context, e)
+        gen = h._dispatch(replica, (body,), {}, h._mux_model_id,
+                          h._request_meta(context.time_remaining()))
+        try:
+            for ref in gen:
+                yield json.dumps(art.get(ref)).encode("utf-8")
+        except Exception as e:  # noqa: BLE001 — classified below
+            _record_result(h._routing, replica, e)
+            self._abort_overload(context, e)
+            raise
+        _record_result(h._routing, replica)
 
     def start(self, port: int) -> int:
         from concurrent import futures  # noqa: PLC0415
@@ -1313,7 +2219,7 @@ def run(app: Application, *, port: int | None = None,
     # The controller reference lets the handle refresh its replica set
     # (autoscaling) and queue snapshot (po2 routing) on a TTL.
     return DeploymentHandle(app.deployment.name, info["replicas"],
-                            controller=controller)
+                            controller=controller, _info=info)
 
 
 run.last_http_port = None

@@ -74,6 +74,7 @@ class ChaosSchedule:
         self.seed = seed
         self.rng = random.Random(seed)
         self._rpc_failures: dict[str, float] = {}
+        self._rpc_latency: dict[str, float] = {}
         self._config: dict = {}
         self._actions: list[_Action] = []
         self._notice_files: list[str] = []
@@ -84,6 +85,15 @@ class ChaosSchedule:
         """Drop ``method`` RPCs with probability ``prob`` (seeded —
         protocol._ChaosInjector; ref: rpc_chaos.h)."""
         self._rpc_failures[method] = prob
+        return self
+
+    def rpc_latency(self, method: str, seconds: float) -> "ChaosSchedule":
+        """Inject ``seconds`` of client-side latency before every
+        ``method`` RPC (testing_rpc_latency_s — protocol._ChaosInjector).
+        The deterministic slow-replica / slow-network knob: e.g.
+        ``rpc_latency("PushTask", 0.05)`` makes every actor call ride a
+        congested link."""
+        self._rpc_latency[method] = seconds
         return self
 
     def chunk_serve_delay(self, seconds: float) -> "ChaosSchedule":
@@ -135,6 +145,9 @@ class ChaosSchedule:
                 [f"seed:{self.seed}"]
                 + [f"{m}:{p}"
                    for m, p in sorted(self._rpc_failures.items())])
+        if self._rpc_latency:
+            out["testing_rpc_latency_s"] = ",".join(
+                f"{m}:{s}" for m, s in sorted(self._rpc_latency.items()))
         return out
 
     # ------------------------------------------------- scheduled actions

@@ -181,6 +181,14 @@ _GUARDED_METRICS = {
     # (arxiv 2510.20171) — regressions here are regressions in goodput.
     "train_recovery_time_s": "lower",
     "goodput_under_chaos": "higher",
+    # Serve overload plane (PR 7): admitted-request throughput under
+    # >= 4x offered load, and the typed-shed share of offered requests.
+    # BOTH guard "higher": goodput dropping means the request plane
+    # lost capacity; shed fraction dropping toward zero at fixed 4x+
+    # overload means the admission bound stopped holding (requests
+    # queueing unboundedly instead of fast-failing with 429).
+    "serve_goodput_under_overload": "higher",
+    "serve_shed_fraction": "higher",
 }
 
 

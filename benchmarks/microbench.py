@@ -353,6 +353,77 @@ def main() -> None:
                           "bench_error":
                           f"resilience bench failed: {e!r}"[:300]}))
 
+    # ---- serve overload plane: goodput + shed fraction at >= 4x
+    # offered load.  A bounded deployment (2 replicas x (1 running +
+    # 1 queued), 100 ms service, 1 s deadline) takes closed-loop
+    # traffic from 8 clients whose sheds return in milliseconds, so
+    # offered load far exceeds the ~20 req/s capacity.  The 100 ms
+    # service time is deliberate: capacity is service-dominated (not
+    # RPC-RTT-dominated), so both numbers are stable on a loaded rig.
+    # `serve_goodput_under_overload` is completed-in-deadline requests
+    # per second (healthy admission control keeps it near replica
+    # capacity no matter the offered load); `serve_shed_fraction` is
+    # the typed-reject share of offered requests — at 4x+ overload
+    # MOST requests must shed, so a drop toward zero means the
+    # admission bound stopped holding (work queueing unboundedly
+    # instead of fast-failing).
+    try:
+        import threading  # noqa: PLC0415
+
+        from ant_ray_tpu import serve  # noqa: PLC0415
+        from ant_ray_tpu.exceptions import (  # noqa: PLC0415
+            BackPressureError,
+            DeadlineExceededError,
+        )
+
+        art.init(num_cpus=2, ignore_reinit_error=True)
+
+        @serve.deployment(name="bench_overload", num_replicas=2,
+                          max_ongoing_requests=1, max_queued_requests=1,
+                          request_timeout_s=1.0)
+        class _Bounded:
+            def __call__(self, x=None):
+                time.sleep(0.1)
+                return x
+
+        handle = serve.run(_Bounded.bind())
+        handle.call()                               # warm the route
+        duration = max(3.0, 8 * scale)
+        stop_at = time.monotonic() + duration
+        counts = {"ok": 0, "shed": 0, "deadline": 0}
+        count_lock = threading.Lock()
+
+        def overload_client():
+            while time.monotonic() < stop_at:
+                try:
+                    handle.call()
+                    tag = "ok"
+                except BackPressureError:
+                    tag = "shed"
+                except DeadlineExceededError:
+                    tag = "deadline"
+                with count_lock:
+                    counts[tag] += 1
+
+        clients = [threading.Thread(target=overload_client)
+                   for _ in range(8)]
+        for t in clients:
+            t.start()
+        for t in clients:
+            t.join()
+        offered = sum(counts.values())
+        assert offered and counts["ok"], counts
+        emit("serve_goodput_under_overload", counts["ok"] / duration,
+             "req/s")
+        emit("serve_shed_fraction",
+             (offered - counts["ok"]) / offered, "fraction")
+        serve.shutdown()
+        art.shutdown()
+    except Exception as e:  # noqa: BLE001 — bench must not die here
+        print(json.dumps({"metric": "bench_error",
+                          "bench_error":
+                          f"serve overload bench failed: {e!r}"[:300]}))
+
     # ---- regression guard vs the committed control file
     import sys  # noqa: PLC0415
 
