@@ -253,6 +253,21 @@ class Config:
     # may carry "<deadline_s> <reason...>".
     testing_preemption_notice: str = ""
 
+    # ---- tracing (observability/tracing_plane.py) ----
+    # Head-sampling rate for request traces: the coin is flipped ONCE at
+    # each ingress (serve HTTP/gRPC request, handle.call, driver
+    # .remote()) and the verdict propagates with the context, Dapper
+    # style.  Error/shed spans are force-sampled regardless.  1.0 traces
+    # everything (tests/debugging); 0 disables minting sampled traces.
+    trace_sample_rate: float = 0.01
+    # Per-process flight-recorder ring size (spans).  Force-sampled
+    # error spans keep a separate ring of size/4 so healthy traffic
+    # wrapping the main ring never evicts failure evidence.
+    flight_recorder_size: int = 4096
+    # Sampled spans batch-published to the GCS span ring once this many
+    # are pending (age-flushed at 1s regardless).
+    trace_publish_batch: int = 128
+
     # ---- logging ----
     log_level: str = "INFO"
 

@@ -57,6 +57,7 @@ from ant_ray_tpu._private.task_options import ActorOptions, TaskOptions
 from ant_ray_tpu.util.scheduling_strategies import strategy_wire
 from ant_ray_tpu._private.worker import CoreRuntime
 from ant_ray_tpu.object_ref import ObjectRef, set_refcount_hook
+from ant_ray_tpu.observability import tracing_plane
 
 logger = logging.getLogger(__name__)
 
@@ -733,6 +734,11 @@ class ClusterRuntime(CoreRuntime):
         how long a *crashed* reader can wedge an arena slot; live
         readers renew at TTL/3 so a deserialized array held for hours
         stays backed."""
+        # This task was spawned from inside a (possibly traced) get()
+        # coroutine and inherited its context copy — clear the trace
+        # var or every renew heartbeat for the life of the process
+        # would record spans attributed to one long-finished request.
+        tracing_plane.set_current(None)
         while not self._shutdown:
             ttl = global_config().zero_copy_pin_ttl_s
             await asyncio.sleep(max(0.05, ttl / 3.0))
@@ -767,12 +773,18 @@ class ClusterRuntime(CoreRuntime):
         memory, pinned at the daemon until the deserialized value is
         GC'd (ref: plasma-backed read-only arrays — ray.get of a numpy
         array returns a view over shm, not a copy)."""
-        reply = await self._node.call_async(
-            "EnsureLocal",
-            {"object_id": oid, "timeout": timeout if timeout else 60.0,
-             "fail_fast_after": global_config().pull_no_holders_grace_s,
-             "pin_ttl": global_config().zero_copy_pin_ttl_s},
-            timeout=-1)
+        payload = {"object_id": oid,
+                   "timeout": timeout if timeout else 60.0,
+                   "fail_fast_after": global_config().pull_no_holders_grace_s,
+                   "pin_ttl": global_config().zero_copy_pin_ttl_s}
+        # Inside a sampled trace (caller context rides into this get()
+        # coroutine) the daemon records the pull as a child span — the
+        # client side is covered by the generic rpc:EnsureLocal span.
+        trace = tracing_plane.current_sampled()
+        if trace is not None:
+            payload["trace"] = trace.to_wire()
+        reply = await self._node.call_async("EnsureLocal", payload,
+                                            timeout=-1)
         if reply.get("no_holders"):
             raise _AllCopiesLost(oid)
         if reply.get("timeout"):
@@ -1023,6 +1035,62 @@ class ClusterRuntime(CoreRuntime):
         re-used (deadlock avoidance for nested tasks)."""
         return _BlockedCtx(self)
 
+    # ------------------------------------------------------------ tracing
+
+    def _trace_attach(self, spec: TaskSpec) -> None:
+        """Stamp the submission's trace context onto the spec.
+
+        Driver submissions with no ambient context are an INGRESS: a
+        root context is minted here (head-sampled — the unsampled mint
+        is a coin flip and two random ids, well under the 2 µs budget).
+        Worker submissions propagate the executing task's context, so a
+        serve request's nested tasks stay in its trace.  Only SAMPLED
+        contexts ride the wire — the unsampled common case adds zero
+        bytes to the frame and zero work downstream."""
+        trace = tracing_plane.current()
+        if trace is None:
+            if self.role != "driver":
+                return
+            # Hot-path mint: coin first, ids only on a sampling hit —
+            # the unsampled .remote() pays one RNG draw here.
+            trace = tracing_plane.maybe_mint()
+            if trace is None:
+                return
+        if not trace.sampled:
+            return
+        call = trace.child()
+        spec.trace_ctx = call.to_wire()
+        # Driver-local timing attrs: never pickled (TaskSpec.__reduce__
+        # is positional), consumed by _trace_task_reply.
+        spec._parent_span = trace.span_id
+        spec._t_wall = time.time()
+        spec._t_submit = time.perf_counter()
+
+    def _trace_task_reply(self, spec: TaskSpec, error: bool = False):
+        """Record the client-side call span when a traced task's reply
+        (or terminal error) lands: queue = submit → frame write, wire =
+        frame write → reply stored."""
+        wire = spec.trace_ctx
+        t0 = getattr(spec, "_t_submit", None)
+        if wire is None or t0 is None:
+            return
+        now = time.perf_counter()
+        t_send = getattr(spec, "_t_send", now)
+        stages = {"queue": max(0.0, t_send - t0),
+                  "wire": max(0.0, now - t_send)}
+        tracing_plane.record_span(
+            wire, f"call:{spec.function_name}",
+            ts=getattr(spec, "_t_wall", time.time()), dur_s=now - t0,
+            stages=stages,
+            attrs={"task_id": spec.task_id.hex(),
+                   "attempt": spec.attempt},
+            error=error, span_id=wire[1],
+            parent_id=getattr(spec, "_parent_span", ""),
+            service="submitter")
+        tracing_plane.record_rpc(
+            "PushTask", {"client_queue": stages["queue"],
+                         "client_wire": stages["wire"]}, wire[0])
+
     # ------------------------------------------------------------ tasks
 
     def submit_task(self, remote_function, args, kwargs, options: TaskOptions):
@@ -1068,6 +1136,7 @@ class ClusterRuntime(CoreRuntime):
             scheduling_strategy=strategy_wire(
                 options.scheduling_strategy),
         )
+        self._trace_attach(spec)
         if cfg.enable_insight:
             from ant_ray_tpu.util import insight  # noqa: PLC0415
 
@@ -1133,6 +1202,13 @@ class ClusterRuntime(CoreRuntime):
 
     def _drain_submit_inbox(self) -> None:
         self._inbox_scheduled = False
+        # One drain callback serves a whole burst of submissions from
+        # DIFFERENT app threads, but call_soon_threadsafe copied only
+        # the scheduling thread's context — clear the trace contextvar
+        # so io-loop machinery (lease acquisition, senders) never
+        # attributes its RPCs to whichever thread happened to schedule
+        # the wakeup.  Per-task attribution rides spec.trace_ctx.
+        tracing_plane.set_current(None)
         inbox = self._submit_inbox
         while inbox:
             fn, args = inbox.popleft()
@@ -1252,6 +1328,11 @@ class ClusterRuntime(CoreRuntime):
                     and entry[0] == "plasma"]
             if deps:
                 lease_payload["deps"] = deps
+            # The head task's trace rides the lease so the serving
+            # daemon records the grant as a child span of the request.
+            head_trace = state.queue[0][0].trace_ctx
+            if head_trace is not None:
+                lease_payload["trace"] = head_trace
         if state.pg is not None:
             node = await self._resolve_bundle_node(*state.pg)
             lease_payload["pg"] = state.pg
@@ -1355,6 +1436,9 @@ class ClusterRuntime(CoreRuntime):
                    and (not inflight
                         or len(state.queue) > state.acquiring)):
                 spec, pinned, attempt = state.queue.popleft()
+                spec.attempt = attempt
+                if spec.trace_ctx is not None:
+                    spec._t_send = time.perf_counter()
                 try:
                     fut = await client.send_request("PushTask", spec,
                                                     defer=True)
@@ -1644,6 +1728,12 @@ class ClusterRuntime(CoreRuntime):
                     self._maybe_free_locked(oid)
 
     def _store_returns(self, spec: TaskSpec, returns: list):
+        if spec.trace_ctx is not None:
+            failed = any(
+                kind == "error"
+                or (kind == "stream_end" and data[1] is not None)
+                for kind, data in returns)
+            self._trace_task_reply(spec, error=failed)
         if spec.num_returns == -1:  # streaming: end-of-stream marker
             kind, data = returns[0]
             assert kind == "stream_end", kind
@@ -1799,6 +1889,8 @@ class ClusterRuntime(CoreRuntime):
             f"lineage re-execution kept failing: {last}")
 
     def _store_error(self, spec: TaskSpec, err: Exception):
+        if spec.trace_ctx is not None:
+            self._trace_task_reply(spec, error=True)
         if spec.num_returns == -1:  # streaming: fail the stream
             state = self._streams.get(spec.task_id)
             self._finish_stream(
@@ -2006,6 +2098,7 @@ class ClusterRuntime(CoreRuntime):
             method_name=method_name,
             concurrency_group=options.concurrency_group,
         )
+        self._trace_attach(spec)
 
         if global_config().enable_task_events:
             from ant_ray_tpu._private import task_events  # noqa: PLC0415
@@ -2078,6 +2171,9 @@ class ClusterRuntime(CoreRuntime):
                 if next_client is not client:
                     await self._safe_flush(client)  # old target first
                     client = next_client
+                spec.attempt = attempt
+                if spec.trace_ctx is not None:
+                    spec._t_send = time.perf_counter()
                 try:
                     fut = await client.send_request("PushTask", spec,
                                                     defer=True)

@@ -169,8 +169,14 @@ def _escape_label(value) -> str:
             .replace("\n", r"\n"))
 
 
-def _prometheus_text(series: list[dict]) -> str:
-    """Render the GCS metrics table in Prometheus exposition format."""
+def _prometheus_text(series: list[dict], exemplars: bool = False) -> str:
+    """Render the GCS metrics table in Prometheus exposition format.
+
+    ``exemplars=True`` renders OpenMetrics exemplar suffixes on
+    histogram bucket lines — legal ONLY in the OpenMetrics exposition
+    format (the /metrics handler enables it when the scraper's Accept
+    header negotiates ``application/openmetrics-text``; classic
+    text-format parsers would fail the whole scrape on the `#`)."""
     lines = []
     seen_headers = set()
     for s in series:
@@ -189,15 +195,32 @@ def _prometheus_text(series: list[dict]) -> str:
         label = f"{{{','.join(pairs)}}}" if pairs else ""
         if s["type"] == "histogram":
             # Cumulative buckets + the mandatory +Inf bucket (== count).
+            # The latest exemplar (OpenMetrics: `# {trace_id="..."} v ts`)
+            # is attached to the first bucket its value fits — a slow
+            # histogram links straight to a concrete trace id.
+            exemplar = s.get("exemplar") if exemplars else None
+            ex_text = ""
+            if exemplar:
+                ex_pairs = ",".join(
+                    f'{k}="{_escape_label(v)}"'
+                    for k, v in sorted(
+                        (exemplar.get("labels") or {}).items()))
+                ex_text = (f" # {{{ex_pairs}}} {exemplar.get('value', 0)}"
+                           f" {exemplar.get('ts', 0)}")
             cum = 0
             for le, n in zip(s.get("boundaries", ()),
                              s.get("buckets", ())):
                 cum += n
                 le_pairs = pairs + [f'le="{format(float(le), "g")}"']
-                lines.append(f"{name}_bucket{{{','.join(le_pairs)}}} {cum}")
+                attach = ""
+                if ex_text and exemplar.get("value", 0) <= float(le):
+                    attach, ex_text = ex_text, ""
+                lines.append(
+                    f"{name}_bucket{{{','.join(le_pairs)}}} {cum}{attach}")
             inf_pairs = pairs + ['le="+Inf"']
             lines.append(
-                f"{name}_bucket{{{','.join(inf_pairs)}}} {s['count']}")
+                f"{name}_bucket{{{','.join(inf_pairs)}}} "
+                f"{s['count']}{ex_text}")
             lines.append(f"{name}_count{label} {s['count']}")
             lines.append(f"{name}_sum{label} {s['sum']}")
         else:
@@ -325,7 +348,54 @@ def create_app(gcs_address: str, session_dir: str):
                               retries=3) or []
             steps = gcs.call("StepEventsGet", {"limit": 20000},
                              retries=3) or []
-            return build_chrome_trace(events, step_events=steps)
+            try:
+                spans = gcs.call("SpanEventsGet", {"limit": 50000},
+                                 retries=3) or []
+            except Exception:  # noqa: BLE001 — pre-upgrade GCS
+                spans = []
+            return build_chrome_trace(events, step_events=steps,
+                                      span_events=spans)
+        return web.json_response(await _call(build))
+
+    async def trace(req):
+        """One request's span tree: every hop (ingress → router →
+        replica → nested tasks → pulls → lease grants) that published
+        under this trace id, folded into a parent/child forest."""
+        trace_id = req.match_info["trace_id"]
+
+        def build():
+            from ant_ray_tpu.observability.tracing_plane import span_tree  # noqa: PLC0415
+
+            spans = gcs.call("SpanEventsGet", {"trace_id": trace_id},
+                             retries=3) or []
+            return {"trace_id": trace_id, "span_count": len(spans),
+                    "spans": spans, "tree": span_tree(spans)}
+        return web.json_response(await _call(build))
+
+    async def flightrecorder(req):
+        """Live per-node flight-recorder rings (always on): the node
+        daemon's in-memory spans — including force-sampled error spans
+        — even when batch publication lags or the GCS ring wrapped.
+        ``?node_id=<prefix>`` narrows to one node."""
+        node_id = req.query.get("node_id")
+        limit = int(req.query.get("limit", 0) or 0)
+
+        def build():
+            infos = gcs.call("GetAllNodes", retries=3)
+            out = []
+            for info in infos.values():
+                if not info.alive:
+                    continue
+                if node_id and not info.node_id.hex().startswith(node_id):
+                    continue
+                try:
+                    reply = clients.get(info.address).call(
+                        "GetFlightRecorder", {"limit": limit},
+                        timeout=5)
+                except Exception:  # noqa: BLE001 — node mid-death
+                    continue
+                out.append(reply)
+            return out
         return web.json_response(await _call(build))
 
     async def profile(req):
@@ -377,7 +447,14 @@ def create_app(gcs_address: str, session_dir: str):
 
         return web.Response(text=INDEX_HTML, content_type="text/html")
 
-    async def metrics(_req):
+    async def metrics(req):
+        # Content negotiation: OpenMetrics scrapers (Accept names
+        # application/openmetrics-text) get exemplar suffixes and the
+        # mandatory EOF marker; classic text-format scrapers get plain
+        # 0.0.4 lines (exemplars would fail their whole scrape).
+        openmetrics = "application/openmetrics-text" in \
+            req.headers.get("Accept", "")
+
         def build():
             series = gcs.call("MetricsGet", retries=3)
             infos = gcs.call("GetAllNodes", retries=3)
@@ -429,9 +506,13 @@ def create_app(gcs_address: str, session_dir: str):
                     "type": "gauge", "tags": {"resource": res},
                     "value": avail.get(res, 0.0),
                     "description": "available cluster resources"})
-            return _prometheus_text(builtin + series)
-        return web.Response(text=await _call(build),
-                            content_type="text/plain")
+            text = _prometheus_text(builtin + series,
+                                    exemplars=openmetrics)
+            return text + "# EOF\n" if openmetrics else text
+        return web.Response(
+            text=await _call(build),
+            content_type=("application/openmetrics-text" if openmetrics
+                          else "text/plain"))
 
     async def submit_job(req):
         body = await req.json()
@@ -474,6 +555,8 @@ def create_app(gcs_address: str, session_dir: str):
     app.router.add_get("/api/insight", insight)
     app.router.add_get("/api/export_events", export_events)
     app.router.add_get("/api/timeline", timeline)
+    app.router.add_get("/api/trace/{trace_id}", trace)
+    app.router.add_get("/api/flightrecorder", flightrecorder)
     app.router.add_get("/api/logs", node_logs)
     app.router.add_get("/api/logs/{filename}", node_log_read)
     app.router.add_get("/metrics", metrics)

@@ -102,6 +102,10 @@ class GcsServer:
         # bounded ring of per-step profiler records (observability/
         # step_profiler.py — merged into the timeline as device rows)
         self._step_events: deque = deque(maxlen=20000)
+        # bounded ring of request-trace spans (observability/
+        # tracing_plane.py — batch-published per-process flight
+        # recorders; /api/trace/{id} and the timeline read it back)
+        self._span_events: deque = deque(maxlen=50000)
         self._dirty_locations: set[ObjectID] = set()
         # ---- pubsub (ref: src/ray/pubsub/publisher.h — long-poll
         # channels; here one global sequence + per-event channel tag so a
@@ -172,6 +176,9 @@ class GcsServer:
             "TaskEventsGet": self._task_events_get,
             "StepEventsAdd": self._step_events_add,
             "StepEventsGet": self._step_events_get,
+            "SpanEventsAdd": self._span_events_add,
+            "SpanEventsGet": self._span_events_get,
+            "MetricsExpire": self._metrics_expire,
             "SubPoll": self._sub_poll,
             "PublishLogs": self._publish_logs,
             "ExportEventsGet": self._export_events_get,
@@ -534,6 +541,7 @@ class GcsServer:
         info.alive = False
         self._publish("node", {"node_id": node_id, "alive": False,
                                "address": info.address})
+        self._expire_node_metrics(node_id)
         for oid, nodes in list(self._object_locations.items()):
             nodes.discard(node_id)
         for record in list(self._actors.values()):
@@ -704,6 +712,31 @@ class GcsServer:
             records = [r for r in records if r.get("rank") == rank]
         return records[-limit:]
 
+    # ------------------------------------------------------ span events
+    # (observability/tracing_plane.py: per-process flight recorders
+    #  batch-publish sampled + force-sampled spans here; one bounded
+    #  ring like step events)
+
+    async def _span_events_add(self, payload):
+        self._span_events.extend(payload.get("spans", ()))
+        return True
+
+    async def _span_events_get(self, payload):
+        payload = payload or {}
+        limit = int(payload.get("limit", 50000))
+        trace_id = payload.get("trace_id")
+        node_id = payload.get("node_id")
+        errors_only = payload.get("errors_only")
+        spans = list(self._span_events)
+        if trace_id is not None:
+            spans = [s for s in spans if s.get("trace_id") == trace_id]
+        if node_id:
+            spans = [s for s in spans
+                     if str(s.get("node_id", "")).startswith(node_id)]
+        if errors_only:
+            spans = [s for s in spans if s.get("error")]
+        return spans[-limit:]
+
     # -------------------------------------------------------- metrics
     # (ref: src/ray/stats/metric.h registry + the dashboard metrics
     #  agent python/ray/_private/metrics_agent.py — GCS holds the
@@ -739,10 +772,43 @@ class GcsServer:
                 if value <= le:
                     entry["buckets"][i] += 1
                     break               # cumulation happens at render
+            # OpenMetrics exemplar: keep the latest per series — the
+            # /metrics renderer links the histogram to a concrete
+            # trace id (tracing_plane's rpc histograms send these).
+            if payload.get("exemplar"):
+                entry["exemplar"] = payload["exemplar"]
         return True
 
     async def _metrics_get(self, _payload):
         return list(self._metrics.values())
+
+    async def _metrics_expire(self, payload):
+        """Drop series whose tags match ``match_tags`` (all pairs must
+        match; ``name_prefix`` additionally narrows by metric name).
+        The owners of per-entity gauges call this at teardown — a dead
+        node's ``art_device_hbm_*`` or a removed replica's
+        ``art_serve_breaker_state`` must not live in /metrics forever."""
+        match = dict(payload.get("match_tags") or {})
+        prefix = payload.get("name_prefix", "")
+        if not match and not prefix:
+            return 0
+        doomed = [key for key, entry in self._metrics.items()
+                  if (not prefix or entry["name"].startswith(prefix))
+                  and all(entry["tags"].get(k) == v
+                          for k, v in match.items())]
+        for key in doomed:
+            del self._metrics[key]
+        return len(doomed)
+
+    def _expire_node_metrics(self, node_id: NodeID) -> None:
+        """Node-death hook: series tagged with the dead node's id (the
+        agent's ``art_device_hbm_*`` publishes, any per-node gauges
+        recorded into the table) are pruned immediately."""
+        full, short = node_id.hex(), node_id.hex()[:12]
+        doomed = [key for key, entry in self._metrics.items()
+                  if entry["tags"].get("node_id") in (full, short)]
+        for key in doomed:
+            del self._metrics[key]
 
     # ------------------------------------------------------------- kv
 

@@ -179,6 +179,11 @@ class NodeAgent:
         this forces must not slow agent startup."""
         from ant_ray_tpu.observability import device_stats  # noqa: PLC0415
 
+        # Tag with this node's identity: different nodes' chips must not
+        # collide on one series, and the GCS prunes node-tagged series
+        # when the node dies (stale-gauge expiry) — matching the short
+        # id the dashboard's live scrape stamps.
+        node_id = os.environ.get("ART_NODE_ID", "")[:12]
         while not self._stop_publish.wait(interval):
             try:
                 gauges = device_stats.device_stats_gauges()
@@ -186,6 +191,8 @@ class NodeAgent:
                 continue
             gcs = self._clients.get(self._gcs_address)
             for g in gauges:
+                if node_id:
+                    g.setdefault("tags", {})["node_id"] = node_id
                 try:
                     gcs.call("MetricRecord", g, timeout=5)
                 except Exception:  # noqa: BLE001 — head restarting

@@ -239,6 +239,7 @@ class NodeManager:
             "GetAgentInfo": self._get_agent_info,
             "GetStoreStats": self._get_store_stats,
             "GetNodeMetrics": self._get_node_metrics,
+            "GetFlightRecorder": self._get_flight_recorder,
             "GetTransferStats": self._get_transfer_stats,
             "ListLogs": self._list_logs,
             "ReadLog": self._read_log,
@@ -303,6 +304,28 @@ class NodeManager:
             prestart = min(2, self._max_workers)
         for _ in range(min(prestart, self._max_workers)):
             self._io.run_coro(self._prestart_worker())
+        # Fix this process's node identity on recorded spans (workers
+        # inherit ART_NODE_ID via env; the daemon minted the id itself)
+        # and give the recorder a publisher — the daemon is not an art
+        # worker, so the default runtime-oneway channel is absent.
+        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+        tracing_plane.set_node_id(self.node_id.hex())
+
+        def _publish_spans(batch, manager=self):
+            gcs = manager._clients.get(manager._gcs_address)
+            asyncio.run_coroutine_threadsafe(
+                gcs.oneway_async("SpanEventsAdd", {"spans": batch}),
+                manager._io.loop)
+
+        def _publish_metric(payload, manager=self):
+            gcs = manager._clients.get(manager._gcs_address)
+            asyncio.run_coroutine_threadsafe(
+                gcs.oneway_async("MetricRecord", payload),
+                manager._io.loop)
+
+        tracing_plane.set_publisher(_publish_spans)
+        tracing_plane.set_metric_recorder(_publish_metric)
         logger.info("node %s listening on %s (resources=%s)",
                     self.node_id.hex()[:8], self.address, self._total)
         return self.address
@@ -557,6 +580,17 @@ class NodeManager:
         return {"used": self.store.used,
                 "capacity": self.store.capacity,
                 "spilled": self.store.spilled_bytes}
+
+    async def _get_flight_recorder(self, payload):
+        """This daemon process's flight-recorder ring (always on): the
+        live spans — including force-sampled error spans — even when
+        the batch publisher lags or the GCS ring wrapped.  The
+        dashboard's ``GET /api/flightrecorder?node_id=`` lands here."""
+        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+        limit = int((payload or {}).get("limit", 0) or 0)
+        return {"node_id": self.node_id.hex(),
+                "spans": tracing_plane.recorder().snapshot(limit)}
 
     async def _get_node_metrics(self, _payload):
         """Per-node gauges for the head's /metrics aggregation (role of
@@ -1033,6 +1067,10 @@ class NodeManager:
 
         os.makedirs(os.path.join(self._session_dir, "logs"),
                     exist_ok=True)
+        agent_env = services.control_plane_env()
+        # The agent tags its published device gauges with the node id
+        # (per-node series identity + death-time expiry in the GCS).
+        agent_env["ART_NODE_ID"] = self.node_id.hex()
         self._agent_proc = subprocess.Popen(
             [sys.executable, "-m", "ant_ray_tpu._private.node_agent",
              "--session-dir", self._session_dir,
@@ -1041,7 +1079,7 @@ class NodeManager:
             stdout=subprocess.PIPE,
             stderr=open(os.path.join(self._session_dir, "logs",
                                      "agent.err"), "ab"),
-            env=services.control_plane_env(), start_new_session=True)
+            env=agent_env, start_new_session=True)
         self._agent_address = None
 
         def _wait_ready(proc=self._agent_proc):
@@ -1173,7 +1211,27 @@ class NodeManager:
 
     async def _lease_worker(self, payload):
         """Grant a worker lease or reply with a spillback target
-        (ref: NodeManager::HandleRequestWorkerLease, node_manager.cc:1794)."""
+        (ref: NodeManager::HandleRequestWorkerLease, node_manager.cc:1794).
+
+        Traced leases (payload carries the head task's ``trace`` wire
+        context) record a ``daemon:lease`` child span with the grant
+        outcome, so a slow actor call can be attributed to scheduling
+        rather than execution."""
+        wire = payload.get("trace")
+        if wire is None:
+            return await self._lease_worker_impl(payload)
+        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+        with tracing_plane.server_span(wire, "daemon:lease",
+                                       "LeaseWorker") as sp:
+            sp.attrs = {"outcome": "error",
+                        "resources": dict(payload.get("resources", {}))}
+            reply = await self._lease_worker_impl(payload)
+            sp.attrs["outcome"] = next(iter(reply))
+            sp.error = "infeasible" in reply
+            return reply
+
+    async def _lease_worker_impl(self, payload):
         demand: dict[str, float] = payload.get("resources", {})
         gcs = self._clients.get(self._gcs_address)
         from ant_ray_tpu._private import runtime_env as renv  # noqa: PLC0415
@@ -1897,8 +1955,25 @@ class NodeManager:
             pass
 
     async def _ensure_local(self, payload):
-        """Make the object local (pull from a holder if needed); reply path
-        (ref: PullManager, src/ray/object_manager/pull_manager.h:50)."""
+        """Make the object local (pull from a holder if needed); reply
+        path (ref: PullManager, pull_manager.h:50).  Traced pulls
+        (payload ``trace``) record a ``daemon:object_pull`` child span
+        with the pulled size — the wire/queue decomposition of a slow
+        ``get()`` lands in the request's trace."""
+        wire = payload.get("trace")
+        if wire is None:
+            return await self._ensure_local_impl(payload)
+        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+        with tracing_plane.server_span(wire, "daemon:object_pull",
+                                       "EnsureLocal") as sp:
+            sp.attrs = {"object_id": payload["object_id"].hex()}
+            reply = await self._ensure_local_impl(payload)
+            sp.attrs["size"] = reply.get("size")
+            sp.error = "no_holders" in reply or "timeout" in reply
+            return reply
+
+    async def _ensure_local_impl(self, payload):
         object_id: ObjectID = payload["object_id"]
         prefetch = payload.get("prefetch", False)
         deadline = time.monotonic() + payload.get("timeout", 60.0)

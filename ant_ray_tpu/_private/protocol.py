@@ -26,6 +26,19 @@ from ant_ray_tpu._private.config import global_config
 
 logger = logging.getLogger(__name__)
 
+
+def _trace_current():
+    """Sampled trace context active in this task, or None.  Lazy-bound:
+    the tracing plane imports config (not protocol), so binding at
+    first use avoids ordering surprises during package init."""
+    global _trace_current
+    from ant_ray_tpu.observability.tracing_plane import (  # noqa: PLC0415
+        current_sampled,
+    )
+
+    _trace_current = current_sampled
+    return current_sampled()
+
 _REQ, _REP, _ERR, _ONEWAY, _HELLO, _GOODBYE = 0, 1, 2, 3, 4, 5
 
 # Wire protocol version (ref: protobuf schema versioning — the pickled
@@ -608,15 +621,62 @@ class RpcClient:
     async def call_async(
         self, method: str, payload: Any = None, timeout: float | None = None
     ) -> Any:
+        # Tracing fast path: one contextvar read.  Calls made inside a
+        # sampled trace (the caller's context rides into this coroutine
+        # via the event-loop context copy) record a client span with a
+        # serialize/wire stage split; everything else takes the bare
+        # path below untouched.
+        ctx = _trace_current()
+        if ctx is not None:
+            return await self._traced_call(ctx, method, payload, timeout)
         fut = await self.send_request(method, payload)
+        return await self._await_reply(fut, method, timeout)
+
+    async def _await_reply(self, fut, method: str,
+                           timeout: float | None) -> Any:
+        """ONE deadline semantic for traced and untraced calls:
+        ``timeout <= 0`` is the explicit no-deadline escape hatch
+        (long-running task pushes); None takes the config default."""
         if timeout is None:
             timeout = global_config().rpc_call_timeout_s
-        if timeout <= 0:  # explicit "no deadline" (long-running task pushes)
+        if timeout <= 0:
             return await fut
         try:
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError as e:
-            raise RpcTimeoutError(f"{method} to {self.address} timed out") from e
+            raise RpcTimeoutError(
+                f"{method} to {self.address} timed out") from e
+
+    async def _traced_call(self, ctx, method: str, payload: Any,
+                           timeout: float | None) -> Any:
+        """call_async under a sampled trace context: record an
+        ``rpc:{method}`` client span (stages: serialize = encode+write,
+        wire = flight + server time) and feed the
+        ``art_rpc_latency_s{method,stage}`` histogram with the trace id
+        as its exemplar."""
+        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        t_sent = t0
+        err = False
+        try:
+            fut = await self.send_request(method, payload)
+            t_sent = time.perf_counter()
+            return await self._await_reply(fut, method, timeout)
+        except BaseException:
+            err = True
+            raise
+        finally:
+            t_end = time.perf_counter()
+            stages = {"serialize": t_sent - t0, "wire": t_end - t_sent}
+            sid = tracing_plane.record_span(
+                ctx, f"rpc:{method}", ts=t_wall, dur_s=t_end - t0,
+                stages=stages,
+                attrs={"method": method, "peer": self.address},
+                error=err, service="rpc-client")
+            if sid is not None:
+                tracing_plane.record_rpc(method, stages, ctx.trace_id)
 
     async def oneway_async(self, method: str, payload: Any = None) -> None:
         await self._ensure_connected()

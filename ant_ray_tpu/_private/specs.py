@@ -50,10 +50,18 @@ class TaskSpec:
     # "SPREAD", or {"kind": "node_affinity", ...}; ref: the raylet
     # policy set, composite_scheduling_policy.h:33)
     scheduling_strategy: "dict | str | None" = None
+    # Propagated trace context (observability/tracing_plane.py wire
+    # tuple (trace_id, span_id, sampled)); None when the submission is
+    # not part of a sampled trace — the zero-overhead common case.
+    trace_ctx: "tuple | None" = None
+    # Execution attempt (0 = first).  Mutated by the submitter before
+    # each (re)push so the worker's task events and span ids can tell a
+    # retry from the original run (span-id salt).
+    attempt: int = 0
 
     def __reduce__(self):
         # Positional-tuple pickling: the default dataclass path pickles
-        # a 17-key dict whose field-name strings are re-encoded in every
+        # a 19-key dict whose field-name strings are re-encoded in every
         # RPC frame (each frame is a fresh dumps with an empty memo) —
         # measurable at 10k specs/s on the actor-call hot path.
         return (TaskSpec, (
@@ -63,7 +71,8 @@ class TaskSpec:
             self.actor_id, self.method_name, self.sequence_no,
             self.concurrency_group, self.placement_group_id,
             self.placement_group_bundle_index, self.runtime_env,
-            self.label_selector, self.scheduling_strategy))
+            self.label_selector, self.scheduling_strategy,
+            self.trace_ctx, self.attempt))
 
 
 @dataclass

@@ -44,7 +44,8 @@ class TaskEventBuffer:
 
     def record(self, runtime, *, task_id: str, name: str, event: str,
                actor_id: str | None = None,
-               parent_task_id: str | None = None) -> None:
+               parent_task_id: str | None = None,
+               attempt: int = 0) -> None:
         entry = {
             "task_id": task_id, "name": name, "event": event,
             "ts": time.time(), "pid": _PID,
@@ -52,6 +53,9 @@ class TaskEventBuffer:
             "worker": getattr(runtime, "address", ""),
             "actor_id": actor_id,
             "parent_task_id": parent_task_id or current_task.get(),
+            # Execution attempt: lets span derivation salt ids so a
+            # retried task's spans never collide with the original run.
+            "attempt": attempt,
         }
         flush_now = False
         register = False
@@ -117,12 +121,14 @@ def _runtime():
 
 def record(task_id: str, name: str, event: str, *,
            actor_id: str | None = None,
-           parent_task_id: str | None = None) -> None:
+           parent_task_id: str | None = None,
+           attempt: int = 0) -> None:
     runtime = _runtime()
     if runtime is None:
         return
     _buffer.record(runtime, task_id=task_id, name=name, event=event,
-                   actor_id=actor_id, parent_task_id=parent_task_id)
+                   actor_id=actor_id, parent_task_id=parent_task_id,
+                   attempt=attempt)
 
 
 def flush() -> None:

@@ -97,6 +97,15 @@ METHODS: dict[str, dict] = {
     "StepEventsAdd": _m("gcs", "{records: [{step, ts, total_s, phases, "
                                "mfu?, rank}]}", "bool"),
     "StepEventsGet": _m("gcs", "{limit?, rank?}", "[record]"),
+    "SpanEventsAdd": _m("gcs", "{spans: [{trace_id, span_id, parent_id, "
+                               "name, ts, dur_s, stages?, attrs?, "
+                               "error?, node_id, pid}]}", "bool"),
+    "SpanEventsGet": _m("gcs", "{limit?, trace_id?, node_id?, "
+                               "errors_only?}", "[span]"),
+    "MetricsExpire": _m("gcs", "{match_tags?, name_prefix?}",
+                        "int (series dropped; per-entity gauge owners "
+                        "call this at teardown so dead nodes/replicas "
+                        "don't live in /metrics forever)"),
     "SubPoll": _m("gcs", "{channels, cursor, timeout}",
                   "{cursor, events: [(seq, channel, data)]}"),
     "PublishLogs": _m("gcs", "{node, entries: [{worker, pid, job_id?, "
@@ -147,6 +156,11 @@ METHODS: dict[str, dict] = {
     "DebugResources": _m("node", "{}",
                          "{available, bundles, workers} ledger dump"),
     "GetNodeMetrics": _m("node", "{}", "{gauges}"),
+    "GetFlightRecorder": _m("node", "{limit?}",
+                            "{node_id, spans} — this daemon process's "
+                            "live flight-recorder ring (always on; "
+                            "force-sampled error spans in their own "
+                            "wrap-protected ring)"),
     "GetStoreStats": _m("node", "{}", "{used, capacity, spilled}"),
     "GetSyncStats": _m("node", "{}", "{beats, views_sent, ...}"),
     "GetTransferStats": _m("node", "{include_read_log?}",

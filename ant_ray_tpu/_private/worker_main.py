@@ -36,6 +36,7 @@ from ant_ray_tpu._private.specs import (
 )
 from ant_ray_tpu._private.worker import CLUSTER_MODE, global_worker
 from ant_ray_tpu.object_ref import ObjectRef
+from ant_ray_tpu.observability import tracing_plane
 
 logger = logging.getLogger(__name__)
 
@@ -151,8 +152,28 @@ class TaskExecutor:
                 spec, exceptions.TaskCancelledError(
                     spec.task_id, "cancelled before execution")))
             return
+        # Propagated trace: the spec carries a sampled context minted at
+        # the ingress — set it for the duration of execution so nested
+        # submits / gets / pulls from user code land in the same trace,
+        # and record the server-side execution span (stages: queue =
+        # arrival → executor pickup, execute = user code).
+        wire = spec.trace_ctx
+        trace_token = exec_ctx = None
+        t_wall = t0 = 0.0
+        if wire is not None:
+            exec_ctx = tracing_plane.TraceContext.from_wire(wire).child()
+            trace_token = tracing_plane.set_current(exec_ctx)
+            t_wall = time.time()
+            t0 = time.perf_counter()
         try:
-            self._reply(fut, self._execute(spec))
+            result = self._execute(spec)
+            if exec_ctx is not None:
+                try:
+                    self._record_exec_span(spec, exec_ctx, wire, t_wall,
+                                           t0, result)
+                except Exception:  # noqa: BLE001 — never lose the reply
+                    logger.exception("exec span recording failed")
+            self._reply(fut, result)
         except SystemExit:
             self._reply(fut, self._error_returns(
                 spec, exceptions.ActorDiedError(
@@ -163,6 +184,34 @@ class TaskExecutor:
         except Exception as e:  # noqa: BLE001 — internal failure
             logger.exception("internal executor failure")
             self._reply_exc(fut, exceptions.ArtError(repr(e)))
+        finally:
+            if trace_token is not None:
+                tracing_plane.reset(trace_token)
+
+    def _record_exec_span(self, spec: TaskSpec, exec_ctx, wire,
+                          t_wall: float, t0: float, result: dict) -> None:
+        now = time.perf_counter()
+        queue_s = max(0.0, t0 - getattr(spec, "_t_arrival", t0))
+        exec_s = now - t0
+        err = False
+        for kind, data in result.get("returns") or ():
+            if kind == "error" or (kind == "stream_end"
+                                   and data[1] is not None):
+                err = True
+                break
+        tracing_plane.record_span(
+            exec_ctx, f"run:{spec.function_name}",
+            ts=t_wall - queue_s, dur_s=queue_s + exec_s,
+            stages={"queue": queue_s, "execute": exec_s},
+            attrs={"task_id": spec.task_id.hex(),
+                   "attempt": spec.attempt,
+                   **({"actor_id": spec.actor_id.hex()}
+                      if spec.actor_id else {})},
+            error=err, span_id=exec_ctx.span_id, parent_id=wire[1],
+            service="worker")
+        tracing_plane.record_rpc(
+            "PushTask", {"queue": queue_s, "execute": exec_s},
+            exec_ctx.trace_id)
 
     # ---- execution
 
@@ -192,7 +241,8 @@ class TaskExecutor:
 
             events.record(
                 spec.task_id.hex(), spec.function_name, "started",
-                actor_id=spec.actor_id.hex() if spec.actor_id else None)
+                actor_id=spec.actor_id.hex() if spec.actor_id else None,
+                attempt=spec.attempt)
             # Nested submissions from this task record it as parent.
             _task_token = events.current_task.set(spec.task_id.hex())
         try:
@@ -241,7 +291,7 @@ class TaskExecutor:
             if events is not None:
                 events.current_task.reset(_task_token)
                 events.record(spec.task_id.hex(), spec.function_name,
-                              "failed")
+                              "failed", attempt=spec.attempt)
             return self._error_returns(spec, err)
         if spec.num_returns == -1:  # streaming generator task
             # The stream is consumed HERE — events record after it
@@ -258,7 +308,7 @@ class TaskExecutor:
                 events.current_task.reset(_task_token)
                 events.record(spec.task_id.hex(), spec.function_name,
                               "failed" if stream_err is not None
-                              else "finished")
+                              else "finished", attempt=spec.attempt)
             return out
         if insight is not None:
             insight.record_call_end(spec.function_name,
@@ -267,7 +317,7 @@ class TaskExecutor:
         if events is not None:
             events.current_task.reset(_task_token)
             events.record(spec.task_id.hex(), spec.function_name,
-                          "finished")
+                          "finished", attempt=spec.attempt)
         values = [result] if spec.num_returns == 1 else list(result)
         if len(values) != spec.num_returns:
             err = exceptions.TaskError(
@@ -423,6 +473,8 @@ def main():  # pragma: no cover — exercised via subprocess in tests
         # Sync fast-route handler: returns the reply future directly, so
         # the server writes the reply from a callback with no Task
         # object per call (see RpcServer.fast_route).
+        if spec.trace_ctx is not None:
+            spec._t_arrival = time.perf_counter()  # queue-stage anchor
         fut = io.loop.create_future()
         executor.submit(spec, fut)  # sync enqueue preserves arrival order
         return fut

@@ -98,11 +98,18 @@ class LLMServer:
             prompts[0], int)
         batch = prompts if many else [prompts]
         sampling = self._sampling(request)
+        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
         self._check_deadline("generation")
-        self._acquire_engine()
+        with tracing_plane.span("llm:admission"):
+            self._acquire_engine()
         try:
             self._check_deadline("generation")  # lock wait can expire it
-            outs = self.engine.generate(batch, sampling)
+            with tracing_plane.span(
+                    "llm:generate",
+                    {"prompts": len(batch),
+                     "max_tokens": sampling.max_tokens}):
+                outs = self.engine.generate(batch, sampling)
         finally:
             self._engine_lock.release()
         return {
@@ -121,11 +128,17 @@ class LLMServer:
         token_ids = render_chat(self.engine.tokenizer,
                                 request.get("messages", []))
         sampling = self._sampling(request)
+        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
         self._check_deadline("generation")
-        self._acquire_engine()
+        with tracing_plane.span("llm:admission"):
+            self._acquire_engine()
         try:
             self._check_deadline("generation")  # lock wait can expire it
-            out = self.engine.generate([token_ids], sampling)[0]
+            with tracing_plane.span(
+                    "llm:generate",
+                    {"max_tokens": sampling.max_tokens, "chat": True}):
+                out = self.engine.generate([token_ids], sampling)[0]
         finally:
             self._engine_lock.release()
         return {
@@ -171,17 +184,23 @@ class LLMServer:
             prompt = prompts[0] if isinstance(prompts, list) and prompts \
                 and not isinstance(prompts[0], int) else prompts
         sampling = self._sampling(request)
+        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
         self._check_deadline("streaming generation")
         # The lock spans the generator's whole life (tokens must stream
         # while generation runs, and no other request may touch the
         # engine mid-stream); the finally releases it even if the
         # consumer abandons the generator (GeneratorExit).
-        self._acquire_engine()
+        with tracing_plane.span("llm:admission"):
+            self._acquire_engine()
         try:
             self._check_deadline("streaming generation")  # lock wait
-            deltas = self.engine.stream(prompt, sampling)
-            yield from (self._chat_chunks(deltas) if chat
-                        else self._chunks(deltas))
+            with tracing_plane.span(
+                    "llm:stream",
+                    {"max_tokens": sampling.max_tokens, "chat": chat}):
+                deltas = self.engine.stream(prompt, sampling)
+                yield from (self._chat_chunks(deltas) if chat
+                            else self._chunks(deltas))
         finally:
             self._engine_lock.release()
 

@@ -27,17 +27,31 @@ substrate:
   gauges (step-time mean/p50/max, phase fractions, straggler ratio)
   and ``util/timeline.py`` merges step-phase slices as per-rank device
   rows into the chrome trace.
+* :mod:`tracing_plane` (``tracing_plane.py``) — the request-level
+  plane: W3C-traceparent-shaped contexts minted at every ingress and
+  propagated through request metadata, per-process flight recorders
+  (force-sampled error rings), the GCS span ring behind
+  ``GET /api/trace/{id}``, and ``art_rpc_latency_s`` histograms with
+  trace-id exemplars.
 """
 
+from ant_ray_tpu.observability import tracing_plane
 from ant_ray_tpu.observability.device_stats import (
     device_memory_stats,
     device_stats_gauges,
 )
 from ant_ray_tpu.observability.step_profiler import StepProfiler, StepRecord
+from ant_ray_tpu.observability.tracing_plane import (
+    FlightRecorder,
+    TraceContext,
+)
 
 __all__ = [
+    "FlightRecorder",
     "StepProfiler",
     "StepRecord",
+    "TraceContext",
     "device_memory_stats",
     "device_stats_gauges",
+    "tracing_plane",
 ]

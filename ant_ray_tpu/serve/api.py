@@ -30,6 +30,8 @@ from ant_ray_tpu.exceptions import (
     DeadlineExceededError,
     GetTimeoutError,
 )
+from ant_ray_tpu.observability import tracing_plane
+from ant_ray_tpu.observability.tracing_plane import TraceContext
 
 CONTROLLER_NAME = "_serve_controller"
 
@@ -107,6 +109,22 @@ def _typed_cause(exc: BaseException):
         if isinstance(c, (BackPressureError, DeadlineExceededError)):
             return c
     return None
+
+
+def _expire_replica_series(replica) -> None:
+    """Drop a torn-down replica's per-replica gauges (the breaker-state
+    series is tagged by replica id) from the GCS metrics table —
+    without this every scaled-down or migrated replica haunts /metrics
+    forever."""
+    try:
+        from ant_ray_tpu.api import global_worker  # noqa: PLC0415
+
+        rt = global_worker.runtime
+        rt._send_oneway(
+            rt.gcs_address, "MetricsExpire",
+            {"match_tags": {"replica": replica.actor_id.hex()[:12]}})
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        pass
 
 
 def _record_result(routing, replica, exc: BaseException | None = None):
@@ -774,12 +792,18 @@ class DeploymentHandle:
                  controller=None, multiplexed_model_id: str = "",
                  _mux_affinity: dict | None = None,
                  _routing: "_RoutingState | None" = None,
-                 _info: dict | None = None):
+                 _info: dict | None = None,
+                 trace_ctx: "TraceContext | None" = None):
         self._name = deployment_name
         self._method = method_name
         self._stream = stream
         self._controller = controller
         self._mux_model_id = multiplexed_model_id
+        # Bound trace context (serve composition: a handle created
+        # inside a traced request and pickled into a downstream
+        # deployment joins that trace when no ambient context is set;
+        # the sampled flag survives the pickle via __reduce__).
+        self._trace_ctx = trace_ctx
         # model id -> replica; SHARED with handles derived via
         # options() so affinity survives per-request option changes
         self._mux_affinity = ({} if _mux_affinity is None
@@ -912,26 +936,54 @@ class DeploymentHandle:
                 self._local_extra.get(index, 0) + 1
             return self._replicas[index]
 
-    def _request_meta(self, timeout_s: float | None = None) -> dict | None:
-        """Stamp the end-to-end deadline carried to the replica: an
+    def _trace_root(self) -> "TraceContext":
+        """The request's trace identity at this handle: the ambient
+        context (a proxy ingress or an enclosing traced task), the
+        handle's pickled binding, or — ``handle.call``/``remote()``
+        being an ingress themselves — a freshly minted head-sampled
+        root."""
+        return (tracing_plane.current() or self._trace_ctx
+                or tracing_plane.mint())
+
+    def _request_meta(self, timeout_s: float | None = None,
+                      trace: "TraceContext | None" = None) -> dict:
+        """Stamp what rides to the replica: the end-to-end deadline (an
         explicit per-call timeout wins, else the deployment's
-        ``request_timeout_s`` default pushed by the controller."""
+        ``request_timeout_s`` default pushed by the controller) and the
+        trace context.  The trace travels even when UNSAMPLED — a shed
+        (429/504) on the replica force-samples an error span and needs
+        the request's trace id to hang it off."""
+        meta: dict = {}
         timeout = (timeout_s if timeout_s is not None
                    else self._routing.default_timeout())
-        if timeout is None:
-            return None
         # NB: 0 is a real (already-expired) deadline — a gRPC client
         # whose native deadline just hit zero must be shed, not granted
         # unbounded time.
-        return {"deadline_ts": time.time() + float(timeout)}
+        if timeout is not None:
+            meta["deadline_ts"] = time.time() + float(timeout)
+        meta["trace"] = (trace if trace is not None
+                         else self._trace_root()).to_wire()
+        return meta
 
     def _dispatch(self, replica, args, kwargs, model_id: str,
                   meta: dict | None):
-        if self._stream:
-            return replica.handle_request_streaming.remote(
+        # Scope the request's trace over the actor submission so the
+        # task spec inherits it (the replica-side execution span nests
+        # under this request, not under whatever the dispatching thread
+        # happened to be doing).
+        wire = (meta or {}).get("trace")
+        if wire is None:
+            if self._stream:
+                return replica.handle_request_streaming.remote(
+                    self._method, args, kwargs, model_id, meta)
+            return replica.handle_request.remote(
                 self._method, args, kwargs, model_id, meta)
-        return replica.handle_request.remote(self._method, args, kwargs,
-                                             model_id, meta)
+        with tracing_plane.use(TraceContext.from_wire(wire)):
+            if self._stream:
+                return replica.handle_request_streaming.remote(
+                    self._method, args, kwargs, model_id, meta)
+            return replica.handle_request.remote(
+                self._method, args, kwargs, model_id, meta)
 
     def _pick_affine(self, exclude: set | None = None):
         """``_pick`` honoring multiplexed-model affinity.  Affinity is
@@ -972,7 +1024,36 @@ class DeploymentHandle:
         when the deployment opts in via ``retry_config`` (idempotent
         handlers only) — failures re-pick a different replica under the
         token-bucket retry budget.  The ingresses route through here;
-        ``remote()`` stays the raw ref-returning path."""
+        ``remote()`` stays the raw ref-returning path.
+
+        Tracing: ``call`` is an ingress — a root context is minted when
+        none is ambient, a ``route:{deployment}`` span covers
+        pick + dispatch + reply, and shed outcomes (429/504) are
+        force-sampled error spans even on unsampled requests."""
+        root = self._trace_root()
+        route_ctx = root.child()
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        exc: BaseException | None = None
+        try:
+            with tracing_plane.use(route_ctx):
+                return self._call_impl(route_ctx, timeout_s, args,
+                                       kwargs)
+        except BaseException as e:
+            exc = e
+            raise
+        finally:
+            typed = _typed_cause(exc) if exc is not None else None
+            attrs = {"deployment": self._name}
+            if typed is not None:
+                attrs["shed"] = type(typed).__name__
+            tracing_plane.record_span(
+                root, f"route:{self._name}", ts=t_wall,
+                dur_s=time.perf_counter() - t0, attrs=attrs,
+                error=exc is not None, span_id=route_ctx.span_id,
+                parent_id=root.span_id, service="router")
+
+    def _call_impl(self, route_ctx, timeout_s, args, kwargs):
         art = _art()
         self._maybe_refresh()
         rcfg = self._routing.config.get("retry")
@@ -996,8 +1077,9 @@ class DeploymentHandle:
                     # failure, not a misleading retriable 429.
                     raise last_exc from None
                 raise
-            meta = ({"deadline_ts": deadline}
-                    if deadline is not None else None)
+            meta: dict = {"trace": route_ctx.to_wire()}
+            if deadline is not None:
+                meta["deadline_ts"] = deadline
             ref = self._dispatch(replica, args, kwargs,
                                  self._mux_model_id, meta)
             try:
@@ -1044,10 +1126,22 @@ class DeploymentHandle:
             return result
         raise last_exc  # pragma: no cover — loop always returns/raises
 
+    def with_trace_context(self, ctx: "TraceContext | None"
+                           ) -> "DeploymentHandle":
+        """A handle whose dispatches join ``ctx`` when no ambient trace
+        context is set — the explicit binding for serve composition
+        (pass the bound handle in a downstream deployment's args; the
+        sampled flag survives the pickle)."""
+        return DeploymentHandle(
+            self._name, self._routing.replicas, self._method,
+            self._stream, self._controller, self._mux_model_id,
+            self._mux_affinity, self._routing, trace_ctx=ctx)
+
     def __reduce__(self):
         return (DeploymentHandle,
                 (self._name, self._replicas, self._method, self._stream,
-                 self._controller, self._mux_model_id))
+                 self._controller, self._mux_model_id,
+                 None, None, None, self._trace_ctx))
 
 
 # ---------------------------------------------------------------- actors
@@ -1181,18 +1275,75 @@ class Replica:
             if token is not None:
                 _multiplexed_model_id.reset(token)
 
+    def _trace_exec_ctx(self, meta: dict | None):
+        """(exec_ctx, parent_span_id) for this request, or (None, "").
+        Prefers the ambient context (the worker executor set it from
+        the task spec on sampled requests — nesting the replica span
+        under the execution span); falls back to the meta-carried wire
+        context, which travels even UNSAMPLED so shed error spans can
+        be force-sampled under the request's trace id."""
+        parent = tracing_plane.current()
+        if parent is None:
+            parent = TraceContext.from_wire((meta or {}).get("trace"))
+        if parent is None:
+            return None, ""
+        return parent.child(), parent.span_id
+
     def handle_request(self, method_name: str, args, kwargs,
                        model_id: str = "", meta: dict | None = None):
+        """One admission sequence for traced and untraced requests —
+        the trace hooks are no-ops without a context; with one the span
+        covers admission (queue stage) + execution and sheds record
+        force-sampled error spans."""
         deadline_ts = (meta or {}).get("deadline_ts")
-        self._check_deadline(deadline_ts)      # shed before queueing
-        self._admit(deadline_ts)               # bounded queue / shed
-        started = time.monotonic()
+        exec_ctx, parent_span = self._trace_exec_ctx(meta)
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        token = (tracing_plane.set_current(exec_ctx)
+                 if exec_ctx is not None else None)
+        err: BaseException | None = None
+        t_admit = t0
         try:
-            self._check_deadline(deadline_ts)  # shed before execution
-            return self._invoke(method_name, args, kwargs, model_id,
-                                deadline_ts)
+            try:
+                self._check_deadline(deadline_ts)  # shed before queueing
+                self._admit(deadline_ts)           # bounded queue / shed
+            finally:
+                # Stamped even when _admit sheds: a request that waited
+                # 2s in the queue before its 429/504 attributes those
+                # 2s to the queue stage, not to execute.
+                t_admit = time.perf_counter()
+            started = time.monotonic()
+            try:
+                self._check_deadline(deadline_ts)  # shed before execution
+                return self._invoke(method_name, args, kwargs, model_id,
+                                    deadline_ts)
+            finally:
+                self._release(started)
+        except BaseException as e:
+            err = e
+            raise
         finally:
-            self._release(started)
+            if token is not None:
+                tracing_plane.reset(token)
+            if exec_ctx is not None:
+                self._record_request_span(
+                    exec_ctx, parent_span, method_name, t_wall, t0,
+                    t_admit, err)
+
+    def _record_request_span(self, exec_ctx, parent_span, method_name,
+                             t_wall, t0, t_admit, err) -> None:
+        now = time.perf_counter()
+        attrs = {"deployment": self._deployment, "method": method_name}
+        if err is not None and isinstance(
+                err, (BackPressureError, DeadlineExceededError)):
+            attrs["shed"] = type(err).__name__
+        stages = {"queue": max(0.0, t_admit - t0),
+                  "execute": max(0.0, now - max(t_admit, t0))}
+        tracing_plane.record_span(
+            exec_ctx, f"replica:{self._deployment or 'replica'}",
+            ts=t_wall, dur_s=now - t0, stages=stages, attrs=attrs,
+            error=err is not None, span_id=exec_ctx.span_id,
+            parent_id=parent_span, service="replica")
 
     def handle_request_streaming(self, method_name: str, args, kwargs,
                                  model_id: str = "",
@@ -1203,22 +1354,48 @@ class Replica:
         look busy to routing and must not be an autoscaler down-scale
         victim."""
         deadline_ts = (meta or {}).get("deadline_ts")
-        self._check_deadline(deadline_ts)
-        self._admit(deadline_ts)
-        started = time.monotonic()
-        # Tokens span the WHOLE stream: the generator body runs during
-        # iteration, long after _invoke (which only creates it, with
-        # the same context) has returned.
-        token = _multiplexed_model_id.set(model_id) if model_id else None
-        dl_token = _request_deadline.set(deadline_ts)
+        exec_ctx, parent_span = self._trace_exec_ctx(meta)
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        t_admit = t0
+        err: BaseException | None = None
+        trace_token = (tracing_plane.set_current(exec_ctx)
+                       if exec_ctx is not None else None)
         try:
-            yield from self._invoke(method_name, args, kwargs, model_id,
-                                    deadline_ts)
+            try:
+                self._check_deadline(deadline_ts)
+                self._admit(deadline_ts)
+            finally:
+                t_admit = time.perf_counter()  # queue stage incl. sheds
+            started = time.monotonic()
+            # Tokens span the WHOLE stream: the generator body runs
+            # during iteration, long after _invoke (which only creates
+            # it, with the same context) has returned.
+            token = (_multiplexed_model_id.set(model_id) if model_id
+                     else None)
+            dl_token = _request_deadline.set(deadline_ts)
+            try:
+                yield from self._invoke(method_name, args, kwargs,
+                                        model_id, deadline_ts)
+            finally:
+                _request_deadline.reset(dl_token)
+                if token is not None:
+                    _multiplexed_model_id.reset(token)
+                self._release(started)
+        except BaseException as e:
+            err = e
+            raise
         finally:
-            _request_deadline.reset(dl_token)
-            if token is not None:
-                _multiplexed_model_id.reset(token)
-            self._release(started)
+            if trace_token is not None:
+                tracing_plane.reset(trace_token)
+            if exec_ctx is not None:
+                # GeneratorExit (consumer abandoned the stream) is a
+                # normal ending, not a replica failure.
+                failed = err is not None and not isinstance(
+                    err, GeneratorExit)
+                self._record_request_span(
+                    exec_ctx, parent_span, method_name, t_wall, t0,
+                    t_admit, err if failed else None)
 
     def ongoing(self) -> int:
         """Queue-depth metric feeding autoscaling and po2 routing
@@ -1729,6 +1906,22 @@ class ServeController:
             art.kill(replica)
         except Exception:  # noqa: BLE001
             pass
+        _expire_replica_series(replica)
+
+    @staticmethod
+    def _expire_deployment_series(name: str) -> None:
+        """Drop a removed deployment's ``art_serve_*`` series from the
+        GCS metrics table (queue depth, shed counters, suspect gauges
+        would otherwise report a deleted deployment forever)."""
+        try:
+            from ant_ray_tpu.api import global_worker  # noqa: PLC0415
+
+            rt = global_worker.runtime
+            rt._send_oneway(rt.gcs_address, "MetricsExpire",
+                            {"match_tags": {"deployment": name},
+                             "name_prefix": "art_serve_"})
+        except Exception:  # noqa: BLE001 — observability is best-effort
+            pass
 
     def list_deployments(self):
         return {
@@ -1780,6 +1973,7 @@ class ServeController:
         with self._lock:
             doomed = [r for entry in self._deployments.values()
                       for r in entry["replicas"]]
+            names = list(self._deployments)
             self._deployments.clear()
             # Wake parked listeners: their deployments now read as
             # deleted, so listener threads exit instead of waiting out
@@ -1790,6 +1984,9 @@ class ServeController:
                 art.kill(r)
             except Exception:  # noqa: BLE001
                 pass
+            _expire_replica_series(r)
+        for name in names:
+            self._expire_deployment_series(name)
         for proxy in (self._proxy, getattr(self, "_grpc_proxy", None)):
             if proxy is not None:
                 try:
@@ -1865,7 +2062,12 @@ class HttpProxy:
             """Blocking route+call (runs on an executor thread so the
             aiohttp loop stays free; building an unprepared Response
             off-loop is fine).  Routes through ``handle.call`` for the
-            full overload contract."""
+            full overload contract.
+
+            Tracing ingress: a root context is minted per request and
+            scoped over the call; the ``http:{path}`` span records the
+            end-to-end server time, force-sampled with ``error:true``
+            when the request sheds (429) or misses its deadline (504)."""
             handle = resolve_handle(path)
             if handle is None:
                 return web.json_response(
@@ -1876,14 +2078,29 @@ class HttpProxy:
                 # dispatch on the request path (ref: proxy passes the
                 # scope through to the replica).
                 body.setdefault("__route_path__", path)
+            ctx = tracing_plane.mint()
+            t_wall = time.time()
+            t0 = time.perf_counter()
+            status = 200
             try:
-                return web.json_response(
-                    {"result": handle.call(body, timeout_s=timeout_s)})
+                with tracing_plane.use(ctx):
+                    return web.json_response(
+                        {"result": handle.call(body,
+                                               timeout_s=timeout_s)})
             except Exception as e:  # noqa: BLE001 — classified below
                 resp = shed_response(e)
                 if resp is not None:
+                    status = resp.status
                     return resp
+                status = 500
                 return web.json_response({"error": repr(e)}, status=500)
+            finally:
+                tracing_plane.record_span(
+                    ctx, f"http:{path}", ts=t_wall,
+                    dur_s=time.perf_counter() - t0,
+                    attrs={"path": path, "status": status},
+                    error=status >= 400, span_id=ctx.span_id,
+                    parent_id="", service="http-proxy")
 
         def stream_start(path: str, body, timeout_s: float | None):
             """Start a streaming call; returns (handle, replica,
@@ -1900,10 +2117,28 @@ class HttpProxy:
                 body.setdefault("__route_path__", path)
             h = handle.options(method_name="stream", stream=True)
             h._maybe_refresh()
-            replica = h._pick()     # may raise typed BackPressureError
-            return (h, replica,
-                    h._dispatch(replica, (body,), {}, h._mux_model_id,
-                                h._request_meta(timeout_s)))
+            # Streaming ingress mints the trace root too; the span is
+            # recorded when dispatch fails (shed) — mid-stream life is
+            # covered by the replica-side stream span.
+            ctx = tracing_plane.mint()
+            t_wall = time.time()
+            t0 = time.perf_counter()
+            try:
+                with tracing_plane.use(ctx):
+                    replica = h._pick()  # may raise typed BackPressure
+                    gen = h._dispatch(replica, (body,), {},
+                                      h._mux_model_id,
+                                      h._request_meta(timeout_s,
+                                                      trace=ctx))
+            except BaseException:
+                tracing_plane.record_span(
+                    ctx, f"http:{path}", ts=t_wall,
+                    dur_s=time.perf_counter() - t0,
+                    attrs={"path": path, "stream": True}, error=True,
+                    span_id=ctx.span_id, parent_id="",
+                    service="http-proxy")
+                raise
+            return (h, replica, gen)
 
         def next_chunk(gen):
             try:
@@ -2117,11 +2352,27 @@ class GrpcProxy:
         if native is not None:
             timeout_s = (native if timeout_s is None
                          else min(timeout_s, native))
+        # Tracing ingress (gRPC unary): mint, scope, record — sheds
+        # force-sample an error span carrying the trace id the client
+        # can quote from the trailer-documented retry contract.
+        ctx = tracing_plane.mint()
+        t_wall = time.time()
+        t0 = time.perf_counter()
+        ok = False
         try:
-            result = handle.call(body, timeout_s=timeout_s)
+            with tracing_plane.use(ctx):
+                result = handle.call(body, timeout_s=timeout_s)
+            ok = True
         except Exception as e:  # noqa: BLE001 — classified below
             self._abort_overload(context, e)
             context.abort(grpc.StatusCode.INTERNAL, repr(e))
+        finally:
+            tracing_plane.record_span(
+                ctx, f"grpc:{route}", ts=t_wall,
+                dur_s=time.perf_counter() - t0,
+                attrs={"route": route}, error=not ok,
+                span_id=ctx.span_id, parent_id="",
+                service="grpc-proxy")
         return json.dumps({"result": result}).encode("utf-8")
 
     def _stream(self, request_bytes, context):
@@ -2140,13 +2391,21 @@ class GrpcProxy:
         # admission gate fires on generator start, i.e. the first get).
         h = handle.options(method_name="stream", stream=True)
         h._maybe_refresh()
-        try:
-            replica = h._pick()
-        except BackPressureError as e:
-            # Every replica ejected: same shed contract as unary.
-            self._abort_overload(context, e)
-        gen = h._dispatch(replica, (body,), {}, h._mux_model_id,
-                          h._request_meta(context.time_remaining()))
+        ctx = tracing_plane.mint()
+        with tracing_plane.use(ctx):
+            try:
+                replica = h._pick()
+            except BackPressureError as e:
+                tracing_plane.record_span(
+                    ctx, f"grpc:{route}", ts=time.time(), dur_s=0.0,
+                    attrs={"route": route, "stream": True}, error=True,
+                    span_id=ctx.span_id, parent_id="",
+                    service="grpc-proxy")
+                # Every replica ejected: same shed contract as unary.
+                self._abort_overload(context, e)
+            gen = h._dispatch(replica, (body,), {}, h._mux_model_id,
+                              h._request_meta(context.time_remaining(),
+                                              trace=ctx))
         try:
             for ref in gen:
                 yield json.dumps(art.get(ref)).encode("utf-8")

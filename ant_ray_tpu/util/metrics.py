@@ -4,6 +4,8 @@ text by the dashboard's /metrics endpoint."""
 
 from __future__ import annotations
 
+import time
+
 
 def _record(payload: dict):
     from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
@@ -68,7 +70,16 @@ class Histogram(_Metric):
         super().__init__(name, description, tag_keys)
         self._boundaries = sorted(float(b) for b in (boundaries or []))
 
-    def observe(self, value: float, tags: dict | None = None):
+    def observe(self, value: float, tags: dict | None = None,
+                exemplar: dict | None = None):
         # Boundaries ride along so the GCS can tally per-bucket counts
-        # and /metrics can render real _bucket{le=...} lines.
-        self._emit(value, tags, extra={"boundaries": self._boundaries})
+        # and /metrics can render real _bucket{le=...} lines.  An
+        # exemplar ({"trace_id": ...}) links the observation to a
+        # concrete trace, OpenMetrics style: the GCS keeps the latest
+        # per series and /metrics renders `# {trace_id="..."} v ts`.
+        extra: dict = {"boundaries": self._boundaries}
+        if exemplar:
+            extra["exemplar"] = {"labels": dict(exemplar),
+                                 "value": float(value),
+                                 "ts": time.time()}
+        self._emit(value, tags, extra=extra)

@@ -46,6 +46,56 @@ def fetch_step_events(limit: int = 20000) -> list[dict]:
         return []
 
 
+def fetch_span_events(limit: int = 50000,
+                      trace_id: str | None = None) -> list[dict]:
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    runtime = global_worker.runtime
+    from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+    tracing_plane.flush()  # this process's pending tail
+    payload: dict = {"limit": limit}
+    if trace_id is not None:
+        payload["trace_id"] = trace_id
+    try:
+        return runtime._gcs.call("SpanEventsGet", payload,
+                                 retries=3) or []
+    except Exception:  # noqa: BLE001 — pre-upgrade GCS without the ring
+        return []
+
+
+def build_request_rows(span_events: list[dict]) -> list[dict]:
+    """Per-request rows from published trace spans
+    (observability/tracing_plane.py): one ``request/<trace8>`` track per
+    trace, each span an "X" slice (args carry stage seconds, node, pid,
+    error) — Perfetto shows a serve request's ingress → router →
+    replica → nested task → object-pull decomposition next to the task
+    schedule."""
+    trace: list[dict] = []
+    pid = "request"
+    for s in span_events:
+        dur = float(s.get("dur_s", 0.0))
+        ts_us = float(s.get("ts", 0.0)) * 1e6
+        tid = str(s.get("trace_id", ""))[:8]
+        args = {"trace_id": s.get("trace_id"),
+                "span_id": s.get("span_id"),
+                "parent_id": s.get("parent_id"),
+                "node_id": s.get("node_id"), "pid": s.get("pid")}
+        for stage, sec in (s.get("stages") or {}).items():
+            args[f"{stage}_s"] = round(float(sec), 6)
+        args.update(s.get("attrs") or {})
+        if s.get("error"):
+            args["error"] = True
+        trace.append({
+            "ph": "X", "cat": "request_span",
+            "name": s.get("name", "span"),
+            "pid": pid, "tid": tid, "ts": ts_us, "dur": dur * 1e6,
+            "args": args,
+            **({"cname": "terrible"} if s.get("error") else {}),
+        })
+    return trace
+
+
 def build_step_rows(step_events: list[dict]) -> list[dict]:
     """Per-rank device rows from published step records: one "X" slice
     per step ("step N", args carry phase seconds + MFU) and nested "X"
@@ -90,7 +140,9 @@ def build_step_rows(step_events: list[dict]) -> list[dict]:
 
 
 def build_chrome_trace(events: list[dict],
-                       step_events: list[dict] | None = None) -> list[dict]:
+                       step_events: list[dict] | None = None,
+                       span_events: list[dict] | None = None
+                       ) -> list[dict]:
     by_task: dict[str, dict] = {}
     for event in events:
         record = by_task.setdefault(event["task_id"], {"events": {}})
@@ -136,17 +188,21 @@ def build_chrome_trace(events: list[dict],
                 "pid": pid, "tid": tid, "ts": ts_us})
     if step_events:
         trace.extend(build_step_rows(step_events))
+    if span_events:
+        trace.extend(build_request_rows(span_events))
     return trace
 
 
 def timeline(filename: str | None = None) -> list[dict] | str:
     """Chrome trace of the cluster's task schedule — plus, when a step
-    profiler published records, per-rank step-phase device rows.  With
+    profiler published records, per-rank step-phase device rows, and
+    when request traces were sampled, per-request span rows.  With
     ``filename`` writes the JSON and returns the path (load in
     chrome://tracing or https://ui.perfetto.dev); without, returns the
     event list."""
     trace = build_chrome_trace(fetch_task_events(),
-                               step_events=fetch_step_events())
+                               step_events=fetch_step_events(),
+                               span_events=fetch_span_events())
     if filename is None:
         return trace
     with open(filename, "w") as f:

@@ -3,15 +3,18 @@
 task/actor calls in OTel spans when ``_tracing_startup_hook`` is set,
 and proxy-mocks otel when it isn't installed, :147-176).
 
-Design difference, on purpose: the reference instruments the submission
-path with a live OTel SDK in every process.  Here workers already
-buffer task lifecycle events (submitted/started/finished, with parent
-linkage via contextvar) into the GCS aggregator for the timeline — so
-spans are DERIVED from that single event stream instead of running a
-second tracing pipeline.  One instrumentation, three consumers
-(timeline, state API, tracing), and the OTel SDK stays optional:
+Two span sources, ONE code path out: requests traced by the live
+tracing plane (observability/tracing_plane.py — contexts minted at
+ingresses and PROPAGATED through request metadata, Dapper style)
+surface their real cross-process spans via :func:`live_spans`; tasks no
+propagated context covered (unsampled traffic) fall back to spans
+DERIVED from the buffered task lifecycle events
+(submitted/started/finished with parent linkage via contextvar), with
+re-executed/retried attempts salted into distinct span ids.  The OTel
+SDK stays optional:
 
-* :func:`task_spans` — span objects (trace/span/parent ids, timings)
+* :func:`task_spans` — span objects (trace/span/parent ids, timings);
+  propagated spans first, derived spans as the fallback
 * :func:`export_otlp_json` — OTLP/JSON file any collector can ingest
 * :func:`replay_to_otel` — emit through a real installed
   ``opentelemetry`` TracerProvider when the package is available
@@ -41,13 +44,18 @@ class Span:
     attributes: dict = field(default_factory=dict)
 
 
-def _span_id(task_id: str) -> str:
+def _span_id(task_id: str, attempt: int = 0) -> str:
     # Hash, don't truncate: task ids share a long job-id prefix, so a
-    # prefix-slice would collide every span in a job.
+    # prefix-slice would collide every span in a job.  The attempt
+    # number salts the hash so a re-executed/retried task's span never
+    # collides with the original run's (attempt 0 keeps the historical
+    # unsalted id).
     import hashlib  # noqa: PLC0415
 
-    return hashlib.blake2b((task_id or "").encode(),
-                           digest_size=8).hexdigest()
+    key = task_id or ""
+    if attempt:
+        key = f"{key}#{attempt}"
+    return hashlib.blake2b(key.encode(), digest_size=8).hexdigest()
 
 
 def _trace_id(task_id: str) -> str:
@@ -57,22 +65,88 @@ def _trace_id(task_id: str) -> str:
                            digest_size=16).hexdigest()
 
 
-def task_spans(events: list[dict] | None = None) -> list[Span]:
-    """Fold the event stream into one span per task execution.
+def live_spans(span_events: list[dict] | None = None) -> list[Span]:
+    """Propagated request-trace spans (observability/tracing_plane.py:
+    minted at ingresses, carried in request meta, published to the GCS
+    span ring) as OTel-shaped :class:`Span` objects — real cross-process
+    trace/span ids, not post-hoc derivations.  Stage timings surface as
+    ``art.stage.<name>_s`` attributes."""
+    if span_events is None:
+        from ant_ray_tpu.util.timeline import fetch_span_events  # noqa: PLC0415
 
-    ``trace_id`` groups a call tree: each task inherits its root
+        span_events = fetch_span_events()
+    spans = []
+    for s in span_events:
+        attrs = dict(s.get("attrs") or {})
+        for stage, sec in (s.get("stages") or {}).items():
+            attrs[f"art.stage.{stage}_s"] = round(float(sec), 6)
+        if s.get("node_id"):
+            attrs["art.node_id"] = s["node_id"]
+        if s.get("pid"):
+            attrs["art.pid"] = s["pid"]
+        if s.get("service"):
+            attrs["art.service"] = s["service"]
+        if s.get("error"):
+            attrs["error"] = True
+        ts = float(s.get("ts", 0.0))
+        spans.append(Span(
+            trace_id=s["trace_id"],
+            span_id=s["span_id"],
+            parent_span_id=s.get("parent_id") or "",
+            name=s.get("name", "span"),
+            start_ns=int(ts * _NS),
+            end_ns=int((ts + float(s.get("dur_s", 0.0))) * _NS),
+            ok=not s.get("error"),
+            attributes=attrs,
+        ))
+    return spans
+
+
+def task_spans(events: list[dict] | None = None,
+               span_events: list[dict] | None = None) -> list[Span]:
+    """ONE code path for spans: propagated request-trace spans where a
+    context travelled (``live_spans``), with driver-local DERIVED spans
+    as the fallback for tasks no propagated context covered (unsampled
+    traffic, pre-upgrade workers).
+
+    ``trace_id`` groups a call tree: a derived task inherits its root
     ancestor's id, so a driver-submitted task and everything it spawned
-    share one trace (the W3C trace-context notion of the reference's
-    propagated spans)."""
+    share one trace (the W3C trace-context notion); propagated spans
+    carry their minted ingress trace id as-is.  Re-executed/retried
+    tasks derive one span per (task_id, attempt), attempt-salted ids."""
+    explicit_events = events is not None
     if events is None:
         events = fetch_task_events()
-    by_task: dict[str, dict] = {}
+    if span_events is None and not explicit_events:
+        # Only reach for the cluster when the caller didn't hand us a
+        # specific event set (unit-test / offline usage stays offline).
+        from ant_ray_tpu.util.timeline import fetch_span_events  # noqa: PLC0415
+
+        try:
+            span_events = fetch_span_events()
+        except Exception:  # noqa: BLE001 — no cluster connected
+            span_events = []
+    live = live_spans(span_events or [])
+    # Tasks already covered by a propagated execution span don't get a
+    # second derived span (one instrumentation, not two vocabularies).
+    covered = {s.attributes["task_id"] for s in live
+               if "task_id" in s.attributes}
+    by_task: dict[tuple, dict] = {}
     for e in sorted(events, key=lambda e: e["ts"]):
-        rec = by_task.setdefault(e["task_id"], {"events": {}})
+        if e["task_id"] in covered:
+            continue
+        key = (e["task_id"], int(e.get("attempt") or 0))
+        rec = by_task.setdefault(key, {"events": {}})
         rec["events"].setdefault(e["event"], e)
 
+    # task_id -> any attempt's record, for parent-chain resolution (a
+    # child's events name only the parent task, not its attempt).
+    by_task_any: dict[str, dict] = {}
+    for (tid, _attempt), rec in by_task.items():
+        by_task_any.setdefault(tid, rec)
+
     def root_of(task_id: str, hops: int = 0) -> str:
-        rec = by_task.get(task_id)
+        rec = by_task_any.get(task_id)
         if rec is None or hops > 256:
             return task_id
         for e in rec["events"].values():
@@ -81,8 +155,8 @@ def task_spans(events: list[dict] | None = None) -> list[Span]:
                 return root_of(parent, hops + 1)
         return task_id
 
-    spans = []
-    for task_id, rec in by_task.items():
+    spans = list(live)
+    for (task_id, attempt), rec in by_task.items():
         ev = rec["events"]
         started = ev.get("started")
         ended = ev.get("finished") or ev.get("failed")
@@ -99,6 +173,8 @@ def task_spans(events: list[dict] | None = None) -> list[Span]:
             "art.node_id": any_e.get("node_id", ""),
             "art.pid": any_e.get("pid", 0),
         }
+        if attempt:
+            attributes["art.attempt"] = attempt
         if any_e.get("actor_id"):
             attributes["art.actor_id"] = any_e["actor_id"]
         if submitted is not None:
@@ -110,7 +186,7 @@ def task_spans(events: list[dict] | None = None) -> list[Span]:
             attributes["error"] = True
         spans.append(Span(
             trace_id=_trace_id(root_of(task_id)),
-            span_id=_span_id(task_id),
+            span_id=_span_id(task_id, attempt),
             parent_span_id=_span_id(parent) if parent else "",
             name=any_e.get("name", task_id),
             start_ns=int(started["ts"] * _NS),

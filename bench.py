@@ -189,6 +189,13 @@ _GUARDED_METRICS = {
     # queueing unboundedly instead of fast-failing with 429).
     "serve_goodput_under_overload": "higher",
     "serve_shed_fraction": "higher",
+    # Tracing plane (PR 8): the unsampled per-call cost of always-on
+    # request tracing (mint + entered-but-unrecorded span; < 2 µs
+    # budget hard-failed in microbench) and the fully-instrumented
+    # (sample rate 1.0) sync actor-call p99 with per-stage spans — the
+    # number ROADMAP item 2's fast-path work decomposes against.
+    "trace_overhead_unsampled_ns": "lower",
+    "rpc_p99_actor_call_us": "lower",
 }
 
 

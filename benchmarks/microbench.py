@@ -244,6 +244,74 @@ def main() -> None:
     emit("step_profiler_overhead_publish_ns",
          profiler_overhead_ns(StepProfiler()), "ns")
 
+    # ---- tracing plane (observability/tracing_plane.py): the headline
+    # metric is the UNSAMPLED per-call cost — an ingress mint (coin
+    # flip + ids) plus an entered-but-unrecorded span block — budgeted
+    # at < 2 µs so always-on tracing is free for untraced traffic.
+    from ant_ray_tpu._private.config import global_config  # noqa: PLC0415
+    from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+    n_spans = max(2000, int(20000 * scale))
+
+    def trace_overhead_ns() -> float:
+        """Per-call cost of the unsampled TASK-SUBMIT path — exactly
+        what core._trace_attach adds to a driver .remote() with tracing
+        always-on: one contextvar read plus the ingress coin
+        (maybe_mint miss generates no ids, allocates nothing).  The
+        per-REQUEST serve-hop shapes (entered span blocks, full mints)
+        are request-scale costs exercised by rpc_p99_actor_call_us."""
+        current, maybe_mint = (tracing_plane.current,
+                               tracing_plane.maybe_mint)
+        t0 = time.perf_counter()
+        for _ in range(n_spans):
+            pass
+        bare = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(n_spans):
+            if current() is None:
+                maybe_mint()
+        return (time.perf_counter() - t0 - bare) / n_spans * 1e9
+
+    trace_overhead_ns()                                   # warmup
+    trace_ns = sorted(trace_overhead_ns() for _ in range(3))[1]
+    emit("trace_overhead_unsampled_ns", trace_ns, "ns")
+    if trace_ns > 2000.0:
+        # Observability must stay free: the unsampled path taxing calls
+        # past the budget is a regression, not a tuning matter.
+        print(json.dumps({"metric": "bench_error",
+                          "bench_error":
+                          f"trace_overhead_unsampled_ns={trace_ns:.0f} "
+                          "exceeds 2000ns budget"}))
+
+    # ---- traced actor-call p99 with the per-stage decomposition the
+    # control-plane fast-path work (ROADMAP item 2) attributes against:
+    # sample rate forced to 1.0 so EVERY call records client/worker
+    # spans — this is the fully-instrumented number, deliberately.
+    cfg = global_config()
+    old_rate = cfg.trace_sample_rate
+    cfg.trace_sample_rate = 1.0
+    try:
+        n_rpc = max(200, int(1000 * scale))
+        art.get(actor.ping.remote())                      # warm trace path
+        lat = []
+        for _ in range(n_rpc):
+            t0 = time.perf_counter()
+            art.get(actor.ping.remote())
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        emit("rpc_p99_actor_call_us",
+             lat[int(0.99 * (len(lat) - 1))] * 1e6, "us")
+        stages: dict = {}
+        for s in tracing_plane.recorder().snapshot():
+            if s.get("name") == "call:Echo.ping":
+                for stage, sec in (s.get("stages") or {}).items():
+                    stages.setdefault(stage, []).append(sec)
+        for stage, vals in sorted(stages.items()):
+            emit(f"rpc_actor_call_{stage}_us_mean",
+                 sum(vals) / len(vals) * 1e6, "us")
+    finally:
+        cfg.trace_sample_rate = old_rate
+
     art.shutdown()
 
     # ---- striped broadcast pull (node_daemon._pull_chunks): a third

@@ -58,6 +58,27 @@ def test_schema_entries_are_well_formed():
         assert entry["payload"] and entry["reply"], name
 
 
+def test_every_method_has_an_rpc_latency_plane():
+    """Tracing lint: every wire-schema method must have an
+    ``art_rpc_latency_s`` plane mapping in the tracing plane's
+    RPC_METHOD_PLANES table — a future RPC cannot ship untraced
+    (adding the method without deciding its latency-aggregation plane
+    fails here)."""
+    from ant_ray_tpu.observability.tracing_plane import RPC_METHOD_PLANES
+
+    missing = set(wire_schema.METHODS) - set(RPC_METHOD_PLANES)
+    assert not missing, (
+        f"RPC methods without an art_rpc_latency_s plane mapping: "
+        f"{sorted(missing)} — add them to "
+        "observability/tracing_plane.py:RPC_METHOD_PLANES")
+    stale = set(RPC_METHOD_PLANES) - set(wire_schema.METHODS)
+    assert not stale, (
+        f"RPC_METHOD_PLANES names methods absent from the wire schema: "
+        f"{sorted(stale)}")
+    assert all(isinstance(v, str) and v
+               for v in RPC_METHOD_PLANES.values())
+
+
 def test_version_fence_rejects_mismatched_client():
     """A peer speaking a different wire protocol gets a GOODBYE frame
     naming both versions and a closed connection — not a hang or a
