@@ -1,0 +1,610 @@
+"""The project-specific checkers.  Each rule encodes a bug class this
+repo has already shipped once — the ``prevents`` string names it.
+
+Heuristics over proofs: these are AST pattern matchers, not a type
+system.  A rule that cries wolf gets suppressed into uselessness, so
+every matcher is written to UNDER-match (e.g. ``.call()`` is only a
+blocking RPC when the receiver is named like a client) and deliberate
+sites carry ``# artlint: disable=<rule> — <why>`` rationale comments.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Iterable
+
+from ant_ray_tpu._lint.framework import (
+    Checker,
+    Finding,
+    ProjectChecker,
+)
+
+# ------------------------------------------------------------ shared bits
+
+#: Attribute calls that park the calling thread on I/O or a subprocess.
+_SOCKET_BLOCKING_ATTRS = {"sendall", "recv", "recv_into", "recvfrom",
+                          "recvmsg"}
+#: ``send`` blocks too, but only flag it on receivers that are plainly
+#: sockets/collectives — ``generator.send`` is everywhere and harmless.
+_SEND_BASES = {"sock", "socket", "conn", "col"}
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "Popen"}
+#: Receiver names that mark ``.call()`` as a synchronous RPC.
+_RPC_BASES = {"gcs", "rpc"}
+_LOCKISH_RE = re.compile(r"lock|mutex|cond|_cv$", re.IGNORECASE)
+
+
+def _terminal_name(node: ast.AST) -> str:
+    """The rightmost identifier of a Name/Attribute/Call chain:
+    ``self._chunk_cache_lock`` -> ``_chunk_cache_lock``,
+    ``_pair_lock(g, s)`` -> ``_pair_lock``."""
+    if isinstance(node, ast.Call):
+        node = node.func
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _base_name(func: ast.Attribute) -> str:
+    """The terminal name of an attribute call's receiver:
+    ``runtime._clients.get`` -> ``_clients``, ``time.sleep`` -> ``time``."""
+    return _terminal_name(func.value)
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    return bool(_LOCKISH_RE.search(_terminal_name(expr)))
+
+
+class _StmtTracker(ast.NodeVisitor):
+    """NodeVisitor that remembers the innermost enclosing statement.
+    Findings anchor there: that is the line a fix edits and the line a
+    ``# artlint: disable`` comment block sits above (a directive above
+    a multi-line statement must suppress a match on a continuation
+    line)."""
+
+    def __init__(self):
+        self._stmt: ast.stmt | None = None
+
+    def visit(self, node):
+        if isinstance(node, ast.stmt):
+            self._stmt = node
+        return super().visit(node)
+
+    def anchor(self, node: ast.AST) -> ast.AST:
+        return self._stmt if self._stmt is not None else node
+
+    def stmt_header_span(self, node: ast.AST) -> tuple[int, int]:
+        """(start, end) lines of the enclosing statement's HEADER: for
+        a compound statement (If/While/With/...) the span stops before
+        the first body statement — `if time.time() - t > 60:` must not
+        be exempted by what its body happens to mention."""
+        stmt = self.anchor(node)
+        start = stmt.lineno
+        body = getattr(stmt, "body", None)
+        if isinstance(body, list) and body \
+                and isinstance(body[0], ast.stmt):
+            return start, max(start, body[0].lineno - 1)
+        return start, getattr(stmt, "end_lineno", start) or start
+
+
+def _blocking_call(node: ast.Call) -> str | None:
+    """Why this call blocks the thread, or None.  The deny-list mirrors
+    the repo's real blocking surface: time.sleep, socket I/O,
+    subprocess, sync RpcClient.call, concurrent.futures ``result()``."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    attr, base = func.attr, _base_name(func)
+    base_l = base.lower()
+    if attr == "sleep" and base == "time":
+        return "time.sleep() parks the thread"
+    if attr in _SOCKET_BLOCKING_ATTRS:
+        return f"socket .{attr}() blocks on the wire"
+    if attr == "send" and base_l in _SEND_BASES:
+        return f"{base}.send() blocks on the wire"
+    if base == "subprocess" and attr in _SUBPROCESS_FNS:
+        return f"subprocess.{attr}() blocks on a child process"
+    if attr == "call" and ("client" in base_l or base_l in _RPC_BASES):
+        return (f"sync RPC {base}.call() blocks on a round trip "
+                "(use call_async / oneway)")
+    if attr == "result" and len(node.args) <= 1 and not node.keywords:
+        return "future .result() parks the thread on remote completion"
+    return None
+
+
+# -------------------------------------------------------------- checkers
+
+class BlockingUnderLockChecker(Checker):
+    """Blocking calls inside ``with <lock>:`` bodies serialize every
+    contender behind one I/O round trip — the whole plane stalls, not
+    one caller.
+
+    DELIBERATE over-match: nested ``def``s inside the critical section
+    are scanned too (unlike blocking-in-async, which exempts them).
+    The historical bug this rule encodes lived in exactly such a
+    helper — ``_recv_all`` defined under the tensor-transport pair
+    lock and executed while it was held.  A callback that is defined
+    under a lock but genuinely invoked lock-free carries a rationale
+    suppression instead."""
+
+    rule = "blocking-under-lock"
+    prevents = ("ADVICE round 5: blocking col.send() under a "
+                "module-global lock serialized all tensor transfers")
+    scope = ("ant_ray_tpu/_private/", "ant_ray_tpu/experimental/",
+             "ant_ray_tpu/util/collective/")
+
+    def check(self, rel_path: str, tree: ast.AST,
+              lines: list[str]) -> Iterable[Finding]:
+        checker = self
+        findings: list[Finding] = []
+
+        class V(_StmtTracker):
+            def __init__(self):
+                super().__init__()
+                self.lock_depth = 0
+
+            def visit_With(self, node: ast.With):
+                held = any(_is_lockish(i.context_expr)
+                           for i in node.items)
+                self.lock_depth += held
+                self.generic_visit(node)
+                self.lock_depth -= held
+
+            def visit_Call(self, node: ast.Call):
+                if self.lock_depth:
+                    why = _blocking_call(node)
+                    if why:
+                        findings.append(checker.finding(
+                            rel_path, self.anchor(node),
+                            f"{why} while a lock is held — move the "
+                            "blocking work outside the critical "
+                            "section (snapshot under the lock, then "
+                            "do I/O)", lines))
+                self.generic_visit(node)
+
+        V().visit(tree)
+        return findings
+
+
+class BlockingInAsyncChecker(Checker):
+    """The same blocking set inside ``async def`` parks the whole event
+    loop: every coroutine sharing it stalls, heartbeats included."""
+
+    rule = "blocking-in-async"
+    prevents = ("daemon-plane review: one sync RPC on the io loop "
+                "freezes every in-flight request on that process")
+
+    def check(self, rel_path: str, tree: ast.AST,
+              lines: list[str]) -> Iterable[Finding]:
+        checker = self
+        findings: list[Finding] = []
+
+        class V(_StmtTracker):
+            def __init__(self):
+                super().__init__()
+                self.async_depth = 0
+
+            def visit_AsyncFunctionDef(self, node):
+                self.async_depth += 1
+                self.generic_visit(node)
+                self.async_depth -= 1
+
+            def visit_FunctionDef(self, node):
+                # A nested sync def runs wherever it is CALLED (often a
+                # thread-pool executor) — not on the loop.
+                saved, self.async_depth = self.async_depth, 0
+                self.generic_visit(node)
+                self.async_depth = saved
+
+            visit_Lambda = visit_FunctionDef
+
+            def visit_Call(self, node: ast.Call):
+                if self.async_depth:
+                    why = _blocking_call(node)
+                    if why:
+                        findings.append(checker.finding(
+                            rel_path, self.anchor(node),
+                            f"{why} inside async def — this parks the "
+                            "event loop; await the async variant or "
+                            "run_in_executor", lines))
+                self.generic_visit(node)
+
+        V().visit(tree)
+        return findings
+
+
+class BannedApisChecker(Checker):
+    """APIs with a strictly-better project-native replacement.
+
+    * ``asyncio.iscoroutine`` → ``inspect.iscoroutine``: on py<3.12 the
+      asyncio variant also matches plain generators, which fed streaming
+      tasks' generators to the event loop ("Task got bad yield" — the
+      root cause of all 8 pre-PR-5 tier-1 failures).
+    * ``time.time()`` in duration/deadline arithmetic →
+      ``time.monotonic()``: wall clock steps under NTP correction, so
+      intervals computed from it can go negative or jump hours.
+      Cross-process wire fields are the sanctioned exception — wall
+      clock is the only clock two hosts share.  Statements mentioning
+      ``deadline_ts`` (the wire-deadline naming convention) are
+      allowlisted automatically; other deliberate sites carry a
+      ``# artlint: disable=banned-apis — <why>`` rationale.
+    """
+
+    rule = "banned-apis"
+    prevents = ("PR 5 root cause: asyncio.iscoroutine matched plain "
+                "generators on py<3.12 (all 8 pre-existing tier-1 "
+                "failures); NTP steps break time.time() intervals")
+
+    #: Identifiers whose presence on the flagged line marks the value as
+    #: a cross-process wire field (wall clock is correct there).
+    wallclock_wire_names = ("deadline_ts",)
+
+    def check(self, rel_path: str, tree: ast.AST,
+              lines: list[str]) -> Iterable[Finding]:
+        checker = self
+        findings: list[Finding] = []
+
+        def _is_time_time(node: ast.AST) -> bool:
+            return (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "time"
+                    and _base_name(node.func) == "time")
+
+        class V(_StmtTracker):
+            def _flag_time_arith(self, node: ast.AST):
+                # Findings anchor on the enclosing STATEMENT: that is
+                # the line a fix edits and the line a disable comment
+                # sits above.  The wire-field allowlist scans only the
+                # statement HEADER — an `if time.time() - t0 > 60:`
+                # must not be exempted because its body happens to
+                # mention deadline_ts.
+                anchor = self.anchor(node)
+                start, end = self.stmt_header_span(node)
+                text = " ".join(lines[start - 1:end])
+                if any(name in text
+                       for name in checker.wallclock_wire_names):
+                    return
+                findings.append(checker.finding(
+                    rel_path, anchor,
+                    "time.time() in duration/deadline arithmetic — "
+                    "use time.monotonic() (wall clock steps under "
+                    "NTP); keep wall clock only for cross-process "
+                    "wire fields, with a disable comment saying so",
+                    lines))
+
+            def visit_Call(self, node: ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr == "iscoroutine"
+                        and _base_name(func) == "asyncio"):
+                    findings.append(checker.finding(
+                        rel_path, node,
+                        "asyncio.iscoroutine() also matches plain "
+                        "generators on py<3.12 — use "
+                        "inspect.iscoroutine()", lines))
+                self.generic_visit(node)
+
+            def visit_BinOp(self, node: ast.BinOp):
+                if isinstance(node.op, (ast.Add, ast.Sub)) and (
+                        _is_time_time(node.left)
+                        or _is_time_time(node.right)):
+                    self._flag_time_arith(node)
+                self.generic_visit(node)
+
+            def visit_Compare(self, node: ast.Compare):
+                if any(_is_time_time(n)
+                       for n in [node.left, *node.comparators]):
+                    self._flag_time_arith(node)
+                self.generic_visit(node)
+
+        V().visit(tree)
+        return findings
+
+
+class BaseExceptionSwallowChecker(Checker):
+    """``except:`` / ``except BaseException`` without a re-raise eats
+    the interrupts this codebase treats as control flow:
+    ``train.PreemptionInterrupt`` is a BaseException BY DESIGN (so user
+    ``except Exception`` can't swallow a node drain) and
+    ``asyncio.CancelledError`` drives every shutdown path.
+
+    The error-channeling idiom is exempt: a handler that binds the
+    exception (``as e``) and forwards the bound value somewhere a
+    consumer will re-raise it (queue.put, set_exception, storing it
+    for a reply) propagates rather than swallows.  Merely LOGGING the
+    bound name is not channeling — ``logger.warning("ignored: %s", e)``
+    is the canonical swallow, the exact PR 6 pattern this rule exists
+    to catch.
+    """
+
+    rule = "baseexception-swallow"
+    prevents = ("PR 6: a broad handler in the unwind path would eat "
+                "PreemptionInterrupt and re-run completed train steps")
+
+    #: Callee names whose arguments are considered CONSUMED, not
+    #: forwarded: a reference that only feeds these is still a swallow.
+    _LOG_CALLEES = frozenset(
+        {"debug", "info", "warning", "warn", "error", "exception",
+         "critical", "log", "print"})
+
+    def check(self, rel_path: str, tree: ast.AST,
+              lines: list[str]) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not self._is_broad(node.type):
+                continue
+            if any(isinstance(n, ast.Raise) for n in ast.walk(node)):
+                continue
+            if node.name and self._channels(node):
+                continue
+            what = ("bare except:" if node.type is None
+                    else "except BaseException")
+            findings.append(self.finding(
+                rel_path, node,
+                f"{what} without re-raise swallows PreemptionInterrupt/"
+                "CancelledError — narrow to Exception, or re-raise "
+                "BaseExceptions before handling", lines))
+        return findings
+
+    def _channels(self, handler: ast.ExceptHandler) -> bool:
+        """True when the bound exception is referenced OUTSIDE logging
+        calls — forwarded to a queue/future/variable a consumer will
+        re-raise, rather than printed and dropped."""
+        logged_refs: set[int] = set()
+        all_refs: list[ast.Name] = []
+        for stmt in handler.body:
+            for n in ast.walk(stmt):
+                if (isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr in self._LOG_CALLEES) or (
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Name)
+                        and n.func.id in self._LOG_CALLEES):
+                    for arg in ast.walk(n):
+                        if isinstance(arg, ast.Name) \
+                                and arg.id == handler.name:
+                            logged_refs.add(id(arg))
+                elif isinstance(n, ast.Name) and n.id == handler.name:
+                    all_refs.append(n)
+        return any(id(ref) not in logged_refs for ref in all_refs)
+
+    @staticmethod
+    def _is_broad(type_node: ast.AST | None) -> bool:
+        if type_node is None:
+            return True
+        nodes = (type_node.elts if isinstance(type_node, ast.Tuple)
+                 else [type_node])
+        return any(_terminal_name(n) == "BaseException" for n in nodes)
+
+
+class ResponseTruthinessChecker(Checker):
+    """Truth-testing an aiohttp ``web.Response``: an unprepared response
+    defines ``__len__`` via its body and is FALSY, so ``resp or
+    fallback`` / ``if resp:`` silently drops a typed reply.  Compare
+    against ``None`` explicitly."""
+
+    rule = "response-truthiness"
+    prevents = ("PR 7 third review round: `resp or fallback` replaced "
+                "a typed 429 (empty body => falsy Response) with a 500")
+    scope = ("ant_ray_tpu/serve/", "ant_ray_tpu/_private/dashboard.py")
+
+    _RESPONSE_CALL_RE = re.compile(
+        r"(Response$)|(^json_response$)|(_response$)")
+
+    def check(self, rel_path: str, tree: ast.AST,
+              lines: list[str]) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._check_function(rel_path, node,
+                                                     lines))
+        return findings
+
+    def _response_names(self, fn: ast.AST) -> set[str]:
+        names: set[str] = set()
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            callee = _terminal_name(node.value.func)
+            if not self._RESPONSE_CALL_RE.search(callee):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    names.add(target.id)
+        return names
+
+    def _check_function(self, rel_path: str, fn: ast.AST,
+                        lines: list[str]) -> Iterable[Finding]:
+        names = self._response_names(fn)
+        if not names:
+            return []
+
+        def bad(expr: ast.AST) -> bool:
+            return isinstance(expr, ast.Name) and expr.id in names
+
+        findings = []
+
+        def flag(expr: ast.AST, how: str):
+            findings.append(self.finding(
+                rel_path, expr,
+                f"truth-testing Response-bound name "
+                f"'{expr.id}' ({how}) — an unprepared web.Response "  # type: ignore[attr-defined]
+                "with an empty body is FALSY; compare `is None` "
+                "instead", lines))
+
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)) \
+                    and bad(node.test):
+                flag(node.test, "if/while test")
+            elif isinstance(node, ast.BoolOp):
+                for value in node.values:
+                    if bad(value):
+                        flag(value, "and/or chain")
+            elif isinstance(node, ast.UnaryOp) \
+                    and isinstance(node.op, ast.Not) and bad(node.operand):
+                flag(node.operand, "not <resp>")
+            elif isinstance(node, ast.Assert) and bad(node.test):
+                flag(node.test, "assert")
+        return findings
+
+
+# ---------------------------------------------------- wire-schema drift
+
+def snapshot_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "wire_methods.json")
+
+
+def load_snapshot(path: str | None = None) -> dict:
+    try:
+        with open(path or snapshot_path()) as f:
+            return json.load(f).get("methods", {})
+    except (OSError, ValueError):
+        return {}
+
+
+def save_snapshot(path: str | None = None) -> None:
+    from ant_ray_tpu._private import wire_schema  # noqa: PLC0415
+
+    methods = {name: entry["since"]
+               for name, entry in sorted(wire_schema.METHODS.items())}
+    with open(path or snapshot_path(), "w") as f:
+        json.dump({"comment": "additive-only METHODS snapshot — a "
+                              "removed/renamed RPC or a changed `since` "
+                              "fails wire-schema-drift; record additions "
+                              "with --baseline-update",
+                   "methods": methods}, f, indent=1)
+        f.write("\n")
+
+
+class WireSchemaDriftChecker(ProjectChecker):
+    """The PR 8 one-off lint generalized: the wire-schema registry, the
+    tracing plane table, and the committed snapshot must agree.
+
+    * every METHODS entry well-formed (service/payload/reply non-empty,
+      ``since`` <= PROTOCOL_VERSION);
+    * METHODS ≡ RPC_METHOD_PLANES, both directions (an RPC cannot ship
+      without deciding its latency-aggregation plane);
+    * additive-only vs the committed snapshot: a method present in the
+      snapshot but gone from METHODS (rename/removal), or whose
+      ``since`` changed, fails loudly — mixed-version peers would
+      mis-route; genuinely new methods are recorded with
+      ``--baseline-update``.
+    """
+
+    rule = "wire-schema-drift"
+    prevents = ("PR 8's one-off test generalized: an RPC renamed or "
+                "shipped without a latency plane breaks mixed-version "
+                "peers / ships untraced")
+
+    _SCHEMA_PATH = "ant_ray_tpu/_private/wire_schema.py"
+
+    def __init__(self, methods: dict | None = None,
+                 planes: dict | None = None,
+                 snapshot: dict | None = None,
+                 protocol_version: int | None = None):
+        # Injectable for fixture tests; None = the real registries.
+        self._methods = methods
+        self._planes = planes
+        self._snapshot = snapshot
+        self._protocol_version = protocol_version
+
+    def _load(self):
+        from ant_ray_tpu._private import protocol, wire_schema  # noqa: PLC0415
+        from ant_ray_tpu.observability.tracing_plane import (  # noqa: PLC0415
+            RPC_METHOD_PLANES)
+
+        methods = (self._methods if self._methods is not None
+                   else wire_schema.METHODS)
+        planes = (self._planes if self._planes is not None
+                  else RPC_METHOD_PLANES)
+        snapshot = (self._snapshot if self._snapshot is not None
+                    else load_snapshot())
+        version = (self._protocol_version
+                   if self._protocol_version is not None
+                   else protocol.PROTOCOL_VERSION)
+        return methods, planes, snapshot, version
+
+    def _line_of(self, package_root: str, method: str) -> int:
+        try:
+            path = os.path.join(os.path.dirname(package_root),
+                                self._SCHEMA_PATH)
+            with open(path) as f:
+                for i, line in enumerate(f, 1):
+                    if f'"{method}"' in line:
+                        return i
+        except OSError:
+            pass
+        return 1
+
+    def check_project(self, package_root: str) -> Iterable[Finding]:
+        methods, planes, snapshot, version = self._load()
+        findings: list[Finding] = []
+
+        def finding(message: str, method: str = "") -> None:
+            line = self._line_of(package_root, method) if method else 1
+            findings.append(Finding(self.rule, self._SCHEMA_PATH, line,
+                                    message, text=method))
+
+        for name, entry in methods.items():
+            if not (isinstance(entry, dict) and entry.get("service")
+                    and entry.get("payload") and entry.get("reply")
+                    and isinstance(entry.get("since"), int)):
+                finding(f"METHODS[{name!r}] malformed: needs non-empty "
+                        "service/payload/reply and an int `since`", name)
+            elif entry["since"] > version:
+                finding(f"METHODS[{name!r}].since={entry['since']} is "
+                        f"ahead of PROTOCOL_VERSION={version}", name)
+
+        for name in sorted(set(methods) - set(planes)):
+            finding(f"{name!r} has no RPC_METHOD_PLANES entry — it "
+                    "would ship untraced; decide its latency plane in "
+                    "observability/tracing_plane.py", name)
+        for name in sorted(set(planes) - set(methods)):
+            finding(f"RPC_METHOD_PLANES names {name!r}, absent from "
+                    "wire_schema.METHODS — stale table entry", name)
+        for name, plane in planes.items():
+            if not (isinstance(plane, str) and plane):
+                finding(f"RPC_METHOD_PLANES[{name!r}] must be a "
+                        "non-empty plane label", name)
+
+        for name, since in sorted(snapshot.items()):
+            if name not in methods:
+                finding(f"{name!r} is in the committed wire snapshot "
+                        "but gone from METHODS — removing/renaming an "
+                        "RPC breaks mixed-version peers; bump "
+                        "PROTOCOL_VERSION and refresh the snapshot "
+                        "with --baseline-update", name)
+            elif methods[name].get("since") != since:
+                finding(f"{name!r} changed since={since} -> "
+                        f"{methods[name].get('since')} — a contract "
+                        "change needs a PROTOCOL_VERSION bump and a "
+                        "snapshot refresh", name)
+        for name in sorted(set(methods) - set(snapshot)):
+            finding(f"new RPC {name!r} is not in the committed wire "
+                    "snapshot — record it with --baseline-update "
+                    "(additive evolution is fine; the snapshot is what "
+                    "makes removals loud)", name)
+        return findings
+
+
+FILE_CHECKERS: list[Checker] = [
+    BlockingUnderLockChecker(),
+    BlockingInAsyncChecker(),
+    BannedApisChecker(),
+    BaseExceptionSwallowChecker(),
+    ResponseTruthinessChecker(),
+]
+
+PROJECT_CHECKERS: list[ProjectChecker] = [
+    WireSchemaDriftChecker(),
+]
+
+ALL_CHECKERS = [*FILE_CHECKERS, *PROJECT_CHECKERS]

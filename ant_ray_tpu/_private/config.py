@@ -268,6 +268,20 @@ class Config:
     # are pending (age-flushed at 1s regardless).
     trace_publish_batch: int = 128
 
+    # ---- lockcheck (_lint/lockcheck.py) ----
+    # Opt-in runtime lock-order detector for the daemon planes: the
+    # make_lock/make_rlock factories return instrumented wrappers that
+    # record the per-process lock-acquisition graph, report cycles
+    # (lock-order inversion = potential deadlock) and budget-exceeding
+    # holds across known-blocking calls through the flight recorder.
+    # Off (default) the factories return plain threading locks — zero
+    # overhead.  Env channel: ART_LOCKCHECK=1 (inherited by spawned
+    # daemons, so one env var arms a whole local cluster).
+    lockcheck: bool = False
+    # A lock held longer than this across a note_blocking() call (sync
+    # RPC, socket I/O, subprocess) is reported as a long-hold.
+    lockcheck_hold_budget_s: float = 0.25
+
     # ---- logging ----
     log_level: str = "INFO"
 

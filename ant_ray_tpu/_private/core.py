@@ -221,7 +221,9 @@ class ClusterRuntime(CoreRuntime):
 
         self._driver_task_id = TaskID.for_driver_task(job_id)
         self._put_index = 0
-        self._put_lock = threading.Lock()
+        from ant_ray_tpu._lint.lockcheck import make_lock, make_rlock  # noqa: PLC0415
+
+        self._put_lock = make_lock("core.put_index")
 
         # ---- reference counting state (owner side)
         self._local_refs: dict[ObjectID, int] = {}
@@ -242,7 +244,7 @@ class ClusterRuntime(CoreRuntime):
         # is held) fires ObjectRef.__del__ → _refcount_event on the same
         # thread; a plain Lock self-deadlocks there.  The nested calls
         # only do per-key dict ops, which compose safely.
-        self._ref_lock = threading.RLock()
+        self._ref_lock = make_rlock("core.refcount")
         set_refcount_hook(self._refcount_event)
 
         # ---- function/class export
@@ -271,7 +273,7 @@ class ClusterRuntime(CoreRuntime):
         self._live_pins = weakref.WeakSet()
         self._pin_renewer_started = False
         self._blocked_depth = 0
-        self._blocked_lock = threading.Lock()
+        self._blocked_lock = make_lock("core.blocked_depth")
         self._shutdown = False
         # Long-poll subscription to GCS pubsub channels: actor deaths
         # arrive as pushes, so idle processes make ~0 RPCs/s and failure

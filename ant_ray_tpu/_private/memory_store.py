@@ -14,8 +14,8 @@ asynchronously (io-loop handlers serving borrower GetObject RPCs).
 from __future__ import annotations
 
 import asyncio
-import threading
 
+from ant_ray_tpu._lint.lockcheck import make_rlock
 from ant_ray_tpu._private.ids import ObjectID
 
 
@@ -34,7 +34,7 @@ class MemoryStore:
         # the io loop there (observed via create_future inside
         # wait_async; the same class of bug as the reference-counter
         # RLock in core.py).
-        self._lock = threading.RLock()
+        self._lock = make_rlock("memory_store")
 
     def mark_pending(self, object_id: ObjectID) -> None:
         with self._lock:

@@ -180,9 +180,9 @@ class NodeManager:
         self._chunk_cache_bytes = 0
         # Guards the chunk cache: served from the io loop (RPC chunk
         # reads) AND from bulk-transfer handler threads.
-        import threading as _threading  # noqa: PLC0415
+        from ant_ray_tpu._lint.lockcheck import make_lock  # noqa: PLC0415
 
-        self._chunk_cache_lock = _threading.Lock()
+        self._chunk_cache_lock = make_lock("daemon.chunk_cache")
         # Pull admission quota: bytes of in-flight inbound transfers
         # (ref: pull_manager.h:50 num_bytes_being_pulled quota) — callers
         # queue instead of pulling a dataset larger than memory at once.
@@ -206,7 +206,7 @@ class NodeManager:
         # but keeps serving its current work until it actually exits.
         self._draining = False
         self._drain_reason = ""
-        self._drain_deadline = 0.0
+        self._drain_deadline_ts = 0.0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -343,7 +343,7 @@ class NodeManager:
             labels=dict(self._labels),
             draining=self._draining,
             drain_reason=self._drain_reason,
-            drain_deadline=self._drain_deadline,
+            drain_deadline=self._drain_deadline_ts,
         )
 
     async def _register(self):
@@ -478,7 +478,9 @@ class NodeManager:
             deadline_s = cfg.drain_deadline_s
         self._draining = True
         self._drain_reason = reason or "drain requested"
-        self._drain_deadline = time.time() + deadline_s
+        # Wall clock BY DESIGN: the deadline crosses processes in the
+        # DrainNode payload / NodeInfo.DrainDeadline (specs.py).
+        self._drain_deadline_ts = time.time() + deadline_s
         self._sync_wakeup.set()      # propagate via the next heartbeat
         logger.warning("node %s draining (%s; deadline in %.0fs)",
                        self.node_id.hex()[:8], self._drain_reason,
@@ -488,7 +490,7 @@ class NodeManager:
             gcs = self._clients.get(self._gcs_address)
             payload = {"node_id": self.node_id,
                        "reason": self._drain_reason,
-                       "deadline": self._drain_deadline}
+                       "deadline": self._drain_deadline_ts}
             for attempt in range(10):  # outlasts a head restart
                 try:
                     await gcs.call_async("DrainNode", payload, timeout=10)
@@ -679,7 +681,7 @@ class NodeManager:
                     "disk_full": self._disk_full,
                     "draining": self._draining,
                     "drain_reason": self._drain_reason,
-                    "drain_deadline": self._drain_deadline,
+                    "drain_deadline": self._drain_deadline_ts,
                     "version": version,
                 }
             try:
@@ -2435,7 +2437,10 @@ class NodeManager:
                 # never touch a recycled arena range.
                 try:
                     await asyncio.gather(*tasks, return_exceptions=True)
-                except BaseException:  # noqa: BLE001 — double cancel
+                except asyncio.CancelledError:
+                    # Double cancel: a second cancellation landing while
+                    # we reap the pumps must not mask the original
+                    # failure re-raised below.
                     pass
             raise
 

@@ -20,11 +20,11 @@ from __future__ import annotations
 import logging
 import mmap
 import os
-import threading
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
+from ant_ray_tpu._lint.lockcheck import make_lock, make_rlock
 from ant_ray_tpu._private.config import global_config
 from ant_ray_tpu._private.ids import ObjectID
 from ant_ray_tpu.exceptions import ObjectLostError
@@ -90,7 +90,7 @@ class ObjectStore:
         # A list, not a dict: the same object id can be doomed more than
         # once (delete → re-create → delete again, each under pins).
         self._doomed: list[ObjectEntry] = []
-        self._lock = threading.RLock()
+        self._lock = make_rlock("object_store.arena")
         self._arena = None
         if use_arena:
             from ant_ray_tpu._private.native import load_native  # noqa: PLC0415
@@ -548,7 +548,7 @@ class ArenaClient:
 
     def __init__(self):
         self._maps: dict[str, memoryview] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("object_store.mmap_pool")
 
     def _mapping(self, path: str) -> memoryview:
         with self._lock:

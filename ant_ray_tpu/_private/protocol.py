@@ -685,6 +685,12 @@ class RpcClient:
     def call(self, method: str, payload: Any = None,
              timeout: float | None = None, retries: int = 0) -> Any:
         """Blocking call from any non-io thread, with connection retries."""
+        from ant_ray_tpu._lint.lockcheck import note_blocking  # noqa: PLC0415
+
+        # Runtime evidence for the static blocking-under-lock rule: if
+        # the calling thread holds an instrumented lock across this
+        # round trip, lockcheck reports the hold with its stack.
+        note_blocking(f"RpcClient.call:{method}")
         attempt = 0
         while True:
             try:
@@ -710,7 +716,9 @@ class ClientPool:
 
     def __init__(self):
         self._clients: dict[str, RpcClient] = {}
-        self._lock = threading.Lock()
+        from ant_ray_tpu._lint.lockcheck import make_lock  # noqa: PLC0415
+
+        self._lock = make_lock("rpc.client_pool")
 
     def get(self, address: str) -> RpcClient:
         with self._lock:

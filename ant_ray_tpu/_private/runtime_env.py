@@ -519,6 +519,10 @@ def ensure_env_ready(wire: dict, session_dir: str) -> None:
             exe = _conda_exe()
             name = conda_env_name(conda)
             with _disk_build_lock(session_dir, f"conda_{name}"):
+                # artlint: disable=blocking-under-lock — serializing
+                # the conda build across processes IS the disk lock's
+                # purpose; this runs on the daemon's env executor
+                # thread, never on the event loop.
                 probe = subprocess.run(
                     [exe, "env", "list"], capture_output=True, text=True,
                     timeout=120)
@@ -535,6 +539,8 @@ def ensure_env_ready(wire: dict, session_dir: str) -> None:
 
                     with open(spec_path, "w") as f:
                         _yaml.safe_dump(spec, f)
+                    # artlint: disable=blocking-under-lock — same
+                    # deliberate build serialization as the probe above.
                     proc = subprocess.run(
                         [exe, "env", "create", "-f", spec_path],
                         capture_output=True, text=True, timeout=1800)

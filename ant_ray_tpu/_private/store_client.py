@@ -15,7 +15,6 @@ from __future__ import annotations
 import logging
 import os
 import sqlite3
-import threading
 
 
 class StoreClient:
@@ -67,7 +66,9 @@ class SqliteStoreClient(StoreClient):
         self.path = path
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._conn = sqlite3.connect(path, check_same_thread=False)
-        self._lock = threading.Lock()
+        from ant_ray_tpu._lint.lockcheck import make_lock  # noqa: PLC0415
+
+        self._lock = make_lock("store_client.sqlite")
         with self._lock:
             self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.execute("PRAGMA synchronous=NORMAL")

@@ -37,7 +37,9 @@ _NODE_ID = os.environ.get("ART_NODE_ID", "")
 class TaskEventBuffer:
     def __init__(self):
         self._events: list[dict] = []
-        self._lock = threading.Lock()
+        from ant_ray_tpu._lint.lockcheck import make_lock  # noqa: PLC0415
+
+        self._lock = make_lock("task_events.buffer")
         self._last_flush = time.monotonic()
         self._registered = False
         self._flusher: threading.Thread | None = None

@@ -148,6 +148,9 @@ class BulkServer:
 
     def _serve_chunk(self, conn, object_id: ObjectID, offset: int,
                      length: int, flags: int) -> None:
+        from ant_ray_tpu._lint.lockcheck import note_blocking  # noqa: PLC0415
+
+        note_blocking("transfer.serve_chunk sendall")
         owner = self._owner
         owner._chunk_read_log.append((object_id.hex(), offset, length))
         delay = global_config().testing_chunk_serve_delay_s
@@ -217,6 +220,9 @@ def pull_chunks(address: tuple, object_id: ObjectID, size: int,
     """
     inflight: list[tuple[int, int]] = []   # (offset, length) issued
     pulled = 0
+    from ant_ray_tpu._lint.lockcheck import note_blocking  # noqa: PLC0415
+
+    note_blocking("transfer.pull_chunks socket I/O")
     sock = socket.create_connection(address, timeout=timeout_s)
     try:
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

@@ -15,7 +15,6 @@ from __future__ import annotations
 
 import asyncio
 import inspect
-import threading
 import time
 from typing import Any, Sequence
 
@@ -105,7 +104,9 @@ class LocalModeRuntime(CoreRuntime):
         self._named_actors: dict[tuple[str, str], ActorID] = {}
         self._put_counter = _PutIndexCounter()
         self._driver_task_id = TaskID.for_driver_task(job_id)
-        self._lock = threading.RLock()
+        from ant_ray_tpu._lint.lockcheck import make_rlock  # noqa: PLC0415
+
+        self._lock = make_rlock("worker.state")
 
     # ---- helpers
 
@@ -277,7 +278,9 @@ class Worker:
         self.runtime: CoreRuntime | None = None
         self.job_id: JobID | None = None
         self.current_actor_id: ActorID | None = None
-        self._lock = threading.Lock()
+        from ant_ray_tpu._lint.lockcheck import make_lock  # noqa: PLC0415
+
+        self._lock = make_lock("worker.connect")
 
     @property
     def connected(self) -> bool:

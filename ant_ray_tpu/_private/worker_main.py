@@ -58,7 +58,9 @@ class TaskExecutor:
         # (CancelTask RPC); checked at both dequeue points so a task
         # parked in the pool's backlog is dropped, not run.
         self._cancelled: "dict[bytes, bool]" = {}
-        self._cancel_lock = threading.Lock()
+        from ant_ray_tpu._lint.lockcheck import make_lock  # noqa: PLC0415
+
+        self._cancel_lock = make_lock("worker.cancelled_ids")
         self.actor_instance = None
         self.actor_spec: ActorSpec | None = None
         self._async_loop: asyncio.AbstractEventLoop | None = None

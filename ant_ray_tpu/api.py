@@ -67,6 +67,12 @@ def init(
     if object_store_memory:
         config.object_store_memory = object_store_memory
     set_global_config(config)
+    # The worker singleton's import-time factory calls cached a
+    # pre-init lockcheck verdict; re-evaluate now that _system_config
+    # is applied (daemons get theirs via the env export above).
+    from ant_ray_tpu._lint import lockcheck  # noqa: PLC0415
+
+    lockcheck.refresh_enabled()
 
     job_id = JobID.from_random()
     global_worker.job_id = job_id

@@ -303,6 +303,10 @@ class CollectiveTransport(TensorTransport):
                 for shard in layout["shards"]:
                     buf = np.zeros(shard["shape"],
                                    dtype=_np_dtype(shard["dtype"]))
+                    # artlint: disable=blocking-under-lock — the pair
+                    # lock SERIALIZES this send/recv rendezvous by
+                    # design (PR 2 satellite): it is per-(group, src),
+                    # and the watchdog below bounds the park.
                     out.append(col.recv(buf, src, group))
                 return out
 
@@ -316,6 +320,9 @@ class CollectiveTransport(TensorTransport):
             pool = cf.ThreadPoolExecutor(max_workers=1)
             fut = pool.submit(_recv_all)
             try:
+                # artlint: disable=blocking-under-lock — bounded wait
+                # by the recv watchdog deadline; the pair lock must
+                # stay held until the collective pair is quiesced.
                 host_shards = fut.result(deadline)
             except cf.TimeoutError:
                 _poisoned_pairs.add((group, src))

@@ -153,6 +153,9 @@ class FileBasedLeaderSelector(_LeaseSelectorBase):
         if lease is not None:
             if lease.get("token") == self._token:
                 return True
+            # artlint: disable=banned-apis — renewed_at is a CROSS-
+            # PROCESS wire field (the lease file is read by every
+            # contender); wall clock is the only clock they share.
             if time.time() - lease.get("renewed_at", 0) < self._ttl:
                 return False
         # Expired (or absent) — take the acquisition mutex so exactly
@@ -162,6 +165,8 @@ class FileBasedLeaderSelector(_LeaseSelectorBase):
             os.mkdir(mutex)
         except FileExistsError:
             try:
+                # artlint: disable=banned-apis — compared against a
+                # file mtime, which is wall clock by definition.
                 if time.time() - os.path.getmtime(mutex) > self._ttl:
                     os.rmdir(mutex)  # crashed contender's debris
             except OSError:
@@ -169,6 +174,8 @@ class FileBasedLeaderSelector(_LeaseSelectorBase):
             return False
         try:
             lease = self._read_lease()  # re-check under the mutex
+            # artlint: disable=banned-apis — renewed_at: cross-process
+            # lease-file field, wall clock by design (see above).
             if lease is not None and lease.get("token") != self._token \
                     and time.time() - lease.get("renewed_at", 0) < \
                     self._ttl:

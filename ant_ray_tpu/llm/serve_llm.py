@@ -74,8 +74,8 @@ class LLMServer:
         from ant_ray_tpu.exceptions import DeadlineExceededError  # noqa: PLC0415
         from ant_ray_tpu.serve.api import get_request_deadline  # noqa: PLC0415
 
-        deadline = get_request_deadline()
-        if deadline is not None and time.time() >= deadline:
+        deadline_ts = get_request_deadline()  # wall-clock wire field
+        if deadline_ts is not None and time.time() >= deadline_ts:
             raise DeadlineExceededError(
                 f"request deadline expired before {where} — shed, "
                 "not executed")

@@ -380,6 +380,9 @@ class _Span:
                  and not issubclass(exc_type, GeneratorExit))
         if ctx.sampled or error:
             dur = time.perf_counter() - self._t0
+            # artlint: disable=banned-apis — span `ts` is a cross-
+            # process wire field: wall clock is what lets spans from
+            # different hosts land on one timeline.
             self.span_id = record_span(
                 ctx, self._name, ts=time.time() - dur, dur_s=dur,
                 attrs=self._attrs, error=error)

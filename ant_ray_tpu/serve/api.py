@@ -408,12 +408,14 @@ def batch(_fn=None, *, max_batch_size: int = 8,
                 with cv:
                     while len(state["items"]) < max_batch_size:
                         remaining = wait_deadline - time.monotonic()
-                        req_dls = [s["deadline_ts"]
-                                   for _, s in state["items"]
-                                   if s["deadline_ts"] is not None]
-                        if req_dls:
+                        req_deadline_ts = [
+                            s["deadline_ts"] for _, s in state["items"]
+                            if s["deadline_ts"] is not None]
+                        if req_deadline_ts:
+                            # Wall clock: deadline_ts is the wire field.
                             remaining = min(
-                                remaining, min(req_dls) - time.time()
+                                remaining,
+                                min(req_deadline_ts) - time.time()
                                 - _BATCH_FLUSH_MARGIN_S)
                         if remaining <= 0:
                             break
@@ -1059,13 +1061,15 @@ class DeploymentHandle:
         rcfg = self._routing.config.get("retry")
         timeout = (timeout_s if timeout_s is not None
                    else self._routing.default_timeout())
-        deadline = (time.time() + float(timeout)
-                    if timeout is not None else None)
+        # Wall clock BY DESIGN: this becomes the request's cross-process
+        # deadline_ts wire field, the one clock every host shares.
+        deadline_ts = (time.time() + float(timeout)
+                       if timeout is not None else None)
         attempts = rcfg.max_attempts if rcfg is not None else 1
         exclude: set = set()
         last_exc: Exception | None = None
         for attempt in range(max(1, attempts)):
-            if deadline is not None and time.time() >= deadline:
+            if deadline_ts is not None and time.time() >= deadline_ts:
                 raise last_exc or DeadlineExceededError(
                     f"deadline expired before dispatch to {self._name}")
             try:
@@ -1078,13 +1082,13 @@ class DeploymentHandle:
                     raise last_exc from None
                 raise
             meta: dict = {"trace": route_ctx.to_wire()}
-            if deadline is not None:
-                meta["deadline_ts"] = deadline
+            if deadline_ts is not None:
+                meta["deadline_ts"] = deadline_ts
             ref = self._dispatch(replica, args, kwargs,
                                  self._mux_model_id, meta)
             try:
-                remaining = (None if deadline is None
-                             else max(0.0, deadline - time.time()))
+                remaining = (None if deadline_ts is None
+                             else max(0.0, deadline_ts - time.time()))
                 result = art.get(ref, timeout=remaining)
             except GetTimeoutError:
                 # The deadline fired while the call was queued or

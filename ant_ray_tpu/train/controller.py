@@ -148,7 +148,7 @@ class TrainController:
         # gang got a preemption notice; report() acks carry it to every
         # rank, whose next report becomes the zero-step-loss exit.
         self._drain_stop = False
-        self._drain_deadline = 0.0
+        self._drain_deadline_ts = 0.0
         # Async checkpoint plane: one background save thread (order-
         # preserving) + in-flight save futures the restart/result paths
         # flush before reading `latest`.
@@ -449,7 +449,7 @@ class TrainController:
         scaling = self._scaling
         world = world if world is not None else scaling.num_workers
         self._drain_stop = False      # fresh gang, fresh drain state
-        self._drain_deadline = 0.0
+        self._drain_deadline_ts = 0.0
         pg, slice_pg = self._reserve_gang(scaling, world)
         self._worker_pg = pg          # set BEFORE anything can fail, so
         self._worker_slice = slice_pg  # the finally always releases it
@@ -525,7 +525,7 @@ class TrainController:
                 if done and art.get(done[0]) == _PREEMPTED:
                     interrupted = True
                 if self._drain_stop and pending and \
-                        time.time() >= self._drain_deadline:
+                        time.time() >= self._drain_deadline_ts:
                     logger.warning(
                         "drain deadline passed with %d rank(s) still "
                         "running; abandoning them (progress is "
@@ -585,13 +585,13 @@ class TrainController:
                     return
                 # No announced deadline -> a generous local one: the
                 # stop order still reaches ranks at their next report.
-                self._drain_deadline = deadline or (time.time() + 30.0)
+                self._drain_deadline_ts = deadline or (time.time() + 30.0)
                 self._drain_stop = True
                 logger.warning(
                     "drain notice on node(s) hosting %d gang worker(s); "
                     "ordering proactive checkpoint + migration "
                     "(deadline in %.0fs)", len(hit),
-                    self._drain_deadline - time.time())
+                    self._drain_deadline_ts - time.time())
                 return
             except Exception as e:  # noqa: BLE001 — monitoring only
                 logger.debug("drain watch poll failed: %s", e)

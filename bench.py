@@ -159,6 +159,22 @@ def _collective_fusion_ratio() -> float:
 
 
 _PROFILER_BUDGET_NS = 2000.0   # 2 µs/step — observability stays free
+_LINT_BUDGET_S = 10.0          # artlint full pass over the package
+
+
+def _lint_full_pass_s() -> float:
+    """Wall time of one full artlint pass (every checker, whole
+    package, project checkers included) — the pre-commit tax the lint
+    plane charges, budgeted so it stays an always-run habit."""
+    from ant_ray_tpu._lint import run_lint
+
+    t0 = time.perf_counter()
+    result = run_lint()
+    elapsed = time.perf_counter() - t0
+    if result.files_checked < 50:
+        raise RuntimeError(
+            f"lint pass saw only {result.files_checked} files")
+    return elapsed
 
 # ---------------------------------------------------------------------------
 # Regression guard: compare a run's metrics against the committed control
@@ -196,6 +212,10 @@ _GUARDED_METRICS = {
     # number ROADMAP item 2's fast-path work decomposes against.
     "trace_overhead_unsampled_ns": "lower",
     "rpc_p99_actor_call_us": "lower",
+    # Static-analysis plane (PR 10): a full artlint pass over the
+    # package.  Guarded "lower" with a hard 10s budget in run_child —
+    # a lint too slow to run every commit stops being run at all.
+    "lint_full_pass_s": "lower",
 }
 
 
@@ -325,6 +345,15 @@ def run_child() -> None:
                 f"{_PROFILER_BUDGET_NS}ns budget")
     except Exception as e:  # noqa: BLE001
         result["step_profiler_overhead_error"] = repr(e)[:120]
+    try:
+        lint_s = round(_lint_full_pass_s(), 3)
+        result["lint_full_pass_s"] = lint_s
+        if lint_s > _LINT_BUDGET_S:
+            result["bench_error"] = (
+                f"lint_full_pass_s={lint_s} exceeds "
+                f"{_LINT_BUDGET_S:.0f}s budget")
+    except Exception as e:  # noqa: BLE001
+        result["lint_full_pass_error"] = repr(e)[:120]
     try:
         regressions = check_regression(
             {k: v for k, v in result.items()

@@ -1,0 +1,716 @@
+"""artlint (ant_ray_tpu/_lint): every checker must fire on its
+known-bad fixture and stay silent on the minimal fix; suppressions and
+the shrink-only baseline must round-trip; the package itself must lint
+clean (this is the tier-1 wiring the ISSUE calls "lands at zero debt");
+and the runtime lockcheck must detect a seeded A→B / B→A inversion
+while adding nothing when disabled."""
+
+import ast
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from ant_ray_tpu._lint import checkers as C
+from ant_ray_tpu._lint import framework as F
+from ant_ray_tpu._lint import lockcheck
+
+
+def lint_src(source: str, checker, rel: str = "ant_ray_tpu/_private/x.py"):
+    """Run ONE checker over a source snippet, applying suppressions the
+    way the driver does (scope is the caller's job via ``rel``)."""
+    source = textwrap.dedent(source)
+    tree = ast.parse(source)
+    lines = source.splitlines()
+    assert checker.applies_to(rel), f"{rel} outside {checker.scope}"
+    return [f for f in checker.check(rel, tree, lines)
+            if not F.is_suppressed(f, lines)]
+
+
+# ------------------------------------------------- blocking-under-lock
+
+BAD_UNDER_LOCK = """
+    import time
+
+    def grant(self):
+        with self._lock:
+            time.sleep(0.5)
+"""
+
+FIXED_UNDER_LOCK = """
+    import time
+
+    def grant(self):
+        with self._lock:
+            snapshot = dict(self._state)
+        time.sleep(0.5)
+"""
+
+
+def test_blocking_under_lock_fires_and_fix_silences():
+    bad = lint_src(BAD_UNDER_LOCK, C.BlockingUnderLockChecker())
+    assert len(bad) == 1 and bad[0].rule == "blocking-under-lock"
+    assert "time.sleep" in bad[0].message
+    assert not lint_src(FIXED_UNDER_LOCK, C.BlockingUnderLockChecker())
+
+
+def test_blocking_under_lock_catches_rpc_and_socket_and_result():
+    src = """
+        def f(self, client, sock, fut):
+            with self._pair_lock:
+                client.call("LeaseWorker", {})
+                sock.sendall(b"x")
+                fut.result()
+    """
+    rules = lint_src(src, C.BlockingUnderLockChecker())
+    assert len(rules) == 3
+    assert {"round trip" in f.message or "wire" in f.message
+            or "parks" in f.message for f in rules} == {True}
+
+
+def test_blocking_under_lock_scoped_to_concurrent_planes():
+    checker = C.BlockingUnderLockChecker()
+    assert checker.applies_to("ant_ray_tpu/_private/node_daemon.py")
+    assert checker.applies_to("ant_ray_tpu/util/collective/fusion.py")
+    assert not checker.applies_to("ant_ray_tpu/train/controller.py")
+
+
+# --------------------------------------------------- blocking-in-async
+
+def test_blocking_in_async_fires_and_async_sleep_is_fine():
+    bad = lint_src("""
+        import time
+
+        async def handler(self):
+            time.sleep(0.1)
+    """, C.BlockingInAsyncChecker())
+    assert len(bad) == 1 and bad[0].rule == "blocking-in-async"
+    assert not lint_src("""
+        import asyncio
+
+        async def handler(self):
+            await asyncio.sleep(0.1)
+    """, C.BlockingInAsyncChecker())
+
+
+def test_blocking_in_async_exempts_nested_sync_defs():
+    # A nested sync def runs where it is CALLED (executor thread),
+    # not on the loop — the pattern every run_in_executor body uses.
+    assert not lint_src("""
+        import time
+
+        async def handler(self, loop):
+            def work():
+                time.sleep(0.1)
+            await loop.run_in_executor(None, work)
+    """, C.BlockingInAsyncChecker())
+
+
+# --------------------------------------------------------- banned-apis
+
+def test_banned_iscoroutine_fires_and_inspect_is_fine():
+    bad = lint_src("""
+        import asyncio
+
+        def classify(obj):
+            return asyncio.iscoroutine(obj)
+    """, C.BannedApisChecker())
+    assert len(bad) == 1 and "inspect.iscoroutine" in bad[0].message
+    assert not lint_src("""
+        import inspect
+
+        def classify(obj):
+            return inspect.iscoroutine(obj)
+    """, C.BannedApisChecker())
+
+
+def test_banned_time_time_arithmetic_fires_and_monotonic_is_fine():
+    bad = lint_src("""
+        import time
+
+        def wait(self):
+            deadline = time.time() + 5.0
+            while time.time() < deadline:
+                pass
+    """, C.BannedApisChecker())
+    assert len(bad) == 2
+    assert all("monotonic" in f.message for f in bad)
+    assert not lint_src("""
+        import time
+
+        def wait(self):
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                pass
+    """, C.BannedApisChecker())
+
+
+def test_banned_time_time_allowlists_wire_deadline_fields():
+    # deadline_ts is the cross-process wire-deadline convention: wall
+    # clock is the only clock two hosts share, so it is sanctioned.
+    assert not lint_src("""
+        import time
+
+        def stamp(self, meta, timeout):
+            meta["deadline_ts"] = time.time() + timeout
+    """, C.BannedApisChecker())
+
+
+def test_banned_time_time_anchors_multiline_statement():
+    # The finding anchors on the STATEMENT, so a rationale comment
+    # above a multi-line expression suppresses it.
+    src = """
+        import time
+
+        def f(self, dur):
+            # artlint: disable=banned-apis — span ts is a wire field
+            record(
+                ts=time.time() - dur)
+    """
+    assert not lint_src(src, C.BannedApisChecker())
+    stripped = src.replace(
+        "# artlint: disable=banned-apis — span ts is a wire field", "#")
+    assert len(lint_src(stripped, C.BannedApisChecker())) == 1
+
+
+def test_banned_time_time_compound_header_not_exempted_by_body():
+    # The wire-field allowlist scans only the statement HEADER: an
+    # `if time.time() - t > 60:` is not exempted because its body
+    # happens to mention deadline_ts.
+    bad = lint_src("""
+        import time
+
+        def sweep(self):
+            if time.time() - self._started > 60:
+                self._expire(self.deadline_ts)
+    """, C.BannedApisChecker())
+    assert len(bad) == 1
+
+
+def test_blocking_checkers_anchor_multiline_statements():
+    # A disable comment above a multi-line statement must suppress a
+    # blocking call sitting on a continuation line (the documented
+    # workflow) — findings anchor at the statement, like banned-apis.
+    src = """
+        import subprocess
+
+        def build(self):
+            with self._lock:
+                # artlint: disable=blocking-under-lock — one-time build
+                proc = subprocess.run(
+                    ["make"],
+                    check=True)
+    """
+    assert not lint_src(src, C.BlockingUnderLockChecker())
+    stripped = src.replace(
+        "# artlint: disable=blocking-under-lock — one-time build", "#")
+    found = lint_src(stripped, C.BlockingUnderLockChecker())
+    assert len(found) == 1
+    # ...anchored at the assignment statement, not the call line.
+    assert "proc = subprocess.run(" in found[0].text
+
+
+# ----------------------------------------------- baseexception-swallow
+
+def test_baseexception_swallow_fires_on_bare_and_broad():
+    bad = lint_src("""
+        def f():
+            try:
+                work()
+            except:
+                pass
+
+        def g():
+            try:
+                work()
+            except BaseException:
+                log()
+    """, C.BaseExceptionSwallowChecker())
+    assert len(bad) == 2
+    assert all(f.rule == "baseexception-swallow" for f in bad)
+
+
+def test_baseexception_swallow_fix_and_channeling_are_fine():
+    assert not lint_src("""
+        def f():
+            try:
+                work()
+            except Exception:
+                pass
+
+        def g():
+            try:
+                work()
+            except BaseException:
+                cleanup()
+                raise
+
+        def h(q):
+            try:
+                work()
+            except BaseException as e:   # channeled to the consumer
+                q.put(("error", e))
+    """, C.BaseExceptionSwallowChecker())
+
+
+def test_baseexception_swallow_log_and_continue_still_fires():
+    # Logging the bound name is NOT channeling — `logger.warning(e)`
+    # then falling through is the canonical swallow (the PR 6 class);
+    # only forwarding the value somewhere a consumer re-raises exempts.
+    bad = lint_src("""
+        def f(logger):
+            try:
+                work()
+            except BaseException as e:
+                logger.warning("ignored: %s", e)
+
+        def g():
+            try:
+                work()
+            except BaseException as e:
+                print(e)
+    """, C.BaseExceptionSwallowChecker())
+    assert len(bad) == 2
+
+
+def test_baseexception_swallow_store_then_forward_is_channeling():
+    # fusion.py's staging idiom: bind into a tuple now, q.put it later.
+    assert not lint_src("""
+        def f(q):
+            try:
+                work()
+            except BaseException as e:
+                staged = ("error", e)
+                q.put(staged)
+    """, C.BaseExceptionSwallowChecker())
+
+
+def test_baseexception_swallow_sees_tuple_handlers():
+    bad = lint_src("""
+        def f():
+            try:
+                work()
+            except (ValueError, BaseException):
+                pass
+    """, C.BaseExceptionSwallowChecker())
+    assert len(bad) == 1
+
+
+# ----------------------------------------------- response-truthiness
+
+def test_response_truthiness_fires_in_serve_scope():
+    src = """
+        def dispatch(request):
+            resp = shed_response(429)
+            if resp:
+                return resp
+            return resp or fallback()
+    """
+    bad = lint_src(src, C.ResponseTruthinessChecker(),
+                   rel="ant_ray_tpu/serve/api.py")
+    assert len(bad) == 2
+    assert all("FALSY" in f.message for f in bad)
+
+
+def test_response_truthiness_is_none_is_fine():
+    assert not lint_src("""
+        def dispatch(request):
+            resp = web.Response(status=429)
+            if resp is None:
+                return fallback()
+            return resp
+    """, C.ResponseTruthinessChecker(), rel="ant_ray_tpu/serve/api.py")
+
+
+def test_response_truthiness_scope():
+    checker = C.ResponseTruthinessChecker()
+    assert checker.applies_to("ant_ray_tpu/serve/api.py")
+    assert checker.applies_to("ant_ray_tpu/_private/dashboard.py")
+    assert not checker.applies_to("ant_ray_tpu/_private/node_daemon.py")
+
+
+# ----------------------------------------------------- wire-schema drift
+
+def _drift(methods, planes, snapshot, version=1):
+    checker = C.WireSchemaDriftChecker(
+        methods=methods, planes=planes, snapshot=snapshot,
+        protocol_version=version)
+    return list(checker.check_project(F.package_root()))
+
+
+_GOOD_METHOD = {"service": "gcs", "since": 1, "payload": "{}",
+                "reply": "bool"}
+
+
+def test_wire_drift_clean_when_all_agree():
+    assert not _drift({"Ping": _GOOD_METHOD}, {"Ping": "control"},
+                      {"Ping": 1})
+
+
+def test_wire_drift_method_without_plane_fails():
+    findings = _drift({"Ping": _GOOD_METHOD, "NewRpc": _GOOD_METHOD},
+                      {"Ping": "control"}, {"Ping": 1, "NewRpc": 1})
+    assert any("no RPC_METHOD_PLANES" in f.message for f in findings)
+
+
+def test_wire_drift_stale_plane_fails():
+    findings = _drift({"Ping": _GOOD_METHOD},
+                      {"Ping": "control", "Gone": "control"}, {"Ping": 1})
+    assert any("stale" in f.message for f in findings)
+
+
+def test_wire_drift_removed_method_fails_loudly():
+    findings = _drift({"Ping": _GOOD_METHOD}, {"Ping": "control"},
+                      {"Ping": 1, "RenamedAway": 1})
+    assert any("breaks mixed-version peers" in f.message
+               for f in findings)
+
+
+def test_wire_drift_since_change_and_new_method_fail():
+    changed = _drift({"Ping": dict(_GOOD_METHOD, since=2)},
+                     {"Ping": "control"}, {"Ping": 1}, version=2)
+    assert any("PROTOCOL_VERSION bump" in f.message for f in changed)
+    new = _drift({"Ping": _GOOD_METHOD, "Fresh": _GOOD_METHOD},
+                 {"Ping": "control", "Fresh": "control"}, {"Ping": 1})
+    assert any("--baseline-update" in f.message for f in new)
+
+
+def test_wire_drift_malformed_entry_fails():
+    findings = _drift({"Ping": {"service": "", "since": 1,
+                                "payload": "{}", "reply": "bool"}},
+                      {"Ping": "control"}, {"Ping": 1})
+    assert any("malformed" in f.message for f in findings)
+
+
+def test_wire_snapshot_matches_committed_registry():
+    """The committed snapshot must exactly track wire_schema.METHODS —
+    an addition without --baseline-update (or a removal, period) is
+    caught by the real project checker run in test_package_lints_clean;
+    this pins the file itself so a hand-edit can't drift."""
+    from ant_ray_tpu._private import wire_schema
+
+    snapshot = C.load_snapshot()
+    assert snapshot, "wire_methods.json missing or empty"
+    assert set(snapshot) == set(wire_schema.METHODS)
+    for name, since in snapshot.items():
+        assert wire_schema.METHODS[name]["since"] == since, name
+
+
+# ------------------------------------------------ suppression mechanics
+
+def test_suppression_same_line_and_block_above_and_all():
+    checker = C.BannedApisChecker()
+    assert not lint_src("""
+        import time
+
+        def f(t0):
+            return time.time() - t0  # artlint: disable=banned-apis — x
+    """, checker)
+    assert not lint_src("""
+        import time
+
+        def f(t0):
+            # a rationale that runs
+            # artlint: disable=banned-apis — over several comment
+            # lines still applies to the statement below it.
+            return time.time() - t0
+    """, checker)
+    assert not lint_src("""
+        import time
+
+        def f(t0):
+            # artlint: disable=all — kitchen sink
+            return time.time() - t0
+    """, checker)
+
+
+def test_suppression_for_other_rule_does_not_apply():
+    findings = lint_src("""
+        import time
+
+        def f(t0):
+            # artlint: disable=blocking-under-lock — wrong rule
+            return time.time() - t0
+    """, C.BannedApisChecker())
+    assert len(findings) == 1
+
+
+# --------------------------------------------------- baseline round trip
+
+def test_baseline_round_trip_and_stale_detection(tmp_path):
+    f1 = F.Finding("banned-apis", "ant_ray_tpu/x.py", 10, "msg",
+                   text="deadline = time.time() + 5")
+    f2 = F.Finding("banned-apis", "ant_ray_tpu/y.py", 3, "msg",
+                   text="t = time.time() - t0")
+    path = str(tmp_path / "baseline.json")
+    F.save_baseline([f1, f2], path)
+    entries = F.load_baseline(path)
+    assert len(entries) == 2
+
+    # Same findings -> all grandfathered, nothing new, nothing stale.
+    counter = F._baseline_counter(entries)
+    assert counter[f1.baseline_key()] == 1
+    # f2's line was FIXED: its entry is now stale (shrink-only contract:
+    # the run must demand --baseline-update, not silently keep it).
+    remaining = F._baseline_counter(entries)
+    remaining[f1.baseline_key()] -= 1
+    stale = [k for k, n in remaining.items() if n > 0]
+    assert stale == [f2.baseline_key()]
+
+
+def test_baseline_matching_survives_line_drift(tmp_path):
+    # Baseline keys on (rule, path, text), NOT the line number: an
+    # unrelated edit above the grandfathered site must not un-baseline.
+    entry = {"rule": "banned-apis", "path": "ant_ray_tpu/x.py",
+             "line": 10, "text": "deadline = time.time() + 5"}
+    drifted = F.Finding("banned-apis", "ant_ray_tpu/x.py", 99, "msg",
+                        text="deadline = time.time() + 5")
+    assert F._baseline_counter([entry])[drifted.baseline_key()] == 1
+
+
+# ------------------------------------------------ the package is clean
+
+def test_package_lints_clean_with_shrink_only_baseline():
+    """Tier-1 contract: every checker over the whole package, zero new
+    findings, zero stale baseline entries — and the committed baseline
+    is EMPTY (the PR landed at zero debt; growing it again means
+    editing this assert, which is the review conversation we want)."""
+    result = F.run_lint()
+    assert result.files_checked > 100
+    rendered = "\n".join(f.render() for f in result.findings)
+    assert not result.findings, f"new artlint findings:\n{rendered}"
+    assert not result.stale_baseline, (
+        "baseline entries no longer fire — shrink it with "
+        f"--baseline-update: {result.stale_baseline}")
+    assert F.load_baseline() == [], (
+        "the committed baseline must stay empty; fix or explicitly "
+        "suppress new findings instead of grandfathering them")
+
+
+def test_cli_exits_zero_on_clean_tree_and_one_on_violation(tmp_path):
+    clean = subprocess.run(
+        [sys.executable, "-m", "ant_ray_tpu._lint", "-q"],
+        capture_output=True, text=True, timeout=120)
+    assert clean.returncode == 0, clean.stdout + clean.stderr
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\n\n"
+                   "def f(t0):\n"
+                   "    return time.time() - t0\n")
+    dirty = subprocess.run(
+        [sys.executable, "-m", "ant_ray_tpu._lint", str(bad)],
+        capture_output=True, text=True, timeout=120)
+    assert dirty.returncode == 1
+    assert "banned-apis" in dirty.stdout
+
+
+def test_cli_list_rules_names_every_checker():
+    out = subprocess.run(
+        [sys.executable, "-m", "ant_ray_tpu._lint", "--list-rules"],
+        capture_output=True, text=True, timeout=120).stdout
+    for rule in ("blocking-under-lock", "blocking-in-async",
+                 "banned-apis", "baseexception-swallow",
+                 "response-truthiness", "wire-schema-drift"):
+        assert rule in out, rule
+
+
+# ------------------------------------------------------------ lockcheck
+
+@pytest.fixture
+def lockcheck_on():
+    lockcheck.reset(enabled_override=True)
+    yield
+    lockcheck.reset()
+
+
+def test_lockcheck_off_returns_plain_locks():
+    """The acceptance contract: disabled, the factories hand back the
+    exact stdlib primitives — zero wrapper, zero overhead."""
+    lockcheck.reset(enabled_override=False)
+    try:
+        assert type(lockcheck.make_lock("x")) is type(threading.Lock())
+        assert type(lockcheck.make_rlock("x")) is type(threading.RLock())
+    finally:
+        lockcheck.reset()
+
+
+def test_lockcheck_detects_seeded_inversion_on_two_threads(lockcheck_on):
+    A = lockcheck.make_lock("test.A")
+    B = lockcheck.make_lock("test.B")
+
+    def a_then_b():
+        with A:
+            with B:
+                pass
+
+    def b_then_a():
+        with B:
+            with A:
+                pass
+
+    for fn in (a_then_b, b_then_a):   # sequential: graph, not deadlock
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+    cycles = [r for r in lockcheck.reports() if r["kind"] == "cycle"]
+    assert len(cycles) == 1, lockcheck.reports()
+    assert set(cycles[0]["cycle"]) == {"test.A", "test.B"}
+    # Both edges carry the acquire stack that formed them.
+    assert len(cycles[0]["stacks"]) == 2
+    # ...and the report rode the flight recorder's force-sampled ring.
+    from ant_ray_tpu.observability import tracing_plane
+
+    spans = [s for s in tracing_plane.recorder().snapshot()
+             if s["name"] == "lockcheck:cycle"]
+    assert spans and spans[-1]["error"] is True
+
+
+def test_lockcheck_consistent_order_reports_nothing(lockcheck_on):
+    A = lockcheck.make_lock("test.C")
+    B = lockcheck.make_lock("test.D")
+    for _ in range(3):
+        with A:
+            with B:
+                pass
+    assert lockcheck.reports() == []
+
+
+def test_lockcheck_long_hold_over_blocking_call(lockcheck_on):
+    from ant_ray_tpu._private.config import global_config
+
+    saved = global_config().lockcheck_hold_budget_s
+    global_config().lockcheck_hold_budget_s = 0.01
+    try:
+        L = lockcheck.make_lock("test.hold")
+        with L:
+            lockcheck.note_blocking("RpcClient.call:LeaseWorker")
+            time.sleep(0.05)
+        holds = [r for r in lockcheck.reports()
+                 if r["kind"] == "long-hold"]
+        assert len(holds) == 1
+        assert holds[0]["lock"] == "test.hold"
+        assert "LeaseWorker" in holds[0]["blocking"]
+
+        # A long hold WITHOUT a blocking call is not reported: the
+        # budget is about holding locks across I/O, not about slow
+        # pure-compute sections.
+        with L:
+            time.sleep(0.05)
+        assert len([r for r in lockcheck.reports()
+                    if r["kind"] == "long-hold"]) == 1
+    finally:
+        global_config().lockcheck_hold_budget_s = saved
+
+
+def test_lockcheck_same_name_instances_still_invert(lockcheck_on):
+    # Two instances sharing one name (every MemoryStore names its lock
+    # "memory_store") taken A→B / B→A are a REAL inversion: the graph
+    # keys on instance, not name, so the name collision can't hide it.
+    A = lockcheck.make_lock("memory_store")
+    B = lockcheck.make_lock("memory_store")
+    for first, second in ((A, B), (B, A)):
+        def run(f=first, s=second):
+            with f:
+                with s:
+                    pass
+        t = threading.Thread(target=run)
+        t.start()
+        t.join()
+    cycles = [r for r in lockcheck.reports() if r["kind"] == "cycle"]
+    assert len(cycles) == 1
+    assert cycles[0]["cycle"] == ["memory_store", "memory_store"]
+    assert len(set(cycles[0]["nodes"])) == 2   # distinct instances
+
+
+def test_lockcheck_edges_of_different_instances_do_not_merge(lockcheck_on):
+    # X→pool#1 on one thread plus pool#2→X on another shares a NAME but
+    # not an instance — stitching them into a cycle would be a false
+    # positive that fails every chaos soak.
+    X = lockcheck.make_lock("X")
+    P1 = lockcheck.make_lock("rpc.client_pool")
+    P2 = lockcheck.make_lock("rpc.client_pool")
+
+    def x_then_p1():
+        with X:
+            with P1:
+                pass
+
+    def p2_then_x():
+        with P2:
+            with X:
+                pass
+
+    for fn in (x_then_p1, p2_then_x):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+    assert [r for r in lockcheck.reports() if r["kind"] == "cycle"] == []
+
+
+def test_lockcheck_system_config_channel_survives_cached_verdict():
+    # Import-time factory calls cache a pre-init verdict; art.init's
+    # refresh_enabled() must make the _system_config channel live.
+    from ant_ray_tpu._private.config import global_config
+
+    lockcheck.reset(enabled_override=False)
+    saved = global_config().lockcheck
+    try:
+        assert type(lockcheck.make_lock("x")) is type(threading.Lock())
+        global_config().lockcheck = True
+        assert lockcheck.refresh_enabled() is True
+        assert isinstance(lockcheck.make_lock("x"),
+                          lockcheck.InstrumentedLock)
+    finally:
+        global_config().lockcheck = saved
+        lockcheck.reset()
+
+
+def test_cli_baseline_update_refuses_path_arguments(tmp_path):
+    # A partial --baseline-update would clobber the global baseline
+    # with one file's findings.
+    some = tmp_path / "a.py"
+    some.write_text("x = 1\n")
+    proc = subprocess.run(
+        [sys.executable, "-m", "ant_ray_tpu._lint", str(some),
+         "--baseline-update"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+    assert "without path arguments" in proc.stderr
+
+
+def test_lockcheck_rlock_reentry_is_not_an_inversion(lockcheck_on):
+    R = lockcheck.make_rlock("test.R")
+    with R:
+        with R:
+            pass
+    assert lockcheck.reports() == []
+
+
+def test_lockcheck_note_blocking_is_noop_when_disabled():
+    lockcheck.reset(enabled_override=False)
+    try:
+        lockcheck.note_blocking("anything")   # must not blow up
+        assert lockcheck.reports() == []
+    finally:
+        lockcheck.reset()
+
+
+def test_lint_full_pass_stays_fast():
+    """The bench budget (<10s over the package) asserted in-tree too,
+    with slack for loaded CI rigs."""
+    t0 = time.monotonic()
+    result = F.run_lint()
+    elapsed = time.monotonic() - t0
+    assert result.files_checked > 100
+    assert elapsed < 30.0, f"lint pass took {elapsed:.1f}s"
+
+
+def test_baseline_file_is_valid_json_with_schema():
+    with open(F.default_baseline_path()) as f:
+        data = json.load(f)
+    assert isinstance(data.get("findings"), list)
