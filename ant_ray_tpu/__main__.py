@@ -1,0 +1,6 @@
+"""``python -m ant_ray_tpu`` — the operator CLI (see cli.py)."""
+
+from ant_ray_tpu.cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -268,6 +268,21 @@ class Config:
     # are pending (age-flushed at 1s regardless).
     trace_publish_batch: int = 128
 
+    # ---- cluster state observatory (_private/task_state.py) ----
+    # Per-job cap on the GCS task-state table (ref: GcsTaskManager's
+    # MAX_NUM_TASK_EVENTS_PER_JOB GC policy, gcs_task_manager.h:60):
+    # once a job exceeds this many (task, attempt) records, finished
+    # attempts are evicted first (oldest first), then the oldest
+    # non-terminal records; evictions surface as num_tasks_dropped in
+    # ListTasks/SummarizeTasks/GetTask stats so operators know the
+    # view is clipped.
+    task_table_max_per_job: int = 10000
+    # Record the creation callsite (file:line outside the framework) of
+    # plasma objects at put() time, surfaced by `art memory` /
+    # /api/memory.  Off by default: the stack walk costs ~microseconds
+    # per put and the strings cost directory memory.
+    record_object_callsite: bool = False
+
     # ---- lockcheck (_lint/lockcheck.py) ----
     # Opt-in runtime lock-order detector for the daemon planes: the
     # make_lock/make_rlock factories return instrumented wrappers that

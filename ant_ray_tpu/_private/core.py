@@ -120,6 +120,25 @@ class _ActorSubmitState:
 # shape; skips a serializer pass per call.
 _EMPTY_ARGS_PAYLOAD = serialization.serialize(((), {})).to_payload()
 
+_FRAMEWORK_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _creation_callsite(limit: int = 12) -> str | None:
+    """First stack frame OUTSIDE the framework — the user line that
+    created the object (behind config.record_object_callsite; walked
+    only when the knob is on)."""
+    import sys  # noqa: PLC0415
+
+    frame = sys._getframe(1)
+    for _ in range(limit):
+        if frame is None:
+            return None
+        filename = frame.f_code.co_filename
+        if not filename.startswith(_FRAMEWORK_DIR):
+            return f"{filename}:{frame.f_lineno}"
+        frame = frame.f_back
+    return None
+
 
 class _ArenaPin:
     """Owner of one daemon-side arena read pin.  Values deserialized
@@ -199,6 +218,7 @@ class ClusterRuntime(CoreRuntime):
             "GetObjectStatusBatch": self._handle_get_object_status_batch,
             "WaitObjects": self._handle_wait_objects,
             "GetObjectInfo": self._handle_get_object_info,
+            "GetOwnedRefInfo": self._handle_get_owned_ref_info,
             "BorrowAdd": self._handle_borrow_add,
             "BorrowRemove": self._handle_borrow_remove,
             "ReconstructObject": self._handle_reconstruct_object,
@@ -579,6 +599,15 @@ class ClusterRuntime(CoreRuntime):
         are copied exactly once end-to-end (plasma create→seal; falls
         back to a tmp file when the native arena is unavailable)."""
         size = ser.payload_nbytes()
+        # Attribution riding the seal (additive keys): the directory
+        # learns who produced the object, so `art memory` can name the
+        # owner — and, behind the record_object_callsite knob, where in
+        # user code the put happened.
+        seal_extra: dict = {"owner": self.address}
+        if global_config().record_object_callsite:
+            callsite = _creation_callsite()
+            if callsite:
+                seal_extra["callsite"] = callsite
         deadline = time.monotonic() + 60
         while True:
             grant = self._node.call("CreateBuffer",
@@ -588,7 +617,9 @@ class ClusterRuntime(CoreRuntime):
                 view = self._arena_client.view(grant["path"], grant["offset"],
                                                size)
                 ser.write_into(view)
-                self._node.call("SealBuffer", {"object_id": oid}, timeout=60)
+                self._node.call("SealBuffer",
+                                {"object_id": oid, **seal_extra},
+                                timeout=60)
                 return
             if grant.get("exists"):
                 return  # idempotent re-put
@@ -605,7 +636,8 @@ class ClusterRuntime(CoreRuntime):
                            f"{oid.hex()}.tmp.{uuid.uuid4().hex[:8]}")
         with open(tmp, "wb") as f:
             f.write(ser.to_payload())
-        self._node.call("SealObject", {"object_id": oid, "tmp_path": tmp},
+        self._node.call("SealObject",
+                        {"object_id": oid, "tmp_path": tmp, **seal_extra},
                         timeout=60)
 
     async def _handle_get_object(self, payload):
@@ -673,6 +705,27 @@ class ClusterRuntime(CoreRuntime):
         if entry[0] == "pending":
             return {"status": "pending", "size": None}
         return {"status": "ready", "size": self._entry_nbytes(entry)}
+
+    async def _handle_get_owned_ref_info(self, payload):
+        """Owner-side refcounts for the memory-attribution leak scan
+        (`art memory`): for each id, the live Python refs, borrower
+        count, and in-flight task-arg pins this owner tracks.  ``None``
+        means the owner holds NO reference state for the id — with the
+        object still in the cluster directory, that is a leak
+        candidate."""
+        out = {}
+        with self._ref_lock:
+            for hexid in payload.get("object_ids", ()):
+                oid = ObjectID.from_hex(hexid)
+                counts = {"local_refs": self._local_refs.get(oid, 0),
+                          "borrows": self._borrows.get(oid, 0),
+                          "pins": self._pins.get(oid, 0)}
+                if not any(counts.values()) \
+                        and not self.memory.contains(oid):
+                    out[hexid] = None
+                else:
+                    out[hexid] = counts
+        return out
 
     @staticmethod
     def _entry_nbytes(entry: tuple) -> int | None:

@@ -7,6 +7,7 @@ job_manager.py:62, _private/metrics_agent.py Prometheus export), as one
 aiohttp process colocated with the head node.  Endpoints:
 
     GET  /api/nodes | /api/actors | /api/placement_groups | /api/objects
+    GET  /api/tasks | /api/tasks/summary | /api/memory
     GET  /api/cluster_status | /api/export_events
     GET  /metrics                         (Prometheus text format)
     POST /api/profile                     {node_id?, duration_s} → XLA trace
@@ -261,8 +262,53 @@ def create_app(gcs_address: str, session_dir: str):
                                          retries=3)))
 
     async def objects(_req):
-        return web.json_response(
-            await _call(lambda: gcs.call("ListObjects", retries=3)))
+        # Directory joined with per-daemon residency (size / pins /
+        # tier / chunk-cache) — the same join `art memory` renders, so
+        # the UI and the CLI show one truth.
+        def build():
+            from ant_ray_tpu._private.state_aggregator import (  # noqa: PLC0415
+                list_objects_joined,
+            )
+
+            return list_objects_joined(gcs, clients)
+        return web.json_response(await _call(build))
+
+    async def tasks(req):
+        """Server-side-filtered task state from the bounded GCS table
+        (?state=&name=&job_id=&actor_id=&node_id=&limit=&token=)."""
+        query = req.query
+
+        def build():
+            token = query.get("token")
+            return gcs.call("ListTasks", {
+                "state": query.get("state"),
+                "name": query.get("name"),
+                "job_id": query.get("job_id"),
+                "actor_id": query.get("actor_id"),
+                "node_id": query.get("node_id"),
+                "limit": int(query.get("limit", 1000)),
+                "token": int(token) if token else None,
+            }, retries=3)
+        return web.json_response(await _call(build))
+
+    async def tasks_summary(req):
+        job_id = req.query.get("job_id")
+
+        def build():
+            return gcs.call("SummarizeTasks", {"job_id": job_id},
+                            retries=3)
+        return web.json_response(await _call(build))
+
+    async def memory(req):
+        top_n = int(req.query.get("top", 20))
+
+        def build():
+            from ant_ray_tpu._private.state_aggregator import (  # noqa: PLC0415
+                build_memory_report,
+            )
+
+            return build_memory_report(gcs, clients, top_n=top_n)
+        return web.json_response(await _call(build))
 
     async def cluster_status(_req):
         def build():
@@ -551,6 +597,9 @@ def create_app(gcs_address: str, session_dir: str):
     app.router.add_get("/api/actors", actors)
     app.router.add_get("/api/placement_groups", pgs)
     app.router.add_get("/api/objects", objects)
+    app.router.add_get("/api/tasks", tasks)
+    app.router.add_get("/api/tasks/summary", tasks_summary)
+    app.router.add_get("/api/memory", memory)
     app.router.add_get("/api/cluster_status", cluster_status)
     app.router.add_get("/api/insight", insight)
     app.router.add_get("/api/export_events", export_events)

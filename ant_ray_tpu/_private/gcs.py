@@ -97,6 +97,23 @@ class GcsServer:
         # bounded ring of task lifecycle events (ref: the GCS task-event
         # aggregator fed by core-worker TaskEventBuffers)
         self._task_events: deque = deque(maxlen=50000)
+        # bounded per-(task, attempt) state table folded at ingestion —
+        # ListTasks/GetTask/SummarizeTasks answer from THIS, never by
+        # replaying the raw ring (ref: GcsTaskManager's task table,
+        # gcs_task_manager.h:97)
+        from ant_ray_tpu._private.task_state import (  # noqa: PLC0415
+            TaskStateTable,
+        )
+
+        self._task_state = TaskStateTable()
+        # client-side flush drops reported by TaskEventBuffers (each
+        # TaskEventsAdd carries the producer's delta) — surfaced in the
+        # state-API stats so a lossy view is never silent
+        self._task_events_dropped = 0
+        # object directory sidecar: owner address (+ optional creation
+        # callsite) per object, reported by the sealing daemon — the
+        # memory-attribution join reads it back via ListObjects
+        self._object_meta: dict[ObjectID, dict] = {}
         # bounded ring of flow-insight events (ant-fork, util/insight)
         self._insight_events: deque = deque(maxlen=10000)
         # bounded ring of per-step profiler records (observability/
@@ -174,6 +191,10 @@ class GcsServer:
             "InsightGet": self._insight_get,
             "TaskEventsAdd": self._task_events_add,
             "TaskEventsGet": self._task_events_get,
+            "ListTasks": self._list_tasks,
+            "GetTask": self._get_task,
+            "SummarizeTasks": self._summarize_tasks,
+            "ListJobs": self._list_jobs,
             "StepEventsAdd": self._step_events_add,
             "StepEventsGet": self._step_events_get,
             "SpanEventsAdd": self._span_events_add,
@@ -676,6 +697,15 @@ class GcsServer:
     async def _task_events_add(self, payload):
         events = payload.get("events", ())
         self._task_events.extend(events)
+        # Fold into the bounded state table AT INGESTION (one dict
+        # upsert per event — benched by task_state_ingest_overhead_ns;
+        # this path must stay cheap, see the export gate below).
+        fold = self._task_state.apply
+        for ev in events:
+            fold(ev)
+        dropped = payload.get("dropped")
+        if dropped:
+            self._task_events_dropped += int(dropped)
         if self._exporter is not None and \
                 global_config().export_task_events:
             # Off by default, like the reference's per-source
@@ -695,6 +725,49 @@ class GcsServer:
         if task_id is not None:
             events = [e for e in events if e.get("task_id") == task_id]
         return events[-limit:]
+
+    # ---------------------------------------------- task state API
+    # (ref: ray.util.state's state_aggregator path — list/summarize
+    #  answered from the GCS-side folded table with server-side
+    #  filtering; the client never pulls the raw event ring)
+
+    def _state_stats(self) -> dict:
+        return {"num_tasks_dropped": self._task_state.num_tasks_dropped,
+                "task_events_dropped": self._task_events_dropped,
+                **self._task_state.stats()}
+
+    async def _list_tasks(self, payload):
+        payload = payload or {}
+        reply = self._task_state.list(
+            filters={k: payload.get(k)
+                     for k in ("state", "name", "job_id", "actor_id",
+                               "node_id")},
+            limit=int(payload.get("limit", 1000)),
+            token=payload.get("token"))
+        reply["task_events_dropped"] = self._task_events_dropped
+        return reply
+
+    async def _get_task(self, payload):
+        attempts = self._task_state.get(payload["task_id"])
+        if not attempts:
+            return None
+        return {"task_id": payload["task_id"], "attempts": attempts,
+                "stats": self._state_stats()}
+
+    async def _summarize_tasks(self, payload):
+        payload = payload or {}
+        reply = self._task_state.summarize(
+            filters={k: payload.get(k) for k in ("job_id", "node_id")})
+        reply["task_events_dropped"] = self._task_events_dropped
+        return reply
+
+    async def _list_jobs(self, _payload):
+        return [
+            {"job_id": job_id.hex(),
+             "driver_address": info.get("driver_address", ""),
+             "started_at": info.get("started_at")}
+            for job_id, info in self._jobs.items()
+        ]
 
     # ------------------------------------------------------ step events
     # (observability/step_profiler.py: batch-published per-step phase
@@ -1102,6 +1175,9 @@ class GcsServer:
             {
                 "object_id": oid.hex(),
                 "locations": [nid.hex() for nid in nodes],
+                "owner": self._object_meta.get(oid, {}).get("owner"),
+                "callsite": self._object_meta.get(oid, {}).get(
+                    "callsite"),
             }
             for oid, nodes in self._object_locations.items()
         ]
@@ -1230,6 +1306,15 @@ class GcsServer:
         oid = payload["object_id"]
         self._object_locations.setdefault(oid, set()).add(
             payload["node_id"])
+        # Optional attribution sidecar (additive payload keys): the
+        # SEALING daemon knows the producer — pull-replica adds don't
+        # resend it, so only fill what's missing.
+        owner = payload.get("owner")
+        if owner:
+            meta = self._object_meta.setdefault(oid, {})
+            meta.setdefault("owner", owner)
+            if payload.get("callsite"):
+                meta.setdefault("callsite", payload["callsite"])
         self._save_locations(oid)
         return True
 
@@ -1240,6 +1325,7 @@ class GcsServer:
             locs.discard(payload["node_id"])
             if not locs:
                 del self._object_locations[oid]
+                self._object_meta.pop(oid, None)
         self._save_locations(oid)
         return True
 
@@ -1251,6 +1337,7 @@ class GcsServer:
     async def _free_object(self, payload):
         oid = payload["object_id"]
         node_ids = self._object_locations.pop(oid, set())
+        self._object_meta.pop(oid, None)
         self._save_locations(oid)
         for nid in node_ids:
             node = self._nodes.get(nid)

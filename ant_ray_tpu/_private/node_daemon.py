@@ -238,6 +238,7 @@ class NodeManager:
             "GetSyncStats": self._get_sync_stats,
             "GetAgentInfo": self._get_agent_info,
             "GetStoreStats": self._get_store_stats,
+            "ListObjectStats": self._list_object_stats,
             "GetNodeMetrics": self._get_node_metrics,
             "GetFlightRecorder": self._get_flight_recorder,
             "GetTransferStats": self._get_transfer_stats,
@@ -582,6 +583,29 @@ class NodeManager:
         return {"used": self.store.used,
                 "capacity": self.store.capacity,
                 "spilled": self.store.spilled_bytes}
+
+    async def _list_object_stats(self, _payload):
+        """Per-object arena residency (size / pins / tier) plus this
+        holder's chunk-cache footprint per object — the daemon half of
+        the memory-attribution join (`art memory`, /api/memory,
+        /api/objects all read this; the GCS directory contributes
+        locations + owner)."""
+        objects = self.store.object_stats()
+        with self._chunk_cache_lock:
+            cache_by_oid: dict[str, int] = {}
+            for (oid, _offset, _length), data in \
+                    self._chunk_cache.items():
+                hexid = oid.hex()
+                cache_by_oid[hexid] = \
+                    cache_by_oid.get(hexid, 0) + len(data)
+        for entry in objects:
+            entry["chunk_cache_bytes"] = cache_by_oid.get(
+                entry["object_id"], 0)
+        return {"node_id": self.node_id.hex(),
+                "objects": objects,
+                "store": {"used": self.store.used,
+                          "capacity": self.store.capacity,
+                          "spilled": self.store.spilled_bytes}}
 
     async def _get_flight_recorder(self, payload):
         """This daemon process's flight-recorder ring (always on): the
@@ -1784,9 +1808,22 @@ class NodeManager:
         object_id: ObjectID = payload["object_id"]
         final = self.store.seal_file(object_id, payload["tmp_path"])
         gcs = self._clients.get(self._gcs_address)
-        await gcs.call_async("ObjectLocationAdd", {
-            "object_id": object_id, "node_id": self.node_id}, timeout=10)
+        await gcs.call_async(
+            "ObjectLocationAdd",
+            self._location_add_payload(object_id, payload), timeout=10)
         return {"path": final}
+
+    def _location_add_payload(self, object_id: ObjectID,
+                              seal_payload: dict) -> dict:
+        """Directory registration for a freshly SEALED object — the
+        producer's attribution (owner address, optional creation
+        callsite) rides along so `art memory` can say who made it."""
+        out = {"object_id": object_id, "node_id": self.node_id}
+        if seal_payload.get("owner"):
+            out["owner"] = seal_payload["owner"]
+        if seal_payload.get("callsite"):
+            out["callsite"] = seal_payload["callsite"]
+        return out
 
     async def _create_buffer(self, payload):
         """Grant a colocated producer a write window in the arena
@@ -1820,8 +1857,9 @@ class NodeManager:
         object_id = payload["object_id"]
         self.store.seal_buffer(object_id)
         gcs = self._clients.get(self._gcs_address)
-        await gcs.call_async("ObjectLocationAdd", {
-            "object_id": object_id, "node_id": self.node_id}, timeout=10)
+        await gcs.call_async(
+            "ObjectLocationAdd",
+            self._location_add_payload(object_id, payload), timeout=10)
         return True
 
     # Hard cap on any single pin lease: a misconfigured client can't

@@ -454,6 +454,38 @@ class ObjectStore:
         with self._lock:
             return list(self._entries)
 
+    def object_stats(self) -> list[dict]:
+        """Per-object residency detail for the memory-attribution join
+        (``art memory`` / ``/api/memory``): every resident AND spilled
+        object with its size, pin count, and storage tier.  One
+        snapshot under the lock — readers get a consistent view."""
+        now = time.monotonic()
+        with self._lock:
+            out = [
+                {
+                    "object_id": oid.hex(),
+                    "size": entry.size,
+                    "pins": entry.pin_count,
+                    "sealed": entry.sealed,
+                    "tier": ("arena" if entry.offset is not None
+                             else "file"),
+                    "created_age_s": now - entry.created_at,
+                }
+                for oid, entry in self._entries.items()
+            ]
+            out.extend(
+                {
+                    "object_id": oid.hex(),
+                    "size": size,
+                    "pins": 0,
+                    "sealed": True,
+                    "tier": "spilled",
+                    "created_age_s": None,
+                }
+                for oid, size in self._spilled.items()
+            )
+        return out
+
     def chunk_view_pinned(self, object_id: ObjectID, offset: int,
                           length: int,
                           token) -> memoryview | bytes | None:

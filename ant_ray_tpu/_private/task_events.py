@@ -7,13 +7,21 @@ events and periodically flush to the GCS task-event aggregator; the
 timeline / state API read the aggregate).  Events here are plain dicts:
 
     {"task_id", "name", "event", "ts", "pid", "node_id", "worker",
-     "parent_task_id", "actor_id"}
+     "parent_task_id", "actor_id", "attempt", "job_id", "error?",
+     "trace_id?"}
 
 ``event`` ∈ {submitted, started, finished, failed}.  Flushes ride one
 oneway RPC per batch (size- or age-triggered from the record path plus
 an atexit drain — no dedicated thread on the hot path).  The executing
 task's id is kept in a contextvar so nested submissions record their
 parent, giving the timeline its span tree without a full OTel stack.
+
+Loss accounting: a batch whose send raises is requeued ONCE (bounded —
+the buffer must not grow without limit against a dead GCS); a batch
+that fails twice is dropped and counted, and the drop count rides the
+next successful flush (``dropped`` payload key) into the GCS
+``task_events_dropped`` stat the state API reports — a lossy task view
+is visible, never silent.
 """
 
 from __future__ import annotations
@@ -25,6 +33,9 @@ import threading
 import time
 
 _MAX_BUFFER = 512
+# A failed batch is requeued once if it fits this bound; combined with
+# _MAX_BUFFER the buffer holds at most 2 batches against a dead GCS.
+_MAX_REQUEUE = _MAX_BUFFER
 _FLUSH_AGE_S = 1.0
 
 current_task = contextvars.ContextVar("art_current_task", default=None)
@@ -42,12 +53,17 @@ class TaskEventBuffer:
         self._lock = make_lock("task_events.buffer")
         self._last_flush = time.monotonic()
         self._registered = False
+        self._atexit_registered = False
         self._flusher: threading.Thread | None = None
+        self._retry: list[dict] | None = None  # one requeued batch
+        self.dropped_total = 0                 # lifetime local drops
+        self._dropped_unreported = 0           # delta not yet at the GCS
 
     def record(self, runtime, *, task_id: str, name: str, event: str,
                actor_id: str | None = None,
                parent_task_id: str | None = None,
-               attempt: int = 0) -> None:
+               attempt: int = 0, error: str | None = None) -> None:
+        job_id = getattr(runtime, "job_id", None)
         entry = {
             "task_id": task_id, "name": name, "event": event,
             "ts": time.time(), "pid": _PID,
@@ -58,7 +74,17 @@ class TaskEventBuffer:
             # Execution attempt: lets span derivation salt ids so a
             # retried task's spans never collide with the original run.
             "attempt": attempt,
+            # Job membership: the GCS state table's GC policy is
+            # per-job, and ListTasks filters on it.
+            "job_id": job_id.hex() if job_id is not None else None,
         }
+        if error is not None:
+            entry["error"] = error[:512]
+        ctx = _trace_current_sampled()
+        if ctx is not None:
+            # Sampled requests link their task records to the trace —
+            # `art trace <id>` and GetTask meet in the middle.
+            entry["trace_id"] = ctx.trace_id
         flush_now = False
         register = False
         with self._lock:
@@ -73,7 +99,9 @@ class TaskEventBuffer:
         if flush_now:
             self.flush()
         if register:
-            atexit.register(self.flush)
+            if not self._atexit_registered:
+                self._atexit_registered = True
+                atexit.register(self.flush)
             # Periodic flusher: without it, the last events of a
             # long-lived worker (e.g. "finished" for its final task)
             # would sit buffered until the next record or process exit.
@@ -85,6 +113,15 @@ class TaskEventBuffer:
     def _flush_loop(self) -> None:
         while True:
             time.sleep(_FLUSH_AGE_S)
+            if _runtime() is None:
+                # Worker disconnected (or events disabled): exit
+                # instead of spinning no-op forever.  Clearing
+                # _registered lets the next record() — e.g. after
+                # art.shutdown()/art.init() — start a fresh flusher.
+                with self._lock:
+                    self._registered = False
+                    self._flusher = None
+                return
             self.flush()
 
     def flush(self) -> None:
@@ -95,18 +132,48 @@ class TaskEventBuffer:
         if runtime is None:
             return
         with self._lock:
-            if not self._events:
+            if not self._events and self._retry is None \
+                    and not self._dropped_unreported:
                 return
             batch, self._events = self._events, []
+            retry, self._retry = self._retry, None
+            # Pop-and-zero under the lock: a concurrent flush (flusher
+            # thread + a record()-triggered one) must not read the same
+            # delta and double-report it to the GCS.
+            dropped, self._dropped_unreported = \
+                self._dropped_unreported, 0
             self._last_flush = time.monotonic()
+        payload = {"events": (retry or []) + batch}
+        if dropped:
+            payload["dropped"] = dropped
         try:
             runtime._send_oneway(runtime.gcs_address, "TaskEventsAdd",
-                                 {"events": batch})
+                                 payload)
         except Exception:  # noqa: BLE001 — observability is best-effort
-            pass
+            with self._lock:
+                # The popped batch is NOT silently lost: requeue it
+                # once under the bound; the already-retried part and
+                # anything over the bound is dropped AND counted.
+                newly_dropped = len(retry or [])
+                if batch and len(batch) <= _MAX_REQUEUE \
+                        and self._retry is None:
+                    self._retry = batch
+                else:
+                    newly_dropped += len(batch)
+                if newly_dropped:
+                    self.dropped_total += newly_dropped
+                    self._dropped_unreported += newly_dropped
+                if dropped:   # the popped delta never reached the GCS
+                    self._dropped_unreported += dropped
 
 
 _buffer = TaskEventBuffer()
+
+
+def _trace_current_sampled():
+    from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+    return tracing_plane.current_sampled()
 
 
 def _runtime():
@@ -124,13 +191,13 @@ def _runtime():
 def record(task_id: str, name: str, event: str, *,
            actor_id: str | None = None,
            parent_task_id: str | None = None,
-           attempt: int = 0) -> None:
+           attempt: int = 0, error: str | None = None) -> None:
     runtime = _runtime()
     if runtime is None:
         return
     _buffer.record(runtime, task_id=task_id, name=name, event=event,
                    actor_id=actor_id, parent_task_id=parent_task_id,
-                   attempt=attempt)
+                   attempt=attempt, error=error)
 
 
 def flush() -> None:

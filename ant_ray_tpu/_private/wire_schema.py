@@ -58,7 +58,8 @@ METHODS: dict[str, dict] = {
                            "bool"),
     "WorkerDied": _m("gcs", "{node_id, worker_id, actor_id?, reason}",
                      "bool"),
-    "ObjectLocationAdd": _m("gcs", "{object_id, node_id}", "bool"),
+    "ObjectLocationAdd": _m("gcs", "{object_id, node_id, owner?, "
+                                   "callsite?}", "bool"),
     "ObjectLocationRemove": _m("gcs", "{object_id, node_id}", "bool"),
     "ObjectLocationsGet": _m("gcs", "{object_id}", "[NodeInfo]"),
     "FreeObject": _m("gcs", "{object_id}", "bool (cluster-wide free)"),
@@ -91,9 +92,24 @@ METHODS: dict[str, dict] = {
                                "{allowed: [node_id]|None}"),
     "InsightRecord": _m("gcs", "{events: [...]}", "bool"),
     "InsightGet": _m("gcs", "{limit?}", "[event]"),
-    "TaskEventsAdd": _m("gcs", "{events: [{task_id, name, event, ...}]}",
-                        "bool"),
+    "TaskEventsAdd": _m("gcs", "{events: [{task_id, name, event, ...}], "
+                               "dropped?}", "bool"),
     "TaskEventsGet": _m("gcs", "{limit?, task_id?}", "[event]"),
+    "ListTasks": _m("gcs",
+                    "{state?, name?, job_id?, actor_id?, node_id?, "
+                    "limit?, token?}",
+                    "{tasks: [record], next_token?, num_tasks_dropped, "
+                    "task_events_dropped} — served from the bounded "
+                    "GCS state table with server-side filtering; the "
+                    "client never pulls the raw event ring"),
+    "GetTask": _m("gcs", "{task_id}",
+                  "{task_id, attempts: [record], stats}|None"),
+    "SummarizeTasks": _m("gcs", "{job_id?, node_id?}",
+                         "{summary: {name: {state_counts, run_s: "
+                         "{mean, p50, p99}}}, total_tasks, "
+                         "num_tasks_dropped, task_events_dropped}"),
+    "ListJobs": _m("gcs", "{}",
+                   "[{job_id, driver_address, started_at}]"),
     "StepEventsAdd": _m("gcs", "{records: [{step, ts, total_s, phases, "
                                "mfu?, rank}]}", "bool"),
     "StepEventsGet": _m("gcs", "{limit?, rank?}", "[record]"),
@@ -162,6 +178,12 @@ METHODS: dict[str, dict] = {
                             "force-sampled error spans in their own "
                             "wrap-protected ring)"),
     "GetStoreStats": _m("node", "{}", "{used, capacity, spilled}"),
+    "ListObjectStats": _m("node", "{}",
+                          "{node_id, objects: [{object_id, size, "
+                          "pins, sealed, tier, created_age_s, "
+                          "chunk_cache_bytes}], store: {used, "
+                          "capacity, spilled}} — per-object arena "
+                          "detail behind `art memory` / /api/memory"),
     "GetSyncStats": _m("node", "{}", "{beats, views_sent, ...}"),
     "GetTransferStats": _m("node", "{include_read_log?}",
                            "{quota_waits, ..., read_log?}"),
@@ -189,6 +211,11 @@ METHODS: dict[str, dict] = {
                       "num_ready listed refs are terminal or the "
                       "deadline fires (push-based wait)"),
     "GetObjectInfo": _m("worker", "{object_id}", "{status, size}"),
+    "GetOwnedRefInfo": _m("worker", "{object_ids: [hex]}",
+                          "{hex: {local_refs, borrows, pins}|None} — "
+                          "owner-side refcounts for the memory-"
+                          "attribution leak scan (None = the owner "
+                          "holds no reference state for the id)"),
     "BorrowAdd": _m("worker", "{object_id}", "bool"),
     "BorrowRemove": _m("worker", "{object_id}", "bool"),
     "ReconstructObject": _m("worker", "{object_id}",

@@ -293,7 +293,8 @@ class TaskExecutor:
             if events is not None:
                 events.current_task.reset(_task_token)
                 events.record(spec.task_id.hex(), spec.function_name,
-                              "failed", attempt=spec.attempt)
+                              "failed", attempt=spec.attempt,
+                              error=repr(e))
             return self._error_returns(spec, err)
         if spec.num_returns == -1:  # streaming generator task
             # The stream is consumed HERE — events record after it
@@ -310,7 +311,10 @@ class TaskExecutor:
                 events.current_task.reset(_task_token)
                 events.record(spec.task_id.hex(), spec.function_name,
                               "failed" if stream_err is not None
-                              else "finished", attempt=spec.attempt)
+                              else "finished", attempt=spec.attempt,
+                              error=(repr(stream_err)
+                                     if stream_err is not None
+                                     else None))
             return out
         if insight is not None:
             insight.record_call_end(spec.function_name,

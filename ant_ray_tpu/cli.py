@@ -1,0 +1,557 @@
+"""Operator CLI: ``python -m ant_ray_tpu <subcommand>`` (ref: the
+`ray list/summary/memory/status` CLI over ray.util.state).
+
+Talks STRAIGHT to the cluster's RPC surfaces through a ClientPool — no
+worker runtime, no driver registration: the CLI is a read-only
+operator tool that must work against a wedged cluster that can't take
+new drivers.  Every subcommand renders a human table by default and
+the raw reply with ``--json`` (one JSON document on stdout — pipe to
+jq).
+
+    art() { python -m ant_ray_tpu "$@"; }
+    art status
+    art list tasks --state RUNNING --limit 20
+    art summary tasks
+    art memory --top 10
+    art list objects | nodes | actors | placement-groups | jobs
+    art logs            # per-node log files;  art logs <file> --tail 100
+    art trace <trace_id>
+
+The cluster address comes from ``--address`` or the ``ART_ADDRESS``
+environment variable (the same one job drivers use).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+# ------------------------------------------------------------ rendering
+
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    value = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if value < 1024 or unit == "TiB":
+            return (f"{value:.0f}{unit}" if unit == "B"
+                    else f"{value:.1f}{unit}")
+        value /= 1024
+    return str(n)
+
+
+def _fmt(value) -> str:
+    if value is None:
+        return "-"
+    if isinstance(value, bool):
+        return "yes" if value else "no"
+    if isinstance(value, float):
+        return f"{value:.3f}"
+    if isinstance(value, (list, tuple)):
+        return ",".join(str(v) for v in value) or "-"
+    if isinstance(value, dict):
+        return json.dumps(value, sort_keys=True)
+    return str(value)
+
+
+def _table(rows: list[dict], columns: list[tuple[str, str]],
+           out=sys.stdout) -> None:
+    """Plain aligned columns: (key, HEADER) pairs; missing keys render
+    as '-'.  No box-drawing — output must survive grep/awk."""
+    headers = [header for _key, header in columns]
+    cells = [[_fmt(row.get(key)) for key, _header in columns]
+             for row in rows]
+    widths = [max(len(headers[i]), *(len(r[i]) for r in cells))
+              if cells else len(headers[i]) for i in range(len(columns))]
+    print("  ".join(h.ljust(w) for h, w in zip(headers, widths)),
+          file=out)
+    for row in cells:
+        print("  ".join(c.ljust(w) for c, w in zip(row, widths)),
+              file=out)
+    if not rows:
+        print("(none)", file=out)
+
+
+def _short(value, n: int = 16):
+    return value[:n] if isinstance(value, str) else value
+
+
+# ------------------------------------------------------------ transport
+
+class StateClient:
+    """Thin RPC facade over the head + per-node daemons."""
+
+    def __init__(self, address: str):
+        from ant_ray_tpu._private.protocol import ClientPool  # noqa: PLC0415
+
+        self.address = address
+        self.pool = ClientPool()
+        self.gcs = self.pool.get(address)
+
+    def call(self, method: str, payload: dict | None = None,
+             timeout: float = 30.0):
+        return self.gcs.call(method, payload or {}, timeout=timeout)
+
+    def alive_nodes(self) -> dict[str, str]:
+        from ant_ray_tpu._private.state_aggregator import (  # noqa: PLC0415
+            _alive_nodes,
+        )
+
+        return _alive_nodes(self.gcs)
+
+
+def _resolve_address(args) -> str:
+    address = args.address or os.environ.get("ART_ADDRESS")
+    if not address:
+        print("error: no cluster address — pass --address host:port or "
+              "set ART_ADDRESS", file=sys.stderr)
+        raise SystemExit(2)
+    return address
+
+
+def _emit(args, payload, render) -> None:
+    """--json prints the raw reply; otherwise the human renderer runs."""
+    if args.json:
+        print(json.dumps(payload, indent=2, default=str))
+    else:
+        render(payload)
+
+
+# ------------------------------------------------------------ commands
+
+def cmd_status(client: StateClient, args) -> int:
+    nodes = client.call("GetAllNodes")
+    actors = client.call("ListActors")
+    total = client.call("ClusterResources")
+    avail = client.call("AvailableResources")
+    try:
+        tasks = client.call("SummarizeTasks")
+    except Exception:  # noqa: BLE001 — pre-observatory head
+        tasks = None
+    stores = []
+    for node_id, address in client.alive_nodes().items():
+        try:
+            store = client.pool.get(address).call("GetStoreStats", {},
+                                                  timeout=5)
+        except Exception:  # noqa: BLE001 — node mid-death
+            continue
+        stores.append({"node_id": node_id, **store})
+    actor_states: dict[str, int] = {}
+    for actor in actors:
+        actor_states[actor["state"]] = \
+            actor_states.get(actor["state"], 0) + 1
+    payload = {
+        "address": client.address,
+        "nodes": {"alive": sum(i.alive for i in nodes.values()),
+                  "dead": sum(not i.alive for i in nodes.values()),
+                  "draining": sum(
+                      bool(getattr(i, "draining", False))
+                      for i in nodes.values() if i.alive)},
+        "resources_total": total,
+        "resources_available": avail,
+        "actors": actor_states,
+        "tasks": (None if tasks is None else {
+            "total": tasks["total_tasks"],
+            "dropped": tasks["num_tasks_dropped"],
+            "states": _merge_state_counts(tasks)}),
+        "object_store": {
+            "used": sum(s["used"] for s in stores),
+            "capacity": sum(s["capacity"] for s in stores),
+            "spilled": sum(s["spilled"] for s in stores)},
+    }
+
+    def render(p):
+        n = p["nodes"]
+        print(f"cluster   {p['address']}")
+        print(f"nodes     {n['alive']} alive / {n['dead']} dead"
+              + (f" / {n['draining']} draining" if n["draining"]
+                 else ""))
+        for res, tot in sorted(p["resources_total"].items()):
+            free = p["resources_available"].get(res, 0.0)
+            print(f"  {res:<12} {tot - free:g}/{tot:g} used")
+        if p["actors"]:
+            print("actors    " + ", ".join(
+                f"{k}={v}" for k, v in sorted(p["actors"].items())))
+        if p["tasks"]:
+            states = ", ".join(f"{k}={v}" for k, v in
+                               sorted(p["tasks"]["states"].items()))
+            print(f"tasks     {p['tasks']['total']} tracked"
+                  + (f" ({states})" if states else "")
+                  + (f", {p['tasks']['dropped']} dropped by GC"
+                     if p["tasks"]["dropped"] else ""))
+        store = p["object_store"]
+        print(f"objects   {_fmt_bytes(store['used'])} / "
+              f"{_fmt_bytes(store['capacity'])} in store"
+              + (f", {_fmt_bytes(store['spilled'])} spilled"
+                 if store["spilled"] else ""))
+
+    _emit(args, payload, render)
+    return 0
+
+
+def _merge_state_counts(summary: dict) -> dict:
+    out: dict[str, int] = {}
+    for group in summary["summary"].values():
+        for state, count in group["state_counts"].items():
+            out[state] = out.get(state, 0) + count
+    return out
+
+
+def cmd_list(client: StateClient, args) -> int:
+    kind = args.kind
+    if kind == "tasks":
+        reply = client.call("ListTasks", {
+            "state": args.state, "name": args.name, "job_id": args.job,
+            "actor_id": args.actor, "node_id": args.node,
+            "limit": args.limit, "token": args.token})
+
+        def render(p):
+            for t in p["tasks"]:
+                t["task"] = _short(t["task_id"])
+                t["node"] = _short(t["node_id"] or "", 12)
+            _table(p["tasks"], [("task", "TASK"), ("attempt", "ATT"),
+                                ("name", "NAME"), ("state", "STATE"),
+                                ("node", "NODE"), ("queue_s", "QUEUE_S"),
+                                ("run_s", "RUN_S"), ("error", "ERROR")])
+            if p.get("next_token") is not None:
+                print(f"... more — continue with --token "
+                      f"{p['next_token']}")
+            if p.get("num_tasks_dropped"):
+                print(f"({p['num_tasks_dropped']} records dropped by "
+                      "table GC)")
+
+        _emit(args, reply, render)
+        return 0
+    if kind == "actors":
+        actors = client.call("ListActors")
+        if args.state:
+            actors = [a for a in actors if a["state"] == args.state]
+        actors = actors[:args.limit]
+
+        def render(rows):
+            for a in rows:
+                a["actor"] = _short(a["actor_id"])
+                a["node"] = _short(a.get("node_id") or "", 12)
+            _table(rows, [("actor", "ACTOR"), ("class_name", "CLASS"),
+                          ("name", "NAME"), ("state", "STATE"),
+                          ("node", "NODE"),
+                          ("death_reason", "DEATH_REASON")])
+
+        _emit(args, actors, render)
+        return 0
+    if kind == "objects":
+        from ant_ray_tpu._private.state_aggregator import (  # noqa: PLC0415
+            list_objects_joined,
+        )
+
+        objects = list_objects_joined(client.gcs, client.pool)
+        if args.node:
+            objects = [o for o in objects
+                       if any(loc.startswith(args.node)
+                              for loc in o["locations"])]
+        objects.sort(key=lambda o: o["size"] or 0, reverse=True)
+        objects = objects[:args.limit]
+
+        def render(rows):
+            for o in rows:
+                o["object"] = _short(o["object_id"])
+                o["bytes"] = _fmt_bytes(o["size"])
+                o["nodes"] = [loc[:8] for loc in o["locations"]]
+                o["tier"] = sorted({c["tier"] for c in o["copies"]
+                                    if c.get("tier")}) or None
+            _table(rows, [("object", "OBJECT"), ("bytes", "SIZE"),
+                          ("nodes", "NODES"), ("tier", "TIER"),
+                          ("pinned", "PINNED"), ("owner", "OWNER"),
+                          ("callsite", "CALLSITE")])
+
+        _emit(args, objects, render)
+        return 0
+    if kind == "nodes":
+        infos = client.call("GetAllNodes")
+        rows = [{
+            "node_id": i.node_id.hex(), "node": i.node_id.hex()[:12],
+            "address": i.address, "alive": i.alive,
+            "draining": bool(getattr(i, "draining", False)),
+            "resources": i.total_resources, "labels": i.labels,
+        } for i in infos.values()]
+
+        def render(r):
+            _table(r, [("node", "NODE"), ("address", "ADDRESS"),
+                       ("alive", "ALIVE"), ("draining", "DRAINING"),
+                       ("resources", "RESOURCES"), ("labels", "LABELS")])
+
+        _emit(args, rows, render)
+        return 0
+    if kind == "placement-groups":
+        pgs = client.call("ListPlacementGroups")
+        rows = [{"pg_id": pg_id, "pg": pg_id[:16], **record}
+                for pg_id, record in pgs.items()]
+
+        def render(r):
+            _table(r, [("pg", "GROUP"), ("name", "NAME"),
+                       ("state", "STATE"), ("strategy", "STRATEGY"),
+                       ("bundles", "BUNDLES")])
+
+        _emit(args, rows, render)
+        return 0
+    if kind == "jobs":
+        jobs = client.call("ListJobs")
+
+        def render(r):
+            _table(r, [("job_id", "JOB"),
+                       ("driver_address", "DRIVER"),
+                       ("started_at", "STARTED_AT")])
+
+        _emit(args, jobs, render)
+        return 0
+    print(f"error: unknown list kind {kind!r}", file=sys.stderr)
+    return 2
+
+
+def cmd_summary(client: StateClient, args) -> int:
+    reply = client.call("SummarizeTasks", {"job_id": args.job})
+
+    def render(p):
+        rows = []
+        for name, group in sorted(p["summary"].items()):
+            run = group.get("run_s") or {}
+            rows.append({
+                "name": name, "total": group["total"],
+                "states": ", ".join(
+                    f"{k}={v}" for k, v in
+                    sorted(group["state_counts"].items())),
+                "mean_s": run.get("mean"), "p50_s": run.get("p50"),
+                "p99_s": run.get("p99")})
+        _table(rows, [("name", "NAME"), ("total", "TOTAL"),
+                      ("states", "STATES"), ("mean_s", "MEAN_S"),
+                      ("p50_s", "P50_S"), ("p99_s", "P99_S")])
+        if p.get("num_tasks_dropped"):
+            print(f"({p['num_tasks_dropped']} records dropped by table "
+                  "GC)")
+        if p.get("task_events_dropped"):
+            print(f"({p['task_events_dropped']} events dropped by "
+                  "producer buffers)")
+
+    _emit(args, reply, render)
+    return 0
+
+
+def cmd_memory(client: StateClient, args) -> int:
+    from ant_ray_tpu._private.state_aggregator import (  # noqa: PLC0415
+        build_memory_report,
+    )
+
+    report = build_memory_report(client.gcs, client.pool,
+                                 top_n=args.top)
+
+    def render(p):
+        print("per-node object store:")
+        node_rows = [dict(n, node=n["node_id"][:12],
+                          used_h=_fmt_bytes(n["used"]),
+                          cap_h=_fmt_bytes(n["capacity"]),
+                          spill_h=_fmt_bytes(n["spilled"]))
+                     for n in p["nodes"]]
+        _table(node_rows, [("node", "NODE"), ("used_h", "USED"),
+                           ("cap_h", "CAPACITY"),
+                           ("spill_h", "SPILLED"),
+                           ("objects", "OBJECTS")])
+        print(f"\ntop {len(p['objects'])} objects by size:")
+        obj_rows = []
+        for o in p["objects"]:
+            refs = o.get("refs")
+            obj_rows.append({
+                "object": _short(o["object_id"]),
+                "bytes": _fmt_bytes(o["size"]),
+                "holders": [loc[:8] for loc in o["locations"]],
+                "pinned": o["pinned"],
+                "owner": o.get("owner"),
+                "refs": ("-" if refs is None else
+                         f"local={refs['local_refs']} "
+                         f"borrows={refs['borrows']} "
+                         f"pins={refs['pins']}"),
+                "leak": o.get("leak"),
+                "callsite": o.get("callsite")})
+        _table(obj_rows, [("object", "OBJECT"), ("bytes", "SIZE"),
+                          ("holders", "HOLDERS"), ("pinned", "PINNED"),
+                          ("owner", "OWNER"), ("refs", "REFS"),
+                          ("leak", "LEAK"), ("callsite", "CALLSITE")])
+        t = p["totals"]
+        print(f"\ntotal {t['objects']} objects, "
+              f"{_fmt_bytes(t['bytes'])} "
+              f"({t['pinned_objects']} pinned, "
+              f"{_fmt_bytes(t['chunk_cache_bytes'])} chunk cache)")
+        if p["leak_candidates"]:
+            print(f"leak candidates: {len(p['leak_candidates'])} "
+                  "(see LEAK column: owner_dead = owning worker "
+                  "unreachable; no_live_reference = owner holds no "
+                  "reference)")
+
+    _emit(args, report, render)
+    return 0
+
+
+def cmd_logs(client: StateClient, args) -> int:
+    nodes = client.alive_nodes()
+    if args.node:
+        nodes = {nid: addr for nid, addr in nodes.items()
+                 if nid.startswith(args.node)}
+        if not nodes:
+            print(f"error: no alive node matches {args.node!r}",
+                  file=sys.stderr)
+            return 1
+    if not args.filename:
+        listing = []
+        for node_id, address in sorted(nodes.items()):
+            try:
+                files = client.pool.get(address).call("ListLogs", {},
+                                                      timeout=5)
+            except Exception:  # noqa: BLE001 — node mid-death
+                continue
+            listing.append({"node_id": node_id, "files": files})
+
+        def render(rows):
+            for entry in rows:
+                print(f"node {entry['node_id'][:12]}:")
+                for f in entry["files"]:
+                    print(f"  {_fmt_bytes(f['size']):>10}  "
+                          f"{f['filename']}")
+
+        _emit(args, listing, render)
+        return 0
+    last_error = "no nodes"
+    for node_id, address in sorted(nodes.items()):
+        try:
+            reply = client.pool.get(address).call("ReadLog", {
+                "filename": args.filename, "tail": args.tail,
+                "max_bytes": args.max_bytes}, timeout=10)
+        except Exception as e:  # noqa: BLE001 — node mid-death: try next
+            last_error = f"{node_id[:12]}: {e}"
+            continue
+        if "error" in reply:
+            last_error = reply["error"]
+            continue
+        text = reply["data"].decode("utf-8", errors="replace")
+        if args.json:
+            print(json.dumps({"node_id": node_id, "data": text,
+                              "eof": reply.get("eof")}))
+        else:
+            sys.stdout.write(text)
+        return 0
+    print(f"error: {last_error}", file=sys.stderr)
+    return 1
+
+
+def cmd_trace(client: StateClient, args) -> int:
+    from ant_ray_tpu.observability.tracing_plane import span_tree  # noqa: PLC0415
+
+    spans = client.call("SpanEventsGet",
+                        {"trace_id": args.trace_id}) or []
+    payload = {"trace_id": args.trace_id, "span_count": len(spans),
+               "tree": span_tree(spans)}
+
+    def render(p):
+        if not p["span_count"]:
+            print(f"no spans for trace {args.trace_id} (sampled? "
+                  "published yet?)")
+            return
+
+        def walk(node, depth):
+            dur = node.get("dur_s")
+            dur_text = f"{dur * 1000:.1f}ms" if dur is not None else "-"
+            flags = " ERROR" if node.get("error") else ""
+            print(f"{'  ' * depth}{node['name']}  {dur_text}  "
+                  f"[{node.get('node_id', '')}:{node.get('pid', '')}]"
+                  f"{flags}")
+            for child in node.get("children", ()):
+                walk(child, depth + 1)
+
+        for root in p["tree"]:
+            walk(root, 0)
+
+    _emit(args, payload, render)
+    return 0
+
+
+# ------------------------------------------------------------- argparse
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m ant_ray_tpu",
+        description="cluster state observatory CLI")
+    parser.add_argument("--address", default=None,
+                        help="cluster head host:port (default: "
+                             "$ART_ADDRESS)")
+    parser.add_argument("--json", action="store_true",
+                        help="print the raw reply as JSON")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    sub.add_parser("status", help="one-screen cluster overview")
+
+    p_list = sub.add_parser("list", help="list cluster entities")
+    p_list.add_argument("kind", choices=[
+        "tasks", "actors", "objects", "nodes", "placement-groups",
+        "jobs"])
+    p_list.add_argument("--state", default=None,
+                        help="filter by state (tasks/actors)")
+    p_list.add_argument("--name", default=None,
+                        help="filter tasks by function name")
+    p_list.add_argument("--job", default=None,
+                        help="filter tasks by job id (hex)")
+    p_list.add_argument("--actor", default=None,
+                        help="filter tasks by actor id (hex)")
+    p_list.add_argument("--node", default=None,
+                        help="filter by node id prefix")
+    p_list.add_argument("--limit", type=int, default=100)
+    p_list.add_argument("--token", type=int, default=None,
+                        help="continuation token from the previous "
+                             "page (tasks)")
+
+    p_summary = sub.add_parser("summary", help="server-side rollups")
+    p_summary.add_argument("kind", choices=["tasks"])
+    p_summary.add_argument("--job", default=None)
+
+    p_memory = sub.add_parser(
+        "memory", help="object memory attribution (`ray memory` "
+                       "analog)")
+    p_memory.add_argument("--top", type=int, default=20,
+                          help="how many objects by size")
+
+    p_logs = sub.add_parser("logs", help="list / read node log files")
+    p_logs.add_argument("filename", nargs="?", default=None)
+    p_logs.add_argument("--node", default=None,
+                        help="node id prefix")
+    p_logs.add_argument("--tail", type=int, default=None)
+    p_logs.add_argument("--max-bytes", type=int, default=65536)
+
+    p_trace = sub.add_parser("trace",
+                             help="render one request's span tree")
+    p_trace.add_argument("trace_id")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    client = StateClient(_resolve_address(args))
+    try:
+        if args.command == "status":
+            return cmd_status(client, args)
+        if args.command == "list":
+            return cmd_list(client, args)
+        if args.command == "summary":
+            return cmd_summary(client, args)
+        if args.command == "memory":
+            return cmd_memory(client, args)
+        if args.command == "logs":
+            return cmd_logs(client, args)
+        if args.command == "trace":
+            return cmd_trace(client, args)
+        return 2
+    finally:
+        client.pool.close_all()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

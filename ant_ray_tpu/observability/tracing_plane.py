@@ -545,6 +545,8 @@ RPC_METHOD_PLANES: dict[str, str] = {
     "SetJobVirtualCluster": "control", "GetJobVirtualCluster": "control",
     "InsightRecord": "observability", "InsightGet": "observability",
     "TaskEventsAdd": "observability", "TaskEventsGet": "observability",
+    "ListTasks": "observability", "GetTask": "observability",
+    "SummarizeTasks": "observability", "ListJobs": "observability",
     "StepEventsAdd": "observability", "StepEventsGet": "observability",
     "SpanEventsAdd": "observability", "SpanEventsGet": "observability",
     "SubPoll": "control", "PublishLogs": "observability",
@@ -563,6 +565,7 @@ RPC_METHOD_PLANES: dict[str, str] = {
     "GetNodeInfo": "control", "NotifyDrain": "control",
     "DebugResources": "observability", "GetNodeMetrics": "observability",
     "GetStoreStats": "observability", "GetSyncStats": "observability",
+    "ListObjectStats": "observability",
     "GetTransferStats": "observability",
     "GetFlightRecorder": "observability",
     "ListLogs": "observability", "ReadLog": "observability",
@@ -571,7 +574,8 @@ RPC_METHOD_PLANES: dict[str, str] = {
     "InstantiateActor": "execution", "Ping": "control",
     "GetObject": "object", "GetObjectStatus": "object",
     "GetObjectStatusBatch": "object", "WaitObjects": "object",
-    "GetObjectInfo": "object", "BorrowAdd": "object",
+    "GetObjectInfo": "object", "GetOwnedRefInfo": "observability",
+    "BorrowAdd": "object",
     "BorrowRemove": "object", "ReconstructObject": "object",
     "StreamItem": "execution", "DeviceTensorFetch": "object",
     "DeviceTensorFree": "object", "DeviceTensorSendVia": "object",

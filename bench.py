@@ -216,6 +216,13 @@ _GUARDED_METRICS = {
     # package.  Guarded "lower" with a hard 10s budget in run_child —
     # a lint too slow to run every commit stops being run at all.
     "lint_full_pass_s": "lower",
+    # State observatory (PR 11): the per-event fold cost on the GCS
+    # TaskEventsAdd ingest path (hard 4 µs budget in microbench — the
+    # fold taxes EVERY task the cluster runs) and the server-side
+    # ListTasks round trip that replaced the pull-the-raw-ring state
+    # query.  Both "lower".
+    "task_state_ingest_overhead_ns": "lower",
+    "state_list_tasks_us": "lower",
 }
 
 

@@ -312,6 +312,40 @@ def main() -> None:
     finally:
         cfg.trace_sample_rate = old_rate
 
+    # ---- cluster state observatory (_private/task_state.py): (a) the
+    # per-event fold cost on the TaskEventsAdd ingest path — the gcs.py
+    # export-gate comment pins why per-event work there must stay ~free
+    # (it taxes EVERY task the cluster runs); (b) the server-side
+    # ListTasks round trip over the populated table (the thousands of
+    # task/actor-call records the workloads above produced), replacing
+    # the old pull-50k-raw-events-and-fold-client-side state query.
+    from ant_ray_tpu._private import task_events  # noqa: PLC0415
+    from ant_ray_tpu._private.task_state import ingest_overhead_ns  # noqa: PLC0415
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    ingest_ns = sorted(
+        ingest_overhead_ns(max(3000, int(20000 * scale)))
+        for _ in range(3))[1]
+    emit("task_state_ingest_overhead_ns", ingest_ns, "ns")
+    if ingest_ns > 4000.0:
+        # The fold rides the hottest GCS write path: past this budget
+        # it is a throughput regression, not a tuning matter.
+        print(json.dumps({"metric": "bench_error",
+                          "bench_error":
+                          f"task_state_ingest_overhead_ns={ingest_ns:.0f}"
+                          " exceeds 4000ns budget"}))
+
+    task_events.flush()               # this driver's tail of records
+    gcs = global_worker.runtime._gcs
+    gcs.call("ListTasks", {"limit": 1000})          # warm the route
+    rounds = 20
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        reply = gcs.call("ListTasks", {"limit": 1000})
+    emit("state_list_tasks_us",
+         1e6 * (time.perf_counter() - t0) / rounds, "us")
+    assert reply["tasks"], "state table unexpectedly empty"
+
     art.shutdown()
 
     # ---- striped broadcast pull (node_daemon._pull_chunks): a third

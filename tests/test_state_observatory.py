@@ -1,0 +1,720 @@
+"""Cluster state observatory (_private/task_state.py +
+state_aggregator.py + the operator CLI): GCS-side event folding
+(out-of-order, retried attempts, sticky terminal states), the
+finished-first GC policy with drop accounting, ListTasks
+filter/pagination semantics, the memory-attribution join incl. leak
+candidates, the TaskEventBuffer requeue-once/drop-count contract, and
+smoke coverage of ``python -m ant_ray_tpu`` + the dashboard routes."""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu._private.task_state import TaskStateTable
+
+JAX = pytest.importorskip("jax")  # noqa: F841 — cluster boots need jax
+
+
+def _ev(task_id, event, *, ts=0.0, attempt=0, name="t", job_id="j",
+        node_id="", error=None, **extra):
+    out = {"task_id": task_id, "name": name, "event": event, "ts": ts,
+           "attempt": attempt, "job_id": job_id, "node_id": node_id}
+    if error is not None:
+        out["error"] = error
+    out.update(extra)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unit: the GCS-side fold
+# ---------------------------------------------------------------------------
+
+
+def test_fold_out_of_order_events():
+    """The worker's `finished` flush routinely beats the driver's
+    `submitted` batch — state must not regress and durations must
+    still come out right once every timestamp lands."""
+    table = TaskStateTable(max_per_job=100)
+    table.apply(_ev("a", "finished", ts=3.0))
+    table.apply(_ev("a", "started", ts=1.0, node_id="n1"))
+    table.apply(_ev("a", "submitted", ts=0.5))
+    (record,) = table.list()["tasks"]
+    assert record["state"] == "FINISHED"
+    assert record["node_id"] == "n1"
+    assert record["queue_s"] == pytest.approx(0.5)
+    assert record["run_s"] == pytest.approx(2.0)
+    assert record["total_s"] == pytest.approx(2.5)
+
+
+def test_terminal_states_sticky():
+    """Equal-rank precedence: a late duplicate `finished` flush must
+    never overwrite FAILED (the client-side fold bug this table
+    replaces), and vice versa."""
+    table = TaskStateTable(max_per_job=100)
+    table.apply(_ev("a", "failed", ts=2.0, error="boom"))
+    table.apply(_ev("a", "finished", ts=3.0))
+    table.apply(_ev("a", "started", ts=1.0))  # late retransmit
+    (record,) = table.list()["tasks"]
+    assert record["state"] == "FAILED"
+    assert record["error"] == "boom"
+
+    table.apply(_ev("b", "finished", ts=2.0))
+    table.apply(_ev("b", "failed", ts=3.0))
+    (record,) = table.list(filters={"name": "t"},
+                           token=None)["tasks"][1:]
+    assert record["state"] == "FINISHED"
+
+
+def test_retried_attempts_are_separate_records():
+    """A retry's `started` must not erase attempt 0's terminal state —
+    records key by (task_id, attempt)."""
+    table = TaskStateTable(max_per_job=100)
+    table.apply(_ev("a", "submitted", ts=0.0))
+    table.apply(_ev("a", "started", ts=1.0, attempt=0))
+    table.apply(_ev("a", "failed", ts=2.0, attempt=0, error="x"))
+    table.apply(_ev("a", "started", ts=3.0, attempt=1))
+    table.apply(_ev("a", "finished", ts=4.0, attempt=1))
+    attempts = table.get("a")
+    assert [r["attempt"] for r in attempts] == [0, 1]
+    assert attempts[0]["state"] == "FAILED"
+    assert attempts[1]["state"] == "FINISHED"
+    assert attempts[1]["run_s"] == pytest.approx(1.0)
+
+
+def test_gc_evicts_finished_first_and_counts():
+    table = TaskStateTable(max_per_job=4)
+    # 3 finished (oldest) + 2 running, then 2 more finished → evictions
+    # must take finished records first and never silent-drop.
+    for i in range(3):
+        table.apply(_ev(f"f{i}", "started", ts=i))
+        table.apply(_ev(f"f{i}", "finished", ts=i + 0.5))
+    for i in range(2):
+        table.apply(_ev(f"r{i}", "started", ts=10 + i))
+    assert table.num_tasks_dropped == 1      # 5 records, cap 4
+    for i in range(3, 5):
+        table.apply(_ev(f"f{i}", "started", ts=i))
+        table.apply(_ev(f"f{i}", "finished", ts=i + 0.5))
+    reply = table.list(limit=100)
+    states = {r["task_id"]: r["state"] for r in reply["tasks"]}
+    # The RUNNING records survived every round of finished-first GC.
+    assert {"r0", "r1"} <= set(states)
+    assert len(states) == 4
+    assert reply["num_tasks_dropped"] == table.num_tasks_dropped == 3
+    assert table.stats()["dropped_by_job"]["j"] == 3
+
+
+def test_gc_falls_back_to_oldest_when_nothing_finished():
+    table = TaskStateTable(max_per_job=2)
+    for i in range(4):
+        table.apply(_ev(f"r{i}", "started", ts=i))
+    tasks = table.list()["tasks"]
+    assert [r["task_id"] for r in tasks] == ["r2", "r3"]
+    assert table.num_tasks_dropped == 2
+
+
+def test_list_filters():
+    table = TaskStateTable(max_per_job=100)
+    table.apply(_ev("a", "started", name="f", job_id="j1",
+                    node_id="n1aa"))
+    table.apply(_ev("b", "finished", name="f", job_id="j1",
+                    node_id="n2bb"))
+    table.apply(_ev("c", "started", name="g", job_id="j2",
+                    node_id="n1aa", actor_id="act1"))
+
+    def ids(**filters):
+        return [r["task_id"] for r in
+                table.list(filters=filters)["tasks"]]
+
+    assert ids(state="RUNNING") == ["a", "c"]
+    assert ids(name="f") == ["a", "b"]
+    assert ids(job_id="j2") == ["c"]
+    assert ids(actor_id="act1") == ["c"]
+    assert ids(node_id="n1") == ["a", "c"]   # prefix match
+    assert ids(state="RUNNING", name="g") == ["c"]
+
+
+def test_list_pagination_walks_every_record_once():
+    table = TaskStateTable(max_per_job=1000)
+    for i in range(25):
+        table.apply(_ev(f"t{i:03d}", "started", ts=i))
+    seen, token, pages = [], None, 0
+    while True:
+        reply = table.list(limit=10, token=token)
+        seen.extend(r["task_id"] for r in reply["tasks"])
+        pages += 1
+        token = reply["next_token"]
+        if token is None:
+            break
+    assert pages == 3
+    assert seen == [f"t{i:03d}" for i in range(25)]
+    # Eviction between pages never repeats or skips survivors.
+    reply = table.list(limit=10)
+    table._gc_job("j")  # no-op under cap; cursor math unaffected
+    rest = table.list(limit=1000, token=reply["next_token"])["tasks"]
+    assert [r["task_id"] for r in rest] == \
+        [f"t{i:03d}" for i in range(10, 25)]
+
+
+def test_summarize_groups_and_percentiles():
+    table = TaskStateTable(max_per_job=1000)
+    for i in range(10):
+        table.apply(_ev(f"t{i}", "started", ts=0.0, name="f"))
+        table.apply(_ev(f"t{i}", "finished", ts=float(i + 1), name="f"))
+    table.apply(_ev("x", "started", name="g"))
+    table.apply(_ev("y", "failed", name="g", error="e"))
+    summary = table.summarize()
+    f = summary["summary"]["f"]
+    assert f["state_counts"] == {"FINISHED": 10}
+    assert f["run_s"]["count"] == 10
+    assert f["run_s"]["mean"] == pytest.approx(5.5)
+    assert f["run_s"]["p50"] == pytest.approx(6.0)
+    assert f["run_s"]["p99"] == pytest.approx(9.0)
+    g = summary["summary"]["g"]
+    assert g["state_counts"] == {"RUNNING": 1, "FAILED": 1}
+    assert g["failed"] == 1 and g["run_s"] is None
+    assert summary["total_tasks"] == 12
+
+
+def test_ingest_overhead_budget():
+    """The fold rides the TaskEventsAdd hot path — it must stay in the
+    single-digit-µs-per-event regime (the microbench guards the real
+    number; this is the smoke bound)."""
+    from ant_ray_tpu._private.task_state import ingest_overhead_ns
+
+    assert ingest_overhead_ns(6000) < 50_000
+
+
+# ---------------------------------------------------------------------------
+# unit: thin-client fallback fold (old servers)
+# ---------------------------------------------------------------------------
+
+
+def test_fallback_fold_fixed_semantics(monkeypatch):
+    from ant_ray_tpu.util import state as state_mod
+
+    events = [
+        # attempt 0 failed; a late duplicate "finished" flush follows
+        _ev("a", "submitted", ts=0.0),
+        _ev("a", "started", ts=1.0, attempt=0),
+        _ev("a", "failed", ts=2.0, attempt=0),
+        _ev("a", "finished", ts=2.1, attempt=0),   # must NOT win
+        # retry: attempt 1 runs and finishes — must not merge with 0
+        _ev("a", "started", ts=3.0, attempt=1),
+        _ev("a", "finished", ts=4.0, attempt=1),
+    ]
+
+    class FakeGcs:
+        def call(self, method, payload=None, **kw):
+            assert method == "TaskEventsGet"
+            return events
+
+    monkeypatch.setattr(state_mod, "_gcs", lambda: FakeGcs())
+    records = state_mod._list_tasks_fallback(100)
+    by_attempt = {r["attempt"]: r for r in records}
+    assert by_attempt[0]["state"] == "FAILED"
+    assert by_attempt[1]["state"] == "FINISHED"
+    # Every server-side filter works in the fallback too (job_id
+    # included — silently ignoring a filter is worse than erroring).
+    assert state_mod._list_tasks_fallback(100, job_id="j")
+    assert not state_mod._list_tasks_fallback(100, job_id="other")
+
+
+def test_list_tasks_falls_back_on_old_server(monkeypatch):
+    from ant_ray_tpu._private.protocol import RpcError
+    from ant_ray_tpu.util import state as state_mod
+
+    class OldGcs:
+        def call(self, method, payload=None, **kw):
+            if method == "ListTasks":
+                raise RpcError("RpcError(\"no route for method "
+                               "'ListTasks'\")")
+            assert method == "TaskEventsGet"
+            return [_ev("a", "started", ts=1.0)]
+
+    monkeypatch.setattr(state_mod, "_gcs", lambda: OldGcs())
+    records = state_mod.list_tasks()
+    assert records[0]["state"] == "RUNNING"
+
+    class BrokenGcs:
+        def call(self, method, payload=None, **kw):
+            raise RpcError("connection reset")
+
+    monkeypatch.setattr(state_mod, "_gcs", lambda: BrokenGcs())
+    with pytest.raises(RpcError):   # real errors surface, no fallback
+        state_mod.list_tasks()
+
+
+# ---------------------------------------------------------------------------
+# unit: TaskEventBuffer loss accounting
+# ---------------------------------------------------------------------------
+
+
+class _FakeRuntime:
+    def __init__(self, fail: bool = False):
+        self.gcs_address = "fake:1"
+        self.address = "fake:2"
+        self.job_id = None
+        self.fail = fail
+        self.payloads: list[dict] = []
+
+    def _send_oneway(self, addr, method, payload):
+        if self.fail:
+            raise ConnectionError("gcs down")
+        self.payloads.append(payload)
+
+
+def test_flush_requeues_once_then_drops_and_counts(monkeypatch):
+    from ant_ray_tpu._private import task_events as te
+
+    buf = te.TaskEventBuffer()
+    runtime = _FakeRuntime(fail=True)
+    monkeypatch.setattr(te, "_runtime", lambda: runtime)
+    for i in range(3):
+        buf.record(runtime, task_id=f"t{i}", name="f",
+                   event="submitted")
+    buf.flush()                       # fails → batch requeued, no drop
+    assert buf._retry is not None and len(buf._retry) == 3
+    assert buf.dropped_total == 0
+    buf.record(runtime, task_id="t3", name="f", event="submitted")
+    buf.flush()     # fails again → the once-requeued 3 drop, counted;
+    assert buf.dropped_total == 3    # the new event takes the retry slot
+    assert buf._retry is not None and len(buf._retry) == 1
+    runtime.fail = False
+    buf.flush()                       # success: retry ships + drop delta
+    (payload,) = runtime.payloads
+    assert len(payload["events"]) == 1
+    assert payload["dropped"] == 3
+    assert buf._dropped_unreported == 0
+    buf.flush()                       # nothing pending → no RPC
+    assert len(runtime.payloads) == 1
+
+
+def test_flush_loop_exits_on_disconnect(monkeypatch):
+    from ant_ray_tpu._private import task_events as te
+
+    buf = te.TaskEventBuffer()
+    runtime = _FakeRuntime()
+    alive = {"on": True}
+    monkeypatch.setattr(
+        te, "_runtime", lambda: runtime if alive["on"] else None)
+    buf.record(runtime, task_id="t", name="f", event="submitted")
+    assert buf._flusher is not None and buf._flusher.is_alive()
+    flusher = buf._flusher
+    alive["on"] = False               # "worker disconnected"
+    flusher.join(timeout=5)
+    assert not flusher.is_alive()
+    assert not buf._registered        # next record() restarts a flusher
+    alive["on"] = True
+    buf.record(runtime, task_id="t2", name="f", event="submitted")
+    assert buf._flusher is not None and buf._flusher.is_alive()
+    alive["on"] = False
+    buf._flusher.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# unit: memory-attribution join + leak candidates (fake transports)
+# ---------------------------------------------------------------------------
+
+
+class _FakeNodeId:
+    def __init__(self, hexid):
+        self._hex = hexid
+
+    def hex(self):
+        return self._hex
+
+
+class _FakeNodeInfo:
+    def __init__(self, hexid, address, alive=True):
+        self.node_id = _FakeNodeId(hexid)
+        self.address = address
+        self.alive = alive
+
+
+class _FakeClient:
+    def __init__(self, replies):
+        self.replies = replies
+
+    def call(self, method, payload=None, **kw):
+        reply = self.replies[method]
+        if isinstance(reply, Exception):
+            raise reply
+        return reply(payload) if callable(reply) else reply
+
+
+class _FakePool:
+    def __init__(self, clients):
+        self.clients = clients
+
+    def get(self, address):
+        return self.clients[address]
+
+
+def _fake_cluster(owner_reply):
+    gcs = _FakeClient({
+        "GetAllNodes": {"n": _FakeNodeInfo("node1" * 4, "daemon:1")},
+        "ListObjects": [
+            {"object_id": "aa" * 8, "locations": ["node1" * 4],
+             "owner": "owner:1", "callsite": "app.py:7"},
+        ],
+    })
+    daemon = _FakeClient({
+        "ListObjectStats": {
+            "node_id": "node1" * 4,
+            "objects": [{"object_id": "aa" * 8, "size": 1024,
+                         "pins": 0, "sealed": True, "tier": "arena",
+                         "created_age_s": 1.0,
+                         "chunk_cache_bytes": 128}],
+            "store": {"used": 1024, "capacity": 4096, "spilled": 0},
+        },
+    })
+    pool = _FakePool({"daemon:1": daemon, "owner:1": owner_reply})
+    return gcs, pool
+
+
+def test_memory_report_leak_owner_dead():
+    from ant_ray_tpu._private.state_aggregator import build_memory_report
+
+    gcs, pool = _fake_cluster(
+        _FakeClient({"GetOwnedRefInfo": ConnectionError("gone")}))
+    report = build_memory_report(gcs, pool)
+    (obj,) = report["objects"]
+    assert obj["leak"] == "owner_dead"
+    assert report["leak_candidates"] == [obj]
+    assert obj["size"] == 1024 and obj["callsite"] == "app.py:7"
+    assert report["totals"]["chunk_cache_bytes"] == 128
+    assert report["nodes"][0]["used"] == 1024
+
+
+def test_memory_report_leak_no_live_reference():
+    from ant_ray_tpu._private.state_aggregator import build_memory_report
+
+    gcs, pool = _fake_cluster(
+        _FakeClient({"GetOwnedRefInfo": {"aa" * 8: None}}))
+    (obj,) = build_memory_report(gcs, pool)["objects"]
+    assert obj["leak"] == "no_live_reference"
+
+
+def test_memory_report_live_reference_not_a_leak():
+    from ant_ray_tpu._private.state_aggregator import build_memory_report
+
+    gcs, pool = _fake_cluster(_FakeClient({
+        "GetOwnedRefInfo": {"aa" * 8: {"local_refs": 2, "borrows": 0,
+                                       "pins": 0}}}))
+    (obj,) = build_memory_report(gcs, pool)["objects"]
+    assert obj["leak"] is None
+    assert obj["refs"]["local_refs"] == 2
+
+
+def test_memory_report_owner_cached_zero_counts_not_a_leak():
+    """An all-zero count dict is the owner saying "no refs but I still
+    hold the value" (memory.contains) — distinct from None ("no
+    reference state at all") and NOT a leak."""
+    from ant_ray_tpu._private.state_aggregator import build_memory_report
+
+    gcs, pool = _fake_cluster(_FakeClient({
+        "GetOwnedRefInfo": {"aa" * 8: {"local_refs": 0, "borrows": 0,
+                                       "pins": 0}}}))
+    (obj,) = build_memory_report(gcs, pool)["objects"]
+    assert obj["leak"] is None
+    assert obj["refs"] == {"local_refs": 0, "borrows": 0, "pins": 0}
+
+
+# ---------------------------------------------------------------------------
+# e2e: 2-node memory attribution
+# ---------------------------------------------------------------------------
+
+
+def test_memory_attribution_two_nodes():
+    from ant_ray_tpu.cluster_utils import Cluster
+    from ant_ray_tpu.util import state
+    from ant_ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy,
+    )
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    second = cluster.add_node(num_cpus=1)
+    try:
+        cluster.connect()
+        target = next(n["NodeID"] for n in art.nodes()
+                      if n["Address"] == second)
+        blob_ref = art.put(np.ones(400_000, dtype=np.uint8))
+
+        @art.remote
+        def consume(arr):
+            return int(arr.sum())        # arg auto-fetch = the pull
+
+        strategy = NodeAffinitySchedulingStrategy(node_id=target)
+        assert art.get(consume.options(
+            scheduling_strategy=strategy).remote(blob_ref)) == 400_000
+
+        def attributed():
+            report = state.memory_report(top_n=10)
+            ours = [o for o in report["objects"]
+                    if o["object_id"] == blob_ref.id.hex()]
+            if ours and len(ours[0]["locations"]) >= 2:
+                return report, ours[0]
+            return None
+
+        report, obj = _wait_for(attributed)
+        # Both holders report the copy, sizes agree, the driver owns it
+        # with a live local ref — so it is NOT a leak candidate.
+        assert len(report["nodes"]) == 2
+        assert {c["node_id"] for c in obj["copies"]} == \
+            set(obj["locations"])
+        assert all(c["size"] == obj["size"] for c in obj["copies"])
+        assert obj["owner"] and obj["refs"]["local_refs"] >= 1
+        assert obj["leak"] is None
+        assert obj not in report["leak_candidates"]
+    finally:
+        art.shutdown()
+        cluster.shutdown()
+
+
+def test_record_object_callsite_knob():
+    art.init(num_cpus=1,
+             _system_config={"record_object_callsite": True})
+    try:
+        from ant_ray_tpu.util import state
+
+        ref = art.put(np.ones(200_000, dtype=np.uint8))  # noqa: F841
+
+        def with_callsite():
+            objs = [o for o in state.list_objects()
+                    if o["object_id"] == ref.id.hex()]
+            return objs if objs and objs[0]["callsite"] else None
+
+        (obj,) = _wait_for(with_callsite, timeout=10)
+        assert "test_state_observatory.py" in obj["callsite"]
+    finally:
+        art.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# e2e: one dashboard-enabled cluster for server/CLI/dashboard coverage
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def observatory_cluster():
+    ctx = art.init(num_cpus=2,
+                   _system_config={"include_dashboard": True})
+    assert ctx.dashboard_url, "dashboard did not start"
+    from ant_ray_tpu._private.worker import global_worker
+
+    yield {"dashboard": ctx.dashboard_url,
+           "gcs": global_worker.runtime.gcs_address}
+    art.shutdown()
+
+
+def _wait_for(predicate, timeout=20.0, interval=0.3):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        value = predicate()
+        if value:
+            return value
+        time.sleep(interval)
+    raise AssertionError("condition not met before timeout")
+
+
+@art.remote
+def _obs_ok(x):
+    return x + 1
+
+
+@art.remote
+def _obs_fail():
+    raise ValueError("observatory boom")
+
+
+_OK_NAME = _obs_ok.function_name
+
+
+def test_server_side_list_filters_and_get(observatory_cluster):
+    from ant_ray_tpu.util import state
+
+    assert art.get([_obs_ok.remote(i) for i in range(6)]) == \
+        list(range(1, 7))
+    with pytest.raises(Exception, match="observatory boom"):
+        art.get(_obs_fail.remote())
+
+    def finished():
+        rows = state.list_tasks(name=_OK_NAME, state="FINISHED")
+        return rows if len(rows) >= 6 else None
+
+    rows = _wait_for(finished)
+    assert all(r["state"] == "FINISHED" for r in rows)
+    assert all(r["run_s"] is not None for r in rows)
+
+    failed = _wait_for(lambda: state.list_tasks(state="FAILED") or None)
+    target = [r for r in failed if r["name"].endswith("_obs_fail")]
+    assert target and "observatory boom" in target[0]["error"]
+
+    # GetTask returns the attempt list + table stats.
+    got = state.get_task(target[0]["task_id"])
+    assert got["attempts"][0]["state"] == "FAILED"
+    assert "num_tasks_dropped" in got["stats"]
+
+    # Summaries come back computed server-side.
+    summary = state.summarize_tasks()
+    group = summary["summary"][_OK_NAME]
+    assert group["state_counts"].get("FINISHED", 0) >= 6
+    assert group["run_s"]["count"] >= 6
+
+
+def test_server_side_pagination(observatory_cluster):
+    from ant_ray_tpu.util import state
+
+    art.get([_obs_ok.remote(i) for i in range(5)])
+    _wait_for(lambda: len(state.list_tasks(
+        name=_OK_NAME, state="FINISHED")) >= 11 or None)
+    seen, token = [], None
+    while True:
+        reply = state.list_tasks_page(limit=4, token=token,
+                                      name=_OK_NAME)
+        seen.extend(r["task_id"] + f"#{r['attempt']}"
+                    for r in reply["tasks"])
+        token = reply["next_token"]
+        if token is None:
+            break
+    assert len(seen) == len(set(seen)) >= 11
+
+
+@art.remote(max_retries=1)
+def _obs_flaky(path):
+    if not os.path.exists(path):
+        open(path, "w").close()
+        # Push the buffered "started" event out before dying — the
+        # crash must not also erase the evidence it happened.
+        from ant_ray_tpu._private import task_events
+
+        task_events.flush()
+        os._exit(1)          # worker crash → the task retries
+    return "ok"
+
+
+def test_retried_task_attempts_server_side(observatory_cluster,
+                                           tmp_path):
+    """A worker-death retry produces a SEPARATE attempt-1 record —
+    attempt 0's last observed state survives instead of being merged
+    over (the bug the (task_id, attempt) key fixes; terminal-sticky
+    folding itself is unit-covered above)."""
+    from ant_ray_tpu.util import state
+
+    marker = str(tmp_path / "flaky_marker")
+    assert art.get(_obs_flaky.remote(marker)) == "ok"
+
+    def attempts():
+        rows = state.list_tasks(name=_obs_flaky.function_name)
+        by_attempt = {r["attempt"]: r for r in rows}
+        if by_attempt.get(1, {}).get("state") == "FINISHED" and \
+                0 in by_attempt:
+            return by_attempt
+        return None
+
+    by_attempt = _wait_for(attempts)
+    # Attempt 0 reached RUNNING and died without a terminal event —
+    # the retry's records must not have overwritten that history.
+    assert by_attempt[0]["state"] in ("RUNNING", "PENDING_EXECUTION")
+    assert by_attempt[1]["run_s"] is not None
+
+
+def test_dashboard_state_routes(observatory_cluster):
+    url = observatory_cluster["dashboard"]
+    art.get(_obs_ok.remote(1))
+    ref = art.put(np.ones(200_000, dtype=np.uint8))
+
+    def get(path):
+        with urllib.request.urlopen(url + path, timeout=30) as r:
+            return json.loads(r.read())
+
+    def tasks_ready():
+        reply = get("/api/tasks?state=FINISHED&limit=2")
+        return reply if reply["tasks"] else None
+
+    reply = _wait_for(tasks_ready)
+    assert len(reply["tasks"]) <= 2
+    assert "num_tasks_dropped" in reply
+
+    summary = get("/api/tasks/summary")
+    assert summary["summary"], summary
+
+    # /api/objects and /api/memory render the SAME join: sizes and
+    # tier come from the daemons, owner from the directory.
+    objects = _wait_for(lambda: [
+        o for o in get("/api/objects")
+        if o["size"] and o["size"] >= 200_000] or None)
+    assert objects[0]["copies"][0]["tier"] in ("arena", "file")
+    assert objects[0]["owner"]
+
+    memory = get("/api/memory?top=5")
+    assert memory["nodes"][0]["capacity"]
+    big = [o for o in memory["objects"]
+           if o["object_id"] == objects[0]["object_id"]]
+    assert big and big[0]["refs"] is not None
+    del ref
+
+
+def test_cli_smoke_json(observatory_cluster):
+    art.get([_obs_ok.remote(i) for i in range(2)])
+    ref = art.put(np.ones(150_000, dtype=np.uint8))  # noqa: F841
+    env = dict(os.environ, ART_ADDRESS=observatory_cluster["gcs"],
+               JAX_PLATFORMS="cpu")
+
+    def run(*args):
+        proc = subprocess.run(
+            [sys.executable, "-m", "ant_ray_tpu", "--json", *args],
+            capture_output=True, text=True, env=env, timeout=120)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        return json.loads(proc.stdout)
+
+    status = run("status")
+    assert status["nodes"]["alive"] >= 1
+    assert status["object_store"]["capacity"] > 0
+
+    def cli_sees_tasks():
+        reply = run("list", "tasks", "--state", "FINISHED",
+                    "--limit", "3")
+        return reply if reply["tasks"] else None
+
+    reply = _wait_for(cli_sees_tasks, timeout=30)
+    assert all(t["state"] == "FINISHED" for t in reply["tasks"])
+
+    summary = run("summary", "tasks")
+    assert summary["summary"]
+
+    memory = run("memory", "--top", "5")
+    assert memory["totals"]["objects"] >= 1
+
+    nodes = run("list", "nodes")
+    assert nodes and nodes[0]["alive"]
+
+    jobs = run("list", "jobs")
+    assert jobs and jobs[0]["job_id"]
+
+    # Human render (no --json) must not crash either.
+    proc = subprocess.run(
+        [sys.executable, "-m", "ant_ray_tpu", "status"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "nodes" in proc.stdout
+
+
+def test_cli_errors_without_address():
+    env = {k: v for k, v in os.environ.items() if k != "ART_ADDRESS"}
+    proc = subprocess.run(
+        [sys.executable, "-m", "ant_ray_tpu", "status"],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 2
+    assert "ART_ADDRESS" in proc.stderr
+
+
