@@ -208,6 +208,27 @@ class Config:
     # is live; without one infeasible fails fast).
     infeasible_wait_s: float = 300.0
 
+    # ---- GCS HA (replicated control plane) ----
+    # Leader lease TTL: a leader that cannot renew within it is fenced
+    # out and a standby takes over — the dominant term in failover time.
+    gcs_ha_lease_ttl_s: float = 2.0
+    # How often the holder renews (and standbys poll) the lease.
+    gcs_ha_renew_period_s: float = 0.4
+    # Follower store-sync period: bounds follower-read staleness and
+    # the replication lag reported in the HA view.
+    gcs_ha_sync_period_s: float = 0.25
+    # Client-side failover budget: how long the GCS router keeps
+    # re-resolving the leader (capped-backoff probes over the known
+    # replica set) after a connection failure before surfacing the
+    # error.  Only applies when the client knows >1 replica.
+    gcs_failover_timeout_s: float = 15.0
+    # Remote-store read fence budget (store_client.RemoteStoreClient):
+    # how long a read waits for the ordered write queue to drain before
+    # failing with a typed StoreFenceError.  A fence miss must surface,
+    # not silently return possibly-stale state — follower reads build
+    # their read-your-writes guarantee on this.
+    store_fence_timeout_s: float = 10.0
+
     # ---- rpc ----
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 60.0

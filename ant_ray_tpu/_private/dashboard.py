@@ -8,7 +8,7 @@ aiohttp process colocated with the head node.  Endpoints:
 
     GET  /api/nodes | /api/actors | /api/placement_groups | /api/objects
     GET  /api/tasks | /api/tasks/summary | /api/memory
-    GET  /api/cluster_status | /api/export_events
+    GET  /api/cluster_status | /api/export_events | /api/ha
     GET  /metrics                         (Prometheus text format)
     POST /api/profile                     {node_id?, duration_s} → XLA trace
     POST /api/jobs                        {entrypoint, runtime_env, ...}
@@ -310,6 +310,12 @@ def create_app(gcs_address: str, session_dir: str):
             return build_memory_report(gcs, clients, top_n=top_n)
         return web.json_response(await _call(build))
 
+    def _ha_view():
+        try:
+            return gcs.call("GetHaView", {}, timeout=5, retries=1)
+        except Exception:  # noqa: BLE001 — pre-HA head
+            return None
+
     async def cluster_status(_req):
         def build():
             infos = gcs.call("GetAllNodes", retries=3)
@@ -319,8 +325,14 @@ def create_app(gcs_address: str, session_dir: str):
                     "nodes_dead": sum(not i.alive
                                       for i in infos.values()),
                     "resources_total": total,
-                    "resources_available": avail}
+                    "resources_available": avail,
+                    "ha": _ha_view()}
         return web.json_response(await _call(build))
+
+    async def ha(_req):
+        """Control-plane HA view: leader identity, standby set with
+        per-follower replication lag, last failover timestamp."""
+        return web.json_response(await _call(_ha_view))
 
     async def insight(_req):
         def build():
@@ -601,6 +613,7 @@ def create_app(gcs_address: str, session_dir: str):
     app.router.add_get("/api/tasks/summary", tasks_summary)
     app.router.add_get("/api/memory", memory)
     app.router.add_get("/api/cluster_status", cluster_status)
+    app.router.add_get("/api/ha", ha)
     app.router.add_get("/api/insight", insight)
     app.router.add_get("/api/export_events", export_events)
     app.router.add_get("/api/timeline", timeline)

@@ -46,7 +46,8 @@ class ActorRecord:
 class GcsServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  store_path: str | None = None,
-                 export_dir: str | None = None):
+                 export_dir: str | None = None,
+                 ha_replica_id: str | None = None):
         from ant_ray_tpu._private.store_client import (  # noqa: PLC0415
             store_client_for,
         )
@@ -72,6 +73,30 @@ class GcsServer:
         # head anywhere can restore the tables (shared-store HA).
         self._store = store_client_for(store_path)
         self._durable = store_path is not None
+        # Replicated control plane (gcs_ha.HaCoordinator): with a
+        # replica id AND a shared store, this process is one member of
+        # a leader + warm-standby set — mutations are fenced on the
+        # lease, standbys tail the store and serve follower reads.
+        self._ha = None
+        if ha_replica_id is not None:
+            if not store_path:
+                raise ValueError(
+                    "GCS HA requires a shared store (--store)")
+            from ant_ray_tpu._private.gcs_ha import (  # noqa: PLC0415
+                HaCoordinator,
+            )
+
+            self._ha = HaCoordinator(self, ha_replica_id, store_path)
+        # Tables whose persisted copy lags in-memory truth by at most
+        # one flush period (high-churn; see _location_flush_loop).
+        self._dirty_nodes: set[NodeID] = set()
+        self._metrics_dirty = False
+        # Store-generation counter: bumped once per flush period in
+        # which ANY table write happened, advertised in the leader ad —
+        # followers skip the full table re-read when it hasn't moved,
+        # so idle-cluster sync cost is O(1), not O(state).
+        self._store_gen = 0
+        self._store_gen_dirty = False
         self._server = RpcServer(host, port)
         self._nodes: dict[NodeID, NodeInfo] = {}
         self._last_heartbeat: dict[NodeID, float] = {}
@@ -145,7 +170,7 @@ class GcsServer:
     # ------------------------------------------------------------- lifecycle
 
     def start(self) -> str:
-        self._server.routes({
+        handlers = {
             "RegisterNode": self._register_node,
             "Heartbeat": self._heartbeat,
             "GetAllNodes": self._get_all_nodes,
@@ -200,12 +225,21 @@ class GcsServer:
             "SpanEventsAdd": self._span_events_add,
             "SpanEventsGet": self._span_events_get,
             "MetricsExpire": self._metrics_expire,
+            "GetHaView": self._get_ha_view,
             "SubPoll": self._sub_poll,
             "PublishLogs": self._publish_logs,
             "ExportEventsGet": self._export_events_get,
             "Shutdown": self._shutdown_rpc,
-        })
-        if self._durable:
+        }
+        if self._ha is not None:
+            # Fence leader-only methods; reads and ring writes stay
+            # servable on any replica (split defined in wire_schema).
+            handlers = self._ha.guard_routes(handlers)
+        self._server.routes(handlers)
+        if self._durable and self._ha is None:
+            # Plain restart-FT: re-hydrate before serving.  HA replicas
+            # re-hydrate continuously (standby sync loop) and fully at
+            # promotion instead.
             self._load_tables()
         self.address = self._server.start()
         self._health_task = asyncio.run_coroutine_threadsafe(
@@ -213,8 +247,26 @@ class GcsServer:
         if self._durable:
             self._flush_task = asyncio.run_coroutine_threadsafe(
                 self._location_flush_loop(), self._io.loop)
-        logger.info("GCS listening on %s", self.address)
+        if self._ha is not None:
+            self._ha.start()
+        logger.info("GCS listening on %s%s", self.address,
+                    f" (HA replica {self._ha.replica_id})"
+                    if self._ha is not None else "")
         return self.address
+
+    def _leading(self) -> bool:
+        """True when this process owns the cluster (non-HA, or the HA
+        leader): the health-check and flush loops no-op on standbys."""
+        return self._ha is None or self._ha.is_leader_active()
+
+    async def _get_ha_view(self, _payload):
+        if self._ha is None:
+            return {"ha": False, "role": "leader",
+                    "replica_id": None, "address": self.address,
+                    "leader": self.address, "term": 0,
+                    "last_failover_ts": None,
+                    "replication_lag_s": None, "replicas": []}
+        return self._ha.view()
 
     # ---------------------------------------------------- persistence
 
@@ -223,10 +275,12 @@ class GcsServer:
             import pickle  # noqa: PLC0415
 
             self._store.put(table, key, pickle.dumps(value))
+            self._store_gen_dirty = True
 
     def _persist_del(self, table: str, key: str) -> None:
         if self._durable:
             self._store.delete(table, key)
+            self._store_gen_dirty = True
 
     def _save_actor(self, record: ActorRecord) -> None:
         self._persist("actors", record.spec.actor_id.hex(), {
@@ -251,7 +305,19 @@ class GcsServer:
     async def _location_flush_loop(self):
         while True:
             await asyncio.sleep(0.5)
+            if not self._leading():
+                continue        # standbys tail the store, never write it
             self._flush_locations()
+            self._flush_nodes()
+            self._flush_metrics()
+            if self._store_gen_dirty:
+                self._store_gen_dirty = False
+                self._store_gen += 1
+            if self._ha is not None:
+                # Leader heartbeat into the store: redirect target +
+                # the wall-clock stamp followers measure lag against +
+                # the store generation they sync against.
+                self._ha.write_leader_ad()
 
     def _flush_locations(self) -> None:
         if not self._durable or not self._dirty_locations:
@@ -264,50 +330,130 @@ class GcsServer:
             else:
                 self._persist_del("locations", oid.hex())
 
+    def _save_node(self, info: NodeInfo) -> None:
+        """Immediate node-table persistence for the low-churn
+        transitions (register / death / drain); the high-churn
+        availability view rides the dirty set + flush loop instead."""
+        self._persist("nodes", info.node_id.hex(), info)
+
+    def _flush_nodes(self) -> None:
+        if not self._durable or not self._dirty_nodes:
+            return
+        dirty, self._dirty_nodes = self._dirty_nodes, set()
+        for node_id in dirty:
+            info = self._nodes.get(node_id)
+            if info is not None:
+                self._persist("nodes", node_id.hex(), info)
+
+    def _flush_metrics(self) -> None:
+        """One pickled blob per flush period when anything changed:
+        followers serve metrics scrapes from it, and a restarted head
+        resumes its counters instead of zeroing every series."""
+        if not self._durable or not self._metrics_dirty:
+            return
+        self._metrics_dirty = False
+        self._persist("misc", "metrics", self._metrics)
+
     def _save_vcs(self) -> None:
         self._persist("misc", "virtual_clusters", self._virtual_clusters)
         self._persist("misc", "job_vc", self._job_vc)
 
-    def _load_tables(self) -> None:
+    def _snapshot_tables_from_store(self) -> dict:
+        """Read every persisted table into fresh containers (no side
+        effects, callable off the io loop): the follower sync loop and
+        the (re)start/promotion loaders share this one reader."""
         import pickle  # noqa: PLC0415
 
-        for key, blob in self._store.load_table("kv").items():
-            self._kv[key] = pickle.loads(blob)
-        for _key, blob in self._store.load_table("jobs").items():
+        store = self._store
+        snap: dict = {}
+        snap["kv"] = {key: pickle.loads(blob)
+                      for key, blob in store.load_table("kv").items()}
+        jobs = {}
+        for _key, blob in store.load_table("jobs").items():
             job_id, info = pickle.loads(blob)
-            self._jobs[job_id] = info
-        for _key, blob in self._store.load_table("actors").items():
-            snap = pickle.loads(blob)
+            jobs[job_id] = info
+        snap["jobs"] = jobs
+        actors: dict = {}
+        named: dict = {}
+        for _key, blob in store.load_table("actors").items():
+            row = pickle.loads(blob)
             record = ActorRecord(
-                spec=snap["spec"], state=snap["state"],
-                address=snap["address"], node_id=snap["node_id"],
-                restarts_used=snap["restarts_used"],
-                death_reason=snap["death_reason"])
-            self._actors[record.spec.actor_id] = record
+                spec=row["spec"], state=row["state"],
+                address=row["address"], node_id=row["node_id"],
+                restarts_used=row["restarts_used"],
+                death_reason=row["death_reason"])
+            actors[record.spec.actor_id] = record
             if record.spec.name and record.state != ACTOR_DEAD:
-                self._named_actors[
-                    (record.spec.namespace, record.spec.name)
-                ] = record.spec.actor_id
+                named[(record.spec.namespace, record.spec.name)] = \
+                    record.spec.actor_id
+        snap["actors"] = actors
+        snap["named_actors"] = named
+        pgs = {}
+        for _key, blob in store.load_table("pgs").items():
+            record = pickle.loads(blob)
+            pgs[record["pg_id"]] = record
+        snap["pgs"] = pgs
+        locations = {}
+        for _key, blob in store.load_table("locations").items():
+            oid, nodes = pickle.loads(blob)
+            locations[oid] = nodes
+        snap["locations"] = locations
+        blob = store.get("misc", "virtual_clusters")
+        snap["vcs"] = pickle.loads(blob) if blob else {}
+        blob = store.get("misc", "job_vc")
+        snap["job_vc"] = pickle.loads(blob) if blob else {}
+        nodes = {}
+        for _key, blob in store.load_table("nodes").items():
+            info = pickle.loads(blob)
+            nodes[info.node_id] = info
+        snap["nodes"] = nodes
+        blob = store.get("misc", "metrics")
+        snap["metrics"] = pickle.loads(blob) if blob else {}
+        return snap
+
+    def _apply_table_snapshot(self, snap: dict) -> None:
+        """Swap the snapshot in (io-loop only): whole-container
+        assignment, so a concurrently-dispatched read handler sees
+        either the previous generation or this one, never a mix."""
+        self._kv = snap["kv"]
+        self._jobs = snap["jobs"]
+        self._actors = snap["actors"]
+        self._named_actors = snap["named_actors"]
+        self._placement_groups = snap["pgs"]
+        self._object_locations = snap["locations"]
+        self._virtual_clusters = snap["vcs"]
+        self._job_vc = snap["job_vc"]
+        self._nodes = snap["nodes"]
+        self._metrics = snap["metrics"]
+
+    def _load_tables(self) -> None:
+        """Full re-hydrate + activation (restart FT): load every table,
+        then activate.  HA promotion snapshots OFF the io loop first
+        (a remote store's reads block on that very loop) and calls
+        :meth:`_activate_tables` directly."""
+        self._activate_tables(self._snapshot_tables_from_store())
+
+    def _activate_tables(self, snap: dict) -> None:
+        """Adopt a snapshot and kick the schedulers/reconcilers that a
+        passive follower sync must never run."""
+        self._apply_table_snapshot(snap)
+        # Restored nodes get one full heartbeat-timeout of grace before
+        # the health check may declare them dead; their view versions
+        # are gone, so the next beat is answered with a resync command.
+        now = time.monotonic()
+        for node_id in self._nodes:
+            self._last_heartbeat[node_id] = now
+        self._node_view_versions = {}
+        for record in self._actors.values():
             # Actors that were mid-scheduling when the head died get
             # re-kicked once the loop runs (nodes resync via heartbeat).
             if record.state in (ACTOR_PENDING, ACTOR_RESTARTING):
                 asyncio.run_coroutine_threadsafe(
                     self._reschedule_after_resync(record), self._io.loop)
-        for _key, blob in self._store.load_table("pgs").items():
-            record = pickle.loads(blob)
-            self._placement_groups[record["pg_id"]] = record
+        for record in self._placement_groups.values():
             if record["state"] == "PENDING":
                 asyncio.run_coroutine_threadsafe(
                     self._schedule_placement_group(record), self._io.loop)
-        blob = self._store.get("misc", "virtual_clusters")
-        if blob:
-            self._virtual_clusters = pickle.loads(blob)
-        blob = self._store.get("misc", "job_vc")
-        if blob:
-            self._job_vc = pickle.loads(blob)
-        for key, blob in self._store.load_table("locations").items():
-            oid, nodes = pickle.loads(blob)
-            self._object_locations[oid] = nodes
         # Liveness reconciliation: an actor restored as ALIVE may sit on
         # a node that never comes back (its daemon died during the head's
         # downtime, so no WorkerDied report will ever arrive).  After a
@@ -318,9 +464,10 @@ class GcsServer:
             asyncio.run_coroutine_threadsafe(
                 self._reconcile_actors_after_restart(), self._io.loop)
         logger.info(
-            "restored GCS state: %d actors, %d pgs, %d kv keys, %d jobs",
+            "restored GCS state: %d actors, %d pgs, %d kv keys, %d jobs"
+            ", %d nodes",
             len(self._actors), len(self._placement_groups),
-            len(self._kv), len(self._jobs))
+            len(self._kv), len(self._jobs), len(self._nodes))
 
     async def _reschedule_after_resync(self, record: ActorRecord):
         # Give nodes one heartbeat round to re-register before placing.
@@ -347,10 +494,16 @@ class GcsServer:
         sockets close with it anyway."""
         if self._health_task is not None:
             self._health_task.cancel()
+        if self._ha is not None:
+            # Releases a held lease so a standby takes over immediately
+            # (graceful failover) instead of waiting out the TTL.
+            self._ha.stop()
         flush_task = getattr(self, "_flush_task", None)
         if flush_task is not None:
             flush_task.cancel()
             self._flush_locations()  # final batch before shutdown
+            self._flush_nodes()
+            self._flush_metrics()
         # Drain the store's async write queue: acknowledged mutations
         # must reach the (possibly remote) store before the head exits.
         self._store.close()
@@ -430,6 +583,13 @@ class GcsServer:
         cursor = int(payload.get("cursor", 0))
         if cursor < 0:  # "start from now" — skip buffered history
             cursor = self._pub_events[-1][0] if self._pub_events else 0
+        elif cursor > self._pub_seq:
+            # A cursor ahead of our sequence belongs to a previous
+            # leader incarnation (the client's router absorbed the
+            # failover, so its error-path resubscribe never ran).
+            # Adopt "now" — resuming with the foreign cursor would
+            # silence the subscription forever.
+            cursor = self._pub_seq
         timeout = min(float(payload.get("timeout", 25.0)), 25.0)
         deadline = time.monotonic() + timeout
         while True:
@@ -455,6 +615,7 @@ class GcsServer:
     async def _register_node(self, info: NodeInfo):
         self._nodes[info.node_id] = info
         self._last_heartbeat[info.node_id] = time.monotonic()
+        self._save_node(info)
         # (Re-)registration carries a fresh full view and restarts the
         # node's version counter — drop any stale high-water mark so the
         # node's next deltas aren't rejected as old.
@@ -492,6 +653,7 @@ class GcsServer:
                     self._apply_drain(info, view.get("drain_reason", ""),
                                       view.get("drain_deadline", 0.0))
                 self._node_view_versions[node_id] = version
+                self._dirty_nodes.add(node_id)
             reply["synced"] = self._node_view_versions[node_id]
         elif node_id not in self._node_view_versions:
             reply["commands"] = ["resync"]
@@ -521,6 +683,7 @@ class GcsServer:
         info.draining = True
         info.drain_reason = reason
         info.drain_deadline = deadline
+        self._save_node(info)
         self._publish("node", {"node_id": info.node_id, "alive": True,
                                "draining": True, "reason": reason,
                                "deadline": deadline,
@@ -548,9 +711,14 @@ class GcsServer:
         timeout = cfg.heartbeat_period_s * cfg.num_heartbeats_timeout
         while True:
             await asyncio.sleep(period)
+            if not self._leading():
+                continue    # standbys observe, only the leader judges
             now = time.monotonic()
             for node_id, info in list(self._nodes.items()):
-                if info.alive and now - self._last_heartbeat[node_id] > timeout:
+                # Nodes synced from the store while standing by have no
+                # beat record yet — grant one from first sight.
+                last = self._last_heartbeat.setdefault(node_id, now)
+                if info.alive and now - last > timeout:
                     logger.warning("node %s missed heartbeats; marking dead",
                                    node_id.hex()[:8])
                     await self._on_node_death(node_id)
@@ -560,6 +728,7 @@ class GcsServer:
         if info is None or not info.alive:
             return
         info.alive = False
+        self._save_node(info)
         self._publish("node", {"node_id": node_id, "alive": False,
                                "address": info.address})
         self._expire_node_metrics(node_id)
@@ -719,11 +888,19 @@ class GcsServer:
         return True
 
     async def _task_events_get(self, payload):
+        payload = payload or {}
         limit = int(payload.get("limit", 50000))
         task_id = payload.get("task_id")
         events = list(self._task_events)
         if task_id is not None:
             events = [e for e in events if e.get("task_id") == task_id]
+        if self._ha is not None and not payload.get("local_only"):
+            # Sharded ring: merge every live replica's local slice
+            # (producers spread their flushes across replicas).
+            for peer_events in await self._ha.gather_ring(
+                    "TaskEventsGet", payload):
+                events.extend(peer_events)
+            events.sort(key=lambda e: e.get("ts") or 0.0)
         return events[-limit:]
 
     # ---------------------------------------------- task state API
@@ -736,19 +913,79 @@ class GcsServer:
                 "task_events_dropped": self._task_events_dropped,
                 **self._task_state.stats()}
 
+    async def _merged_task_records(self,
+                                   filters: dict) -> tuple[list, int, int]:
+        """HA fan-in for the state API: this replica's records plus
+        every live peer's (``local_only`` fan-out), merged with
+        sticky-terminal semantics, THEN filtered — filtering per
+        replica before the merge would let a ``state=RUNNING`` query
+        resurface a task another replica knows FAILED.  Returns
+        (records, dropped, events_dropped) with the drop counters
+        summed across replicas — a clipped view stays visibly
+        clipped after the merge."""
+        from ant_ray_tpu._private.task_state import (  # noqa: PLC0415
+            TaskStateTable,
+            merge_public_records,
+        )
+
+        local = self._task_state.list(filters={}, limit=1 << 30)
+        lists = [local["tasks"]]
+        dropped = local["num_tasks_dropped"]
+        events_dropped = self._task_events_dropped
+        for reply in await self._ha.gather_ring(
+                "ListTasks", {"limit": 1 << 30}):
+            lists.append(reply.get("tasks"))
+            dropped += reply.get("num_tasks_dropped", 0)
+            events_dropped += reply.get("task_events_dropped", 0)
+        merged = [r for r in merge_public_records(lists)
+                  if TaskStateTable._matches(r, filters)]
+        return merged, dropped, events_dropped
+
     async def _list_tasks(self, payload):
         payload = payload or {}
+        filters = {k: payload.get(k)
+                   for k in ("state", "name", "job_id", "actor_id",
+                             "node_id")}
+        limit = max(1, int(payload.get("limit", 1000)))
+        if self._ha is not None and not payload.get("local_only"):
+            records, dropped, events_dropped = \
+                await self._merged_task_records(filters)
+            # Offset-style continuation over the deterministically-
+            # sorted merged view (the single-replica seq cursor cannot
+            # span replicas); the token stays an opaque int either way.
+            # Known HA-mode tradeoffs, acceptable at the bounded table
+            # sizes (task_table_max_per_job): each page re-runs the
+            # full fan-in (no cross-page snapshot), and GC between
+            # pages can shift offsets — unlike the eviction-safe
+            # single-replica cursor.
+            offset = int(payload.get("token") or 0)
+            page = records[offset:offset + limit]
+            next_token = (offset + limit
+                          if offset + limit < len(records) else None)
+            return {"tasks": page, "next_token": next_token,
+                    "num_tasks_dropped": dropped,
+                    "task_events_dropped": events_dropped}
         reply = self._task_state.list(
-            filters={k: payload.get(k)
-                     for k in ("state", "name", "job_id", "actor_id",
-                               "node_id")},
-            limit=int(payload.get("limit", 1000)),
+            filters=filters,
+            limit=limit,
             token=payload.get("token"))
         reply["task_events_dropped"] = self._task_events_dropped
         return reply
 
     async def _get_task(self, payload):
         attempts = self._task_state.get(payload["task_id"])
+        if self._ha is not None and not payload.get("local_only"):
+            from ant_ray_tpu._private.task_state import (  # noqa: PLC0415
+                merge_public_records,
+            )
+
+            lists = [attempts]
+            for reply in await self._ha.gather_ring(
+                    "GetTask", {"task_id": payload["task_id"]}):
+                if reply:
+                    lists.append(reply.get("attempts"))
+            attempts = sorted(merge_public_records(lists),
+                              key=lambda r: r["attempt"])
         if not attempts:
             return None
         return {"task_id": payload["task_id"], "attempts": attempts,
@@ -756,8 +993,19 @@ class GcsServer:
 
     async def _summarize_tasks(self, payload):
         payload = payload or {}
-        reply = self._task_state.summarize(
-            filters={k: payload.get(k) for k in ("job_id", "node_id")})
+        filters = {k: payload.get(k) for k in ("job_id", "node_id")}
+        if self._ha is not None and not payload.get("local_only"):
+            from ant_ray_tpu._private.task_state import (  # noqa: PLC0415
+                summarize_public_records,
+            )
+
+            records, dropped, events_dropped = \
+                await self._merged_task_records(filters)
+            reply = summarize_public_records(records)
+            reply["num_tasks_dropped"] = dropped
+            reply["task_events_dropped"] = events_dropped
+            return reply
+        reply = self._task_state.summarize(filters=filters)
         reply["task_events_dropped"] = self._task_events_dropped
         return reply
 
@@ -778,11 +1026,17 @@ class GcsServer:
         return True
 
     async def _step_events_get(self, payload):
-        limit = int((payload or {}).get("limit", 20000))
-        rank = (payload or {}).get("rank")
+        payload = payload or {}
+        limit = int(payload.get("limit", 20000))
+        rank = payload.get("rank")
         records = list(self._step_events)
         if rank is not None:
             records = [r for r in records if r.get("rank") == rank]
+        if self._ha is not None and not payload.get("local_only"):
+            for peer_records in await self._ha.gather_ring(
+                    "StepEventsGet", payload):
+                records.extend(peer_records)
+            records.sort(key=lambda r: r.get("ts") or 0.0)
         return records[-limit:]
 
     # ------------------------------------------------------ span events
@@ -808,6 +1062,11 @@ class GcsServer:
                      if str(s.get("node_id", "")).startswith(node_id)]
         if errors_only:
             spans = [s for s in spans if s.get("error")]
+        if self._ha is not None and not payload.get("local_only"):
+            for peer_spans in await self._ha.gather_ring(
+                    "SpanEventsGet", payload):
+                spans.extend(peer_spans)
+            spans.sort(key=lambda s: s.get("ts") or 0.0)
         return spans[-limit:]
 
     # -------------------------------------------------------- metrics
@@ -850,6 +1109,7 @@ class GcsServer:
             # trace id (tracing_plane's rpc histograms send these).
             if payload.get("exemplar"):
                 entry["exemplar"] = payload["exemplar"]
+        self._metrics_dirty = True
         return True
 
     async def _metrics_get(self, _payload):
@@ -871,6 +1131,8 @@ class GcsServer:
                           for k, v in match.items())]
         for key in doomed:
             del self._metrics[key]
+        if doomed:
+            self._metrics_dirty = True
         return len(doomed)
 
     def _expire_node_metrics(self, node_id: NodeID) -> None:
@@ -882,6 +1144,8 @@ class GcsServer:
                   if entry["tags"].get("node_id") in (full, short)]
         for key in doomed:
             del self._metrics[key]
+        if doomed:
+            self._metrics_dirty = True
 
     # ------------------------------------------------------------- kv
 
@@ -895,7 +1159,37 @@ class GcsServer:
         return True
 
     async def _kv_get(self, payload):
-        return self._kv.get(payload["key"])
+        import pickle  # noqa: PLC0415
+
+        key = payload["key"]
+        value = self._kv.get(key)
+        if self._ha is None or self._ha.is_leader_active():
+            return value
+        if payload.get("fence"):
+            # Authoritative read-your-writes: ask the LEADER's
+            # in-memory table.  Correct on every store backend — a
+            # remote store's write-through is async (ack precedes
+            # landing), so even a fenced store read could miss the
+            # leader's latest acknowledged put; and the store, not the
+            # synced cache, decides deletes (a deleted key must not
+            # resurrect from sync lag).
+            leader = self._ha.leader_addr()
+            if leader:
+                try:
+                    return await self._clients.get(leader).call_async(
+                        "KVGet", {"key": key}, timeout=5)
+                except Exception:  # noqa: BLE001 — leader mid-death:
+                    pass           # fall back to the fenced store read
+            blob = await asyncio.to_thread(self._store.get, "kv", key)
+            return pickle.loads(blob) if blob is not None else None
+        if value is None:
+            # Plain cache miss: best-effort freshness via the store (a
+            # just-put key beats the sync period; a fence failure
+            # raises typed StoreFenceError instead of serving stale).
+            blob = await asyncio.to_thread(self._store.get, "kv", key)
+            if blob is not None:
+                value = pickle.loads(blob)
+        return value
 
     async def _kv_del(self, payload):
         self._persist_del("kv", payload["key"])
@@ -1809,13 +2103,19 @@ def main():  # pragma: no cover — exercised via subprocess in tests
     parser.add_argument("--export-dir", default="",
                         help="directory for export-event JSONL files "
                              "(empty = export pipeline disabled)")
+    parser.add_argument("--ha-replica-id", default="",
+                        help="join the replicated control plane as this "
+                             "replica (requires --store shared with the "
+                             "other replicas); the lease decides the "
+                             "leader, standbys serve follower reads")
     args = parser.parse_args()
 
     logging.basicConfig(
         level=global_config().log_level,
         format="[gcs %(levelname)s %(asctime)s] %(message)s")
     server = GcsServer(port=args.port, store_path=args.store or None,
-                       export_dir=args.export_dir or None)
+                       export_dir=args.export_dir or None,
+                       ha_replica_id=args.ha_replica_id or None)
     server.start()
     print(f"GCS_READY {server.address}", flush=True)
 

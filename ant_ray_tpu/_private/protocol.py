@@ -103,6 +103,26 @@ class RpcTimeoutError(RpcError):
     pass
 
 
+class NotLeaderError(RpcError):
+    """A GCS mutation reached a replica that is not (or no longer) the
+    leader.  Carries the leader's address when the replica knows it, so
+    the client-side router (gcs_client.GcsRouter) can redirect instead
+    of surfacing "no route".  Raised server-side by the HA mutation
+    guard; travels the wire pickled like any handler exception."""
+
+    def __init__(self, leader_addr: str = ""):
+        super().__init__(
+            "not the GCS leader"
+            + (f" (leader at {leader_addr})" if leader_addr
+               else " (no leader elected yet)"))
+        self.leader_addr = leader_addr
+
+    def __reduce__(self):
+        # Default exception pickling replays ``args`` (the formatted
+        # message) into __init__, which would masquerade as an address.
+        return (NotLeaderError, (self.leader_addr,))
+
+
 class _ChaosInjector:
     """Deterministic RPC fault injection (ref: src/ray/rpc/rpc_chaos.h:24).
 
@@ -724,7 +744,21 @@ class ClientPool:
         with self._lock:
             client = self._clients.get(address)
             if client is None or client._closed:
-                client = RpcClient(address)
+                if "," in address:
+                    # A comma-joined replica list is a GCS HA spec: the
+                    # pool hands back a leader-aware router with the
+                    # RpcClient call surface, so every existing
+                    # ``pool.get(gcs_address)`` call site gains
+                    # redirect-following + re-resolve failover without
+                    # changing.  (Import here: gcs_client imports this
+                    # module.)
+                    from ant_ray_tpu._private.gcs_client import (  # noqa: PLC0415
+                        GcsRouter,
+                    )
+
+                    client = GcsRouter(address, self)
+                else:
+                    client = RpcClient(address)
                 self._clients[address] = client
             return client
 

@@ -17,6 +17,14 @@ import os
 import sqlite3
 
 
+class StoreFenceError(RuntimeError):
+    """A remote-store read fence did not drain within its budget: every
+    read behind it could be stale.  Raised instead of proceeding — a
+    silently-stale read is exactly what follower reads (which build
+    read-your-writes on this fence) must not inherit.  The budget is
+    the ``store_fence_timeout_s`` config knob."""
+
+
 class StoreClient:
     """Interface: byte-valued tables keyed by string."""
 
@@ -159,6 +167,11 @@ class RemoteStoreClient(StoreClient):
                                                   timeout=10)
                     break
                 except Exception as e:  # noqa: BLE001 — store blip
+                    if self._closed:
+                        # close() gave up waiting: stop retrying into a
+                        # store that will never take this write instead
+                        # of spinning (and logging) forever.
+                        return
                     logging.getLogger(__name__).warning(
                         "store write %s retrying: %s", method, e)
                     await self._asyncio.sleep(delay)
@@ -176,13 +189,20 @@ class RemoteStoreClient(StoreClient):
 
         loop.call_soon_threadsafe(_enqueue)
 
-    def _read_fence(self, timeout: float = 10.0) -> None:
+    def _read_fence(self, timeout: float | None = None) -> None:
         """Read-your-writes: block until every write this client
         enqueued so far has landed (a fence item through the ordered
         queue).  Without it a get() racing a queued delete/put reads
-        the pre-write value."""
+        the pre-write value.  A fence that does not drain within the
+        budget (``store_fence_timeout_s`` by default) raises a typed
+        :class:`StoreFenceError` — proceeding would hand the caller
+        possibly-stale state with no signal."""
         import concurrent.futures
 
+        from ant_ray_tpu._private.config import global_config  # noqa: PLC0415
+
+        if timeout is None:
+            timeout = global_config().store_fence_timeout_s
         fence: concurrent.futures.Future = concurrent.futures.Future()
         loop = self._client._io.loop
 
@@ -201,9 +221,10 @@ class RemoteStoreClient(StoreClient):
         try:
             fence.result(timeout)
         except concurrent.futures.TimeoutError:
-            logging.getLogger(__name__).warning(
-                "store read fence timed out after %.0fs; reading "
-                "possibly-stale state", timeout)
+            raise StoreFenceError(
+                f"store read fence did not drain within {timeout:.0f}s "
+                f"(store {self.address} unreachable or write backlog); "
+                "refusing a possibly-stale read") from None
 
     def put(self, table, key, value):
         self._submit_write("StorePut", {"table": table, "key": key,

@@ -37,6 +37,12 @@ _MAX_BUFFER = 512
 # _MAX_BUFFER the buffer holds at most 2 batches against a dead GCS.
 _MAX_REQUEUE = _MAX_BUFFER
 _FLUSH_AGE_S = 1.0
+# Terminal events (finished/failed) kept for replay after a GCS-replica
+# failover: the replica that ingested them may die with its ring, and a
+# FAILED that un-happens is the one loss the state API must never show.
+# Bounded — only the recent tail replays; dedup is the state table's
+# sticky-terminal fold.
+_TERMINAL_TAIL = 256
 
 current_task = contextvars.ContextVar("art_current_task", default=None)
 
@@ -58,6 +64,14 @@ class TaskEventBuffer:
         self._retry: list[dict] | None = None  # one requeued batch
         self.dropped_total = 0                 # lifetime local drops
         self._dropped_unreported = 0           # delta not yet at the GCS
+        from collections import deque  # noqa: PLC0415
+
+        # Recent terminal events + the GCS ring epoch they were last
+        # published under (see module constant).  When the router's
+        # ring epoch moves (replica died / set changed), the next flush
+        # prepends this tail so terminal states survive the failover.
+        self._terminal_tail: deque = deque(maxlen=_TERMINAL_TAIL)
+        self._ring_epoch_seen = 0
 
     def record(self, runtime, *, task_id: str, name: str, event: str,
                actor_id: str | None = None,
@@ -89,6 +103,8 @@ class TaskEventBuffer:
         register = False
         with self._lock:
             self._events.append(entry)
+            if event in ("finished", "failed"):
+                self._terminal_tail.append(entry)
             now = time.monotonic()
             if len(self._events) >= _MAX_BUFFER or \
                     now - self._last_flush > _FLUSH_AGE_S:
@@ -131,9 +147,21 @@ class TaskEventBuffer:
         runtime = _runtime()
         if runtime is None:
             return
+        # Ring-failover replay: the GCS router bumps ring_epoch when
+        # the replica set changes (a replica died — possibly with this
+        # producer's ingested events in its ring).  Replaying the
+        # terminal tail costs one bounded batch; the GCS fold dedups.
+        epoch = getattr(
+            getattr(runtime, "_gcs", None), "ring_epoch", 0)
+        replay: list[dict] = []
+        prev_epoch_seen = None
         with self._lock:
+            if epoch != self._ring_epoch_seen:
+                prev_epoch_seen = self._ring_epoch_seen
+                self._ring_epoch_seen = epoch
+                replay = list(self._terminal_tail)
             if not self._events and self._retry is None \
-                    and not self._dropped_unreported:
+                    and not self._dropped_unreported and not replay:
                 return
             batch, self._events = self._events, []
             retry, self._retry = self._retry, None
@@ -143,14 +171,34 @@ class TaskEventBuffer:
             dropped, self._dropped_unreported = \
                 self._dropped_unreported, 0
             self._last_flush = time.monotonic()
-        payload = {"events": (retry or []) + batch}
+        payload = {"events": replay + (retry or []) + batch}
         if dropped:
             payload["dropped"] = dropped
         try:
-            runtime._send_oneway(runtime.gcs_address, "TaskEventsAdd",
-                                 payload)
+            if replay:
+                # A replay batch is the durability mechanism itself —
+                # send it ACKNOWLEDGED (bounded timeout) rather than
+                # fire-and-forget: a oneway's failure is swallowed
+                # inside the async send, which would mark the epoch
+                # seen while the tail never landed.  Failure lands in
+                # the except below, which rewinds the epoch mark.
+                call = getattr(getattr(runtime, "_gcs", None),
+                               "call", None)
+                if call is not None:
+                    call("TaskEventsAdd", payload, timeout=2)
+                else:        # bare fake/legacy runtime: best effort
+                    runtime._send_oneway(runtime.gcs_address,
+                                         "TaskEventsAdd", payload)
+            else:
+                runtime._send_oneway(runtime.gcs_address,
+                                     "TaskEventsAdd", payload)
         except Exception:  # noqa: BLE001 — observability is best-effort
             with self._lock:
+                # A replay that never left rewinds the epoch mark so
+                # the next flush tries it again (the tail itself is
+                # never consumed — it lives until overwritten).
+                if prev_epoch_seen is not None:
+                    self._ring_epoch_seen = prev_epoch_seen
                 # The popped batch is NOT silently lost: requeue it
                 # once under the bound; the already-retried part and
                 # anything over the bound is dropped AND counted.

@@ -256,42 +256,15 @@ class TaskStateTable:
     def summarize(self, filters: dict | None = None) -> dict:
         """Group-by-name rollup: per-state counts plus run-duration
         stats (mean/p50/p99 over attempts with a measured run_s),
-        computed here so the client never pulls the table."""
+        computed here so the client never pulls the table.  ONE rollup
+        implementation: delegates to :func:`summarize_public_records`,
+        which the HA cross-replica merge path uses too."""
         filters = filters or {}
-        groups: dict[str, dict] = {}
-        durations: dict[str, list[float]] = {}
-        for record in self._records.values():
-            if not self._matches(record, filters):
-                continue
-            name = record["name"]
-            group = groups.get(name)
-            if group is None:
-                group = groups[name] = {
-                    "state_counts": {}, "total": 0, "failed": 0}
-                durations[name] = []
-            group["total"] += 1
-            counts = group["state_counts"]
-            counts[record["state"]] = counts.get(record["state"], 0) + 1
-            if record["state"] == FAILED:
-                group["failed"] += 1
-            d = self._durations(record)
-            if d["run_s"] is not None:
-                durations[name].append(d["run_s"])
-        for name, group in groups.items():
-            runs = sorted(durations[name])
-            if runs:
-                group["run_s"] = {
-                    "count": len(runs),
-                    "mean": sum(runs) / len(runs),
-                    "p50": runs[len(runs) // 2],
-                    "p99": runs[min(len(runs) - 1,
-                                    int(0.99 * (len(runs) - 1)))],
-                }
-            else:
-                group["run_s"] = None
-        return {"summary": groups,
-                "total_tasks": sum(g["total"] for g in groups.values()),
-                "num_tasks_dropped": self.num_tasks_dropped}
+        reply = summarize_public_records(
+            self._public(r) for r in self._records.values()
+            if self._matches(r, filters))
+        reply["num_tasks_dropped"] = self.num_tasks_dropped
+        return reply
 
     def stats(self) -> dict:
         return {
@@ -300,6 +273,87 @@ class TaskStateTable:
             "events_folded": self.events_folded,
             "dropped_by_job": dict(self._dropped_by_job),
         }
+
+
+# ------------------------------------------------ cross-replica merge
+# (GCS HA: the task-event ring is sharded across replicas by producer —
+#  ListTasks/GetTask/SummarizeTasks on any replica fan out local_only
+#  queries and merge HERE, with the same forward-only / sticky-terminal
+#  rules as apply(), so a task whose events landed on two replicas
+#  still reads as one record and FAILED can never un-happen.)
+
+_MERGE_FILL_NONE = ("submitted_ts", "started_ts", "end_ts", "actor_id",
+                    "parent_task_id", "trace_id", "error", "pid")
+_MERGE_FILL_EMPTY = ("name", "job_id", "node_id")
+
+
+def merge_public_records(record_lists) -> list[dict]:
+    """Merge per-replica public task records (as returned by
+    :meth:`TaskStateTable.list`) keyed by ``(task_id, attempt)``.
+    State moves by strictly-greater rank (terminal sticky), missing
+    timestamps/identity fields fill from whichever replica knows them,
+    and durations are recomputed from the merged timestamps."""
+    out: dict[tuple, dict] = {}
+    for records in record_lists:
+        for rec in records or ():
+            key = (rec["task_id"], rec["attempt"])
+            cur = out.get(key)
+            if cur is None:
+                out[key] = dict(rec)
+                continue
+            for field in _MERGE_FILL_NONE:
+                if cur.get(field) is None and rec.get(field) is not None:
+                    cur[field] = rec[field]
+            for field in _MERGE_FILL_EMPTY:
+                if not cur.get(field) and rec.get(field):
+                    cur[field] = rec[field]
+            if STATE_RANK[rec["state"]] > STATE_RANK[cur["state"]]:
+                cur["state"] = rec["state"]
+    merged = list(out.values())
+    for rec in merged:
+        rec.update(TaskStateTable._durations(rec))
+    # Deterministic order so offset-style continuation over the merged
+    # view walks each record exactly once.
+    merged.sort(key=lambda r: (r.get("submitted_ts")
+                               or r.get("started_ts")
+                               or r.get("end_ts") or 0.0,
+                               r["task_id"], r["attempt"]))
+    return merged
+
+
+def summarize_public_records(records) -> dict:
+    """:meth:`TaskStateTable.summarize` semantics over (merged) public
+    records — the rollup a replica computes after the HA fan-in."""
+    groups: dict[str, dict] = {}
+    durations: dict[str, list[float]] = {}
+    for record in records:
+        name = record["name"]
+        group = groups.get(name)
+        if group is None:
+            group = groups[name] = {
+                "state_counts": {}, "total": 0, "failed": 0}
+            durations[name] = []
+        group["total"] += 1
+        counts = group["state_counts"]
+        counts[record["state"]] = counts.get(record["state"], 0) + 1
+        if record["state"] == FAILED:
+            group["failed"] += 1
+        if record.get("run_s") is not None:
+            durations[name].append(record["run_s"])
+    for name, group in groups.items():
+        runs = sorted(durations[name])
+        if runs:
+            group["run_s"] = {
+                "count": len(runs),
+                "mean": sum(runs) / len(runs),
+                "p50": runs[len(runs) // 2],
+                "p99": runs[min(len(runs) - 1,
+                                int(0.99 * (len(runs) - 1)))],
+            }
+        else:
+            group["run_s"] = None
+    return {"summary": groups,
+            "total_tasks": sum(g["total"] for g in groups.values())}
 
 
 def ingest_overhead_ns(n: int = 20000) -> float:

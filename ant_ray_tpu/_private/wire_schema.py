@@ -43,7 +43,9 @@ METHODS: dict[str, dict] = {
                     "bool (node enters DRAINING: schedulers skip it, "
                     "Serve/Train migrate off it)"),
     "KVPut": _m("gcs", "{key, value, overwrite?}", "bool"),
-    "KVGet": _m("gcs", "{key}", "bytes|None"),
+    "KVGet": _m("gcs", "{key, fence?}",
+                "bytes|None (fence: a follower answers through the "
+                "shared store — read-your-writes across the HA split)"),
     "KVDel": _m("gcs", "{key}", "bool"),
     "KVTake": _m("gcs", "{key}", "bytes|None (atomic get+del)"),
     "KVKeys": _m("gcs", "{prefix}", "[key]"),
@@ -94,17 +96,19 @@ METHODS: dict[str, dict] = {
     "InsightGet": _m("gcs", "{limit?}", "[event]"),
     "TaskEventsAdd": _m("gcs", "{events: [{task_id, name, event, ...}], "
                                "dropped?}", "bool"),
-    "TaskEventsGet": _m("gcs", "{limit?, task_id?}", "[event]"),
+    "TaskEventsGet": _m("gcs", "{limit?, task_id?, local_only?}",
+                        "[event] (local_only: this replica's ring "
+                        "slice only — the HA merge fan-out)"),
     "ListTasks": _m("gcs",
                     "{state?, name?, job_id?, actor_id?, node_id?, "
-                    "limit?, token?}",
+                    "limit?, token?, local_only?}",
                     "{tasks: [record], next_token?, num_tasks_dropped, "
                     "task_events_dropped} — served from the bounded "
                     "GCS state table with server-side filtering; the "
                     "client never pulls the raw event ring"),
-    "GetTask": _m("gcs", "{task_id}",
+    "GetTask": _m("gcs", "{task_id, local_only?}",
                   "{task_id, attempts: [record], stats}|None"),
-    "SummarizeTasks": _m("gcs", "{job_id?, node_id?}",
+    "SummarizeTasks": _m("gcs", "{job_id?, node_id?, local_only?}",
                          "{summary: {name: {state_counts, run_s: "
                          "{mean, p50, p99}}}, total_tasks, "
                          "num_tasks_dropped, task_events_dropped}"),
@@ -112,16 +116,24 @@ METHODS: dict[str, dict] = {
                    "[{job_id, driver_address, started_at}]"),
     "StepEventsAdd": _m("gcs", "{records: [{step, ts, total_s, phases, "
                                "mfu?, rank}]}", "bool"),
-    "StepEventsGet": _m("gcs", "{limit?, rank?}", "[record]"),
+    "StepEventsGet": _m("gcs", "{limit?, rank?, local_only?}",
+                        "[record]"),
     "SpanEventsAdd": _m("gcs", "{spans: [{trace_id, span_id, parent_id, "
                                "name, ts, dur_s, stages?, attrs?, "
                                "error?, node_id, pid}]}", "bool"),
     "SpanEventsGet": _m("gcs", "{limit?, trace_id?, node_id?, "
-                               "errors_only?}", "[span]"),
+                               "errors_only?, local_only?}", "[span]"),
     "MetricsExpire": _m("gcs", "{match_tags?, name_prefix?}",
                         "int (series dropped; per-entity gauge owners "
                         "call this at teardown so dead nodes/replicas "
                         "don't live in /metrics forever)"),
+    "GetHaView": _m("gcs", "{}",
+                    "{ha, role, replica_id, address, leader, term, "
+                    "last_failover_ts, replication_lag_s, replicas: "
+                    "[{replica_id, address, role, lag_s, age_s}]} — "
+                    "served by ANY replica (leader or standby); the "
+                    "client router re-resolves the leader through it "
+                    "after a failover"),
     "SubPoll": _m("gcs", "{channels, cursor, timeout}",
                   "{cursor, events: [(seq, channel, data)]}"),
     "PublishLogs": _m("gcs", "{node, entries: [{worker, pid, job_id?, "
@@ -252,3 +264,48 @@ METHODS: dict[str, dict] = {
     "LeaseRelease": _m("store", "{name, owner}", "bool"),
     "LeaseInfo": _m("store", "{name}", "{owner, expires_at}|None"),
 }
+
+
+# ---------------------------------------------------------------- HA split
+#
+# The replicated-GCS read/write classification (the HA analogue of the
+# reference's GCS-FT blueprint): a GCS method is exactly one of
+#
+# * a FOLLOWER READ — servable by any replica from its store-synced
+#   tables (staleness bounded by gcs_ha_sync_period_s); the client
+#   router fans these out to standbys so read load scales with them;
+# * a RING WRITE — a high-churn bounded-ring ingestion (task / step /
+#   span events) accepted on ANY replica, sharded by producer key
+#   client-side and merged at query time (the matching *Get / ListTasks
+#   family accepts a ``local_only`` payload key for the merge fan-out);
+# * everything else — a MUTATION, leader-only: a follower receiving one
+#   replies with a typed NotLeaderError redirect.
+#
+# Follower-side enforcement and client-side routing both read THESE
+# sets, so the split cannot drift between server and router.
+
+GCS_FOLLOWER_READS = frozenset({
+    "GetAllNodes", "ClusterResources", "AvailableResources",
+    "KVGet", "KVKeys",
+    "ListActors", "ListObjects", "ListPlacementGroups",
+    "ListVirtualClusters", "ListJobs",
+    "MetricsGet", "InsightGet",
+    "TaskEventsGet", "StepEventsGet", "SpanEventsGet",
+    "ListTasks", "GetTask", "SummarizeTasks",
+    "GetHaView",
+})
+
+GCS_RING_WRITES = frozenset({
+    "TaskEventsAdd", "StepEventsAdd", "SpanEventsAdd",
+})
+
+
+def gcs_methods() -> frozenset:
+    return frozenset(m for m, e in METHODS.items()
+                     if e["service"].split("|")[0] == "gcs")
+
+
+def gcs_mutations() -> frozenset:
+    """Leader-only methods: the GCS surface minus follower reads and
+    any-replica ring writes."""
+    return gcs_methods() - GCS_FOLLOWER_READS - GCS_RING_WRITES

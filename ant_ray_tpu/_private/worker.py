@@ -356,11 +356,17 @@ class Worker:
 
     def shutdown(self):
         with self._lock:
-            if self.runtime is not None:
-                self.runtime.shutdown()
-            self.runtime = None
-            self.mode = None
-            self.job_id = None
+            try:
+                if self.runtime is not None:
+                    self.runtime.shutdown()
+            finally:
+                # The disconnect must stick even when a teardown step
+                # throws (a dying cluster races its own disconnect):
+                # a runtime left behind here turns the NEXT init() in
+                # this process into "init() called twice".
+                self.runtime = None
+                self.mode = None
+                self.job_id = None
 
 
 global_worker = Worker()

@@ -148,7 +148,10 @@ _exported_config_env: list = []
 def shutdown() -> None:
     from ant_ray_tpu._private import task_events  # noqa: PLC0415
 
-    task_events.flush()  # drain before the runtime goes away
+    try:
+        task_events.flush()  # drain before the runtime goes away
+    except Exception:  # noqa: BLE001 — observability must not block
+        pass             # the disconnect (events are best-effort)
     global_worker.shutdown()
     # Undo _system_config env exports (restoring any pre-existing user
     # value) so the next init() in this process starts clean.

@@ -131,6 +131,10 @@ def cmd_status(client: StateClient, args) -> int:
         tasks = client.call("SummarizeTasks")
     except Exception:  # noqa: BLE001 — pre-observatory head
         tasks = None
+    try:
+        ha = client.call("GetHaView")
+    except Exception:  # noqa: BLE001 — pre-HA head
+        ha = None
     stores = []
     for node_id, address in client.alive_nodes().items():
         try:
@@ -145,6 +149,7 @@ def cmd_status(client: StateClient, args) -> int:
             actor_states.get(actor["state"], 0) + 1
     payload = {
         "address": client.address,
+        "ha": ha,
         "nodes": {"alive": sum(i.alive for i in nodes.values()),
                   "dead": sum(not i.alive for i in nodes.values()),
                   "draining": sum(
@@ -166,6 +171,24 @@ def cmd_status(client: StateClient, args) -> int:
     def render(p):
         n = p["nodes"]
         print(f"cluster   {p['address']}")
+        view = p.get("ha")
+        if view and view.get("ha"):
+            print(f"control   leader {view.get('leader') or '?'} "
+                  f"(term {view.get('term')})")
+            standbys = [r for r in view.get("replicas", ())
+                        if r.get("role") != "leader"]
+            for r in standbys:
+                lag = r.get("lag_s")
+                print(f"  standby {r.get('address')} "
+                      f"[{r.get('replica_id')}]"
+                      + (f" lag {lag * 1000:.0f}ms"
+                         if lag is not None else ""))
+            failover = view.get("last_failover_ts")
+            if failover:
+                import datetime  # noqa: PLC0415
+
+                stamp = datetime.datetime.fromtimestamp(failover)
+                print(f"  last failover {stamp:%Y-%m-%d %H:%M:%S}")
         print(f"nodes     {n['alive']} alive / {n['dead']} dead"
               + (f" / {n['draining']} draining" if n["draining"]
                  else ""))

@@ -1,6 +1,12 @@
 """Multi-node-on-one-host test cluster (ref: python/ray/cluster_utils.py:135
 — the mechanism by which all distributed scheduling/FT tests run without
-real machines: N node daemons, each a full node, on one host)."""
+real machines: N node daemons, each a full node, on one host).
+
+With ``head_node_args={"gcs_standbys": N}`` the control plane itself is
+replicated: N+1 GCS processes share the session store, the lease elects
+a leader, and every address handed to daemons/drivers is the full
+comma-joined replica list — so ``kill_gcs_leader()`` exercises a real
+failover, not a restart."""
 
 from __future__ import annotations
 
@@ -16,12 +22,15 @@ class Cluster:
     def __init__(self, initialize_head: bool = True,
                  head_node_args: dict | None = None):
         self._session_dir = services.new_session_dir()
-        self._procs: list[subprocess.Popen] = []
+        self._gcs_procs: list[tuple[subprocess.Popen, str]] = []
+        self._node_procs: list[subprocess.Popen] = []
         self._node_addresses: list[str] = []
-        self.gcs_address: str | None = None
+        self._gcs_standbys = 0
+        self._gcs_replica_seq = 0
         self._pool = ClientPool()
         self._saved_env: list[tuple[str, str | None]] = []
         head_node_args = dict(head_node_args or {})
+        self._gcs_standbys = int(head_node_args.pop("gcs_standbys", 0))
         # _system_config flags travel to every daemon this cluster spawns
         # as ART_<NAME> env vars — same channel api.init uses
         # (ref: _system_config embedded into raylet launch,
@@ -37,41 +46,126 @@ class Cluster:
             self.add_node(**head_node_args)
 
     @property
+    def gcs_address(self) -> str | None:
+        """The GCS endpoint spec handed to daemons/drivers: a single
+        address, or the comma-joined replica list when standbys exist
+        (ClientPool resolves that spec to a leader-aware router)."""
+        if not self._gcs_procs:
+            return None
+        return ",".join(addr for _proc, addr in self._gcs_procs)
+
+    @property
     def address(self) -> str:
-        assert self.gcs_address is not None, "cluster has no head"
+        assert self._gcs_procs, "cluster has no head"
         return self.gcs_address
+
+    # ------------------------------------------------------------ members
+
+    def _start_gcs_replica(self) -> str:
+        replica_id = f"r{self._gcs_replica_seq}"
+        self._gcs_replica_seq += 1
+        ha = self._gcs_standbys > 0 or self._gcs_replica_seq > 1
+        proc, address = services.start_gcs(
+            self._session_dir,
+            ha_replica_id=replica_id if ha else None)
+        self._gcs_procs.append((proc, address))
+        return address
 
     def add_node(self, num_cpus: int | None = None,
                  num_tpus: int | None = None,
                  resources: dict | None = None,
                  labels: dict | None = None) -> str:
-        """Start one more node daemon; the first call also starts the GCS."""
-        if self.gcs_address is None:
-            gcs_proc, self.gcs_address = services.start_gcs(self._session_dir)
-            self._procs.append(gcs_proc)
+        """Start one more node daemon; the first call also starts the
+        GCS (plus any configured standbys)."""
+        if not self._gcs_procs:
+            self._start_gcs_replica()
+            for _ in range(self._gcs_standbys):
+                self._start_gcs_replica()
         node_resources = services.default_resources(
             num_cpus if num_cpus is not None else 1, num_tpus, resources)
         proc, address = services.start_node(
             self.gcs_address, node_resources, self._session_dir, labels)
-        self._procs.append(proc)
+        self._node_procs.append(proc)
         self._node_addresses.append(address)
         return address
 
+    def add_gcs_standby(self) -> str:
+        """Grow the control-plane replica set by one warm standby.
+        Existing clients learn it through their next HA-view refresh;
+        new daemons/drivers get it in the address spec."""
+        assert self._gcs_procs, "start a head first"
+        # The head must itself be lease-electing: a standby beside a
+        # non-HA head would grab the (uncontested) lease and split-brain.
+        assert self._gcs_standbys > 0, \
+            "construct the Cluster with head_node_args={'gcs_standbys': N}"
+        return self._start_gcs_replica()
+
+    # ---------------------------------------------------------- GCS chaos
+
     def kill_gcs(self) -> None:
-        """Kill the head's GCS process (simulates head failure)."""
-        assert self.gcs_address is not None
-        proc = self._procs[0]
+        """Kill the head's (first) GCS process (simulates head failure
+        in the single-replica restart-FT scenario)."""
+        assert self._gcs_procs
+        proc, _addr = self._gcs_procs[0]
         proc.kill()
         proc.wait(timeout=5)
 
     def restart_gcs(self) -> None:
         """Restart the GCS on the same port, resuming from its sqlite
-        store (the test_gcs_fault_tolerance scenario)."""
-        assert self.gcs_address is not None
-        port = int(self.gcs_address.rsplit(":", 1)[1])
-        proc, address = services.start_gcs(self._session_dir, port=port)
-        self._procs[0] = proc
-        assert address == self.gcs_address
+        store (the test_gcs_fault_tolerance scenario).  On a replicated
+        cluster the restarted process rejoins as an HA replica (fresh
+        id) — restarting it lease-less beside live standbys would make
+        it an unfenced second leader over the same store."""
+        assert self._gcs_procs
+        old_proc, old_addr = self._gcs_procs[0]
+        port = int(old_addr.rsplit(":", 1)[1])
+        replica_id = None
+        if self._gcs_standbys > 0:
+            replica_id = f"r{self._gcs_replica_seq}"
+            self._gcs_replica_seq += 1
+        proc, address = services.start_gcs(self._session_dir, port=port,
+                                           ha_replica_id=replica_id)
+        assert address == old_addr
+        self._gcs_procs[0] = (proc, address)
+
+    def gcs_leader_address(self, timeout: float = 10.0) -> str:
+        """The current leader's address, per whichever replica answers
+        the HA view first."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        last_err: Exception | None = None
+        while time.monotonic() < deadline:
+            for _proc, addr in self._gcs_procs:
+                try:
+                    view = self._pool.get(addr).call("GetHaView", {},
+                                                     timeout=2)
+                except Exception as e:  # noqa: BLE001 — replica down
+                    last_err = e
+                    continue
+                # Only the leader's own word counts: a standby's view
+                # can still name the replica that just died.
+                if view.get("role") == "leader":
+                    return view["address"]
+            time.sleep(0.1)
+        raise RuntimeError(f"no GCS leader elected: {last_err}")
+
+    def kill_gcs_leader(self) -> str:
+        """Find the current leader, SIGKILL it, and return its address
+        — the control-plane loss the replicated GCS must absorb.  The
+        dead replica stays out of the set (no restart): failover, not
+        recovery, is under test."""
+        leader = self.gcs_leader_address()
+        for index, (proc, addr) in enumerate(self._gcs_procs):
+            if addr == leader:
+                proc.kill()
+                proc.wait(timeout=5)
+                del self._gcs_procs[index]
+                return addr
+        raise RuntimeError(f"leader {leader} is not one of this "
+                           "cluster's GCS processes")
+
+    # -------------------------------------------------------------- nodes
 
     def drain_node(self, address: str, reason: str = "preemption",
                    deadline_s: float = 30.0) -> None:
@@ -86,7 +180,7 @@ class Cluster:
     def remove_node(self, address: str, graceful: bool = False) -> None:
         """Kill a node daemon (simulates node failure when not graceful)."""
         index = self._node_addresses.index(address)
-        proc = self._procs[1 + index]  # procs[0] is the GCS
+        proc = self._node_procs[index]
         if graceful:
             try:
                 self._pool.get(address).call("Shutdown", timeout=2)
@@ -104,10 +198,11 @@ class Cluster:
 
     def shutdown(self):
         self._pool.close_all()
-        services.stop_processes(self._procs)
-        self._procs.clear()
+        procs = [p for p, _a in self._gcs_procs] + self._node_procs
+        services.stop_processes(procs)
+        self._gcs_procs.clear()
+        self._node_procs.clear()
         self._node_addresses.clear()
-        self.gcs_address = None
         for name, old in self._saved_env:
             if old is None:
                 os.environ.pop(name, None)

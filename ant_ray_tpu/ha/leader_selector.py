@@ -21,10 +21,13 @@ acquire / renew / fence / release):
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 import uuid
+
+logger = logging.getLogger(__name__)
 
 
 class HeadNodeLeaderSelector:
@@ -61,6 +64,18 @@ class _LeaseSelectorBase(HeadNodeLeaderSelector):
         self._stop = threading.Event()
         self._became_leader = threading.Event()
         self._thread: threading.Thread | None = None
+        # Fencing clock: the holder may act as leader only while
+        # monotonic() < lease_valid_until (set at every successful
+        # acquire/renew).  Consumers check it BEFORE applying a
+        # mutation, so an expired-but-not-yet-demoted holder rejects
+        # late writes instead of split-braining (the "old leader's late
+        # mutation is rejected" guarantee).
+        self.lease_valid_until: float = 0.0
+        # Optional role-transition callbacks, invoked from the poll
+        # thread (consumers post to their own loop): the replicated GCS
+        # hangs its promote/demote sequences here.
+        self.on_promote = None
+        self.on_demote = None
 
     # Backend hooks -----------------------------------------------------
 
@@ -79,17 +94,45 @@ class _LeaseSelectorBase(HeadNodeLeaderSelector):
         self._thread = threading.Thread(target=self._run, daemon=True)
         self._thread.start()
 
+    def _guarded(self, op) -> bool:
+        """A raising backend (shared-FS blip raising raw OSError from
+        the file lease) must read as 'could not prove the lease', not
+        kill the poll thread — a dead selector is a silent zombie that
+        can neither lead nor fail over."""
+        try:
+            return op()
+        except Exception:  # noqa: BLE001 — backend blip: stand by
+            logger.exception("lease backend error (treated as failure)")
+            return False
+
     def _run(self) -> None:
         while not self._stop.is_set():
             if self.role == "leader":
-                if not self._renew():
+                # Stamp the validity window BEFORE the backend round
+                # trip: the lease is good for ttl from (at latest) the
+                # moment the renew was issued, so the window is
+                # conservative even when the renew itself is slow.
+                stamp = time.monotonic() + self._ttl
+                if self._guarded(self._renew):
+                    self.lease_valid_until = stamp
+                else:
                     # Fenced (or the backend is gone): a leader that
                     # cannot prove its lease must not act.
+                    self.lease_valid_until = 0.0
                     self.role = "standby"
                     self._became_leader.clear()
-            elif self._try_acquire():
-                self.role = "leader"
-                self._became_leader.set()
+                    callback = self.on_demote
+                    if callback is not None:
+                        callback()
+            else:
+                stamp = time.monotonic() + self._ttl
+                if self._guarded(self._try_acquire):
+                    self.lease_valid_until = stamp
+                    self.role = "leader"
+                    self._became_leader.set()
+                    callback = self.on_promote
+                    if callback is not None:
+                        callback()
             self._stop.wait(self._renew_period)
 
     def wait_until_leader(self, timeout: float | None = None) -> bool:
@@ -108,6 +151,7 @@ class _LeaseSelectorBase(HeadNodeLeaderSelector):
             self._release()
         except Exception:  # noqa: BLE001 — best effort
             pass
+        self.lease_valid_until = 0.0
         self.role = "standby"
         self._became_leader.clear()
 

@@ -551,6 +551,7 @@ RPC_METHOD_PLANES: dict[str, str] = {
     "SpanEventsAdd": "observability", "SpanEventsGet": "observability",
     "SubPoll": "control", "PublishLogs": "observability",
     "ExportEventsGet": "observability", "Shutdown": "control",
+    "GetHaView": "control",
     # ---- node daemon
     "LeaseWorker": "scheduling", "ReturnWorker": "scheduling",
     "RegisterWorker": "scheduling", "StartActorWorker": "scheduling",

@@ -160,6 +160,22 @@ class ChaosSchedule:
             label=label or getattr(fn, "__name__", "action"), fn=fn))
         return self
 
+    def kill_leader(self, step: int, cluster) -> "ChaosSchedule":
+        """Schedule a GCS **leader kill** at logical step ``step`` —
+        the control-plane-loss action for a replicated head
+        (``Cluster(head_node_args={"gcs_standbys": N})``).  Resolves
+        the leader at fire time (it may have moved since scheduling),
+        SIGKILLs that replica, and leaves it dead: the cluster must
+        fail over to a standby, not wait for a restart.  The killed
+        address lands in :attr:`killed_leaders` for assertions."""
+        self.killed_leaders: list[str] = getattr(
+            self, "killed_leaders", [])
+
+        def _kill():
+            self.killed_leaders.append(cluster.kill_gcs_leader())
+
+        return self.at_step(step, _kill, label="kill_leader")
+
     def fire(self, step: int) -> list[str]:
         """Run every not-yet-fired action scheduled at or before
         ``step`` (deterministic order: step, then registration).

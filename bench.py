@@ -216,6 +216,13 @@ _GUARDED_METRICS = {
     # package.  Guarded "lower" with a hard 10s budget in run_child —
     # a lint too slow to run every commit stops being run at all.
     "lint_full_pass_s": "lower",
+    # No-SPOF control plane (PR 13): the replicated head's MTTR (kill
+    # → first acknowledged mutation on the promoted standby; "lower")
+    # and the productive-step fraction of a fit run across a leader
+    # kill ("higher", acceptance bar 0.90) — the two numbers that say a
+    # control-plane loss is survived, not merely restarted around.
+    "gcs_failover_time_s": "lower",
+    "goodput_under_leader_kill": "higher",
     # State observatory (PR 11): the per-event fold cost on the GCS
     # TaskEventsAdd ingest path (hard 4 µs budget in microbench — the
     # fold taxes EVERY task the cluster runs) and the server-side

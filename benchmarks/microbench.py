@@ -455,6 +455,110 @@ def main() -> None:
                           "bench_error":
                           f"resilience bench failed: {e!r}"[:300]}))
 
+    # ---- control-plane HA: failover MTTR + goodput under a leader
+    # kill.  One replicated head (leader + 2 warm standbys over the
+    # shared store) takes two SIGKILLs of whoever currently leads:
+    # (a) at rest — `gcs_failover_time_s` is the gap from the kill to
+    # the first acknowledged mutation on the promoted standby (lease
+    # expiry + promotion + client re-resolve: the control plane's
+    # MTTR, the number the lease-TTL knob trades against); and
+    # (b) mid-fit — `goodput_under_leader_kill` is unique productive
+    # steps over total step executions while the leader dies under an
+    # active training run (1.0 = the control-plane loss unwound
+    # nothing and recomputed nothing; acceptance bar 0.90).
+    try:
+        import tempfile  # noqa: PLC0415
+        import threading  # noqa: PLC0415
+
+        from ant_ray_tpu.cluster_utils import Cluster  # noqa: PLC0415
+        from ant_ray_tpu.train import (  # noqa: PLC0415
+            FailureConfig,
+            JaxTrainer,
+            RunConfig,
+            ScalingConfig,
+        )
+        from ant_ray_tpu.util.chaos import ChaosSchedule  # noqa: PLC0415
+
+        cluster = Cluster(head_node_args={"num_cpus": 2,
+                                          "gcs_standbys": 2})
+        cluster.add_node(num_cpus=2)
+        cluster.connect()
+        try:
+            from ant_ray_tpu.api import global_worker  # noqa: PLC0415
+
+            rt = global_worker.runtime
+            rt._gcs.call("KVPut", {"key": "warm", "value": b"1"},
+                         retries=3)
+            cluster.kill_gcs_leader()
+            t0 = time.perf_counter()
+            deadline = time.monotonic() + 60
+            while True:
+                try:
+                    rt._gcs.call("KVPut", {"key": "probe",
+                                           "value": b"1"}, timeout=2)
+                    break
+                except Exception:  # noqa: BLE001 — failover in progress
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.02)
+            emit("gcs_failover_time_s", time.perf_counter() - t0, "s")
+
+            steplog = tempfile.mktemp(prefix="art_bench_ha_")
+            chaos = ChaosSchedule(seed=7)
+            chaos.kill_leader(3, cluster)
+
+            def ha_loop(config):
+                import time as _t  # noqa: PLC0415
+
+                from ant_ray_tpu import train as _train  # noqa: PLC0415
+
+                ctx = _train.get_context()
+                for step in range(config["steps"]):
+                    with open(config["log"], "a") as f:
+                        f.write(f"{ctx.attempt} {step}\n")
+                    _t.sleep(0.25)
+                    _train.report({"step": step},
+                                  checkpoint={"step": step})
+
+            steps_total = max(8, int(10 * scale))
+            trainer = JaxTrainer(
+                ha_loop,
+                train_loop_config={"steps": steps_total,
+                                   "log": steplog},
+                scaling_config=ScalingConfig(num_workers=1),
+                run_config=RunConfig(
+                    name="bench-ha", storage_path=tempfile.mkdtemp(),
+                    failure_config=FailureConfig(max_failures=0)))
+            box = {}
+            fit_thread = threading.Thread(
+                target=lambda: box.update(result=trainer.fit()),
+                daemon=True)
+            fit_thread.start()
+            fit_deadline = time.monotonic() + 240
+            while time.monotonic() < fit_deadline and \
+                    fit_thread.is_alive():
+                if os.path.exists(steplog):
+                    lines = open(steplog).read().splitlines()
+                    if lines:
+                        chaos.fire(int(lines[-1].split()[1]))
+                time.sleep(0.1)
+            fit_thread.join(timeout=30)
+            assert not fit_thread.is_alive(), "fit wedged"
+            assert box["result"].error is None, box["result"].error
+            assert chaos.killed_leaders, "leader kill never fired"
+            rows = open(steplog).read().splitlines()
+            unique = {int(line.split()[1]) for line in rows}
+            assert len(unique) == steps_total, (len(unique), steps_total)
+            emit("goodput_under_leader_kill",
+                 len(unique) / len(rows), "fraction")
+        finally:
+            art.shutdown()
+            cluster.shutdown()
+    except Exception as e:  # noqa: BLE001 — bench must not die here
+        print(json.dumps({"metric": "bench_error",
+                          "bench_error":
+                          f"gcs ha bench failed: {e!r}"[:300]}))
+
     # ---- serve overload plane: goodput + shed fraction at >= 4x
     # offered load.  A bounded deployment (2 replicas x (1 running +
     # 1 queued), 100 ms service, 1 s deadline) takes closed-loop
