@@ -595,16 +595,211 @@ class WireSchemaDriftChecker(ProjectChecker):
         return findings
 
 
+# ---------------------------------------------------- frame-schema drift
+
+def frame_snapshot_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "wire_frames.json")
+
+
+def live_frame_schema() -> tuple[dict, dict]:
+    """(frame kind/flag constants, ordered hot field tables) as the
+    tree defines them right now."""
+    from ant_ray_tpu._private import hotframe, protocol  # noqa: PLC0415
+
+    kinds = {
+        "REQ": protocol._REQ, "REP": protocol._REP,
+        "ERR": protocol._ERR, "ONEWAY": protocol._ONEWAY,
+        "HELLO": protocol._HELLO, "GOODBYE": protocol._GOODBYE,
+        "HOT": protocol._HOT,
+        "RAW_FLAG": protocol._RAW_FLAG,
+        "HOT_FLAG": protocol._HOT_FLAG,
+        "HOT_WIRE_VERSION": hotframe.HOT_WIRE_VERSION,
+        "HOT_TEMPLATE": hotframe.HOT_TEMPLATE,
+        "HOT_CALL": hotframe.HOT_CALL,
+        "HOT_ACKS": hotframe.HOT_ACKS,
+    }
+    tables = {
+        "hot_template_fields": list(hotframe.TEMPLATE_FIELDS),
+        "hot_call_fields": list(hotframe.CALL_FIELDS),
+    }
+    return kinds, tables
+
+
+def load_frame_snapshot(path: str | None = None) -> dict:
+    try:
+        with open(path or frame_snapshot_path()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def save_frame_snapshot(path: str | None = None) -> None:
+    kinds, tables = live_frame_schema()
+    with open(path or frame_snapshot_path(), "w") as f:
+        json.dump({"comment": "frame-kind constants + hot-frame field "
+                              "tables — values are FROZEN and the "
+                              "field tables append-only (a reorder/"
+                              "rename breaks peers that negotiated "
+                              "the same hot version); record additive "
+                              "growth with --baseline-update",
+                   "frame_kinds": kinds, **tables}, f, indent=1)
+        f.write("\n")
+
+
+class FrameSchemaDriftChecker(ProjectChecker):
+    """The wire-schema drift idea extended below the method registry to
+    the FRAME layer the hot wire introduced: transport kind/flag
+    constants and the hot-frame field tables must stay frozen /
+    append-only against the committed ``wire_frames.json`` snapshot.
+
+    * a frame-kind or flag value that CHANGES (or disappears) fails —
+      peers that negotiated the same PROTOCOL_VERSION / hot version
+      would mis-parse each other's frames;
+    * the hot template/call field tables are ordered wire layout:
+      renaming, removing, or REORDERING an entry fails (struct offsets
+      shift under the peer); appending is the one legal evolution,
+      recorded with ``--baseline-update`` alongside a
+      ``HOT_WIRE_VERSION`` bump when layout-affecting.
+    """
+
+    rule = "frame-schema-drift"
+    prevents = ("a hot-frame field reordered without a version bump "
+                "would mis-decode every call between same-version "
+                "peers — the wire-schema snapshot idea applied to the "
+                "frame layer")
+
+    _HOTFRAME_PATH = "ant_ray_tpu/_private/hotframe.py"
+
+    def __init__(self, kinds: dict | None = None,
+                 tables: dict | None = None,
+                 snapshot: dict | None = None):
+        # Injectable for fixture tests; None = the real registries.
+        self._kinds = kinds
+        self._tables = tables
+        self._snapshot = snapshot
+
+    def check_project(self, package_root: str) -> Iterable[Finding]:
+        if self._kinds is not None:
+            kinds, tables = self._kinds, self._tables or {}
+        else:
+            kinds, tables = live_frame_schema()
+        snapshot = (self._snapshot if self._snapshot is not None
+                    else load_frame_snapshot())
+        findings: list[Finding] = []
+
+        def finding(message: str, text: str = "") -> None:
+            findings.append(Finding(self.rule, self._HOTFRAME_PATH, 1,
+                                    message, text=text))
+
+        for name, value in (snapshot.get("frame_kinds") or {}).items():
+            if name not in kinds:
+                finding(f"frame kind/flag {name!r} is in the committed "
+                        "frame snapshot but gone from the tree — "
+                        "removing a frame constant breaks negotiated "
+                        "peers", name)
+            elif kinds[name] != value:
+                finding(f"frame kind/flag {name!r} changed "
+                        f"{value} -> {kinds[name]} — frame constants "
+                        "are frozen wire contract; introduce a NEW "
+                        "kind instead", name)
+        for name in sorted(set(kinds) - set(snapshot.get("frame_kinds")
+                                            or {})):
+            finding(f"new frame kind/flag {name!r} is not in the "
+                    "committed frame snapshot — record it with "
+                    "--baseline-update", name)
+
+        for table in ("hot_template_fields", "hot_call_fields"):
+            live = tables.get(table)
+            pinned = snapshot.get(table)
+            if live is None or pinned is None:
+                if pinned is not None:
+                    finding(f"{table} missing from the tree but pinned "
+                            "in the frame snapshot", table)
+                continue
+            if live[:len(pinned)] != pinned:
+                finding(f"{table} is not an append-only extension of "
+                        f"the committed snapshot ({pinned} -> {live}) "
+                        "— renaming/removing/reordering shifts struct "
+                        "offsets under same-version peers; append "
+                        "only, and bump HOT_WIRE_VERSION for layout "
+                        "changes", table)
+            elif len(live) > len(pinned):
+                finding(f"{table} grew ({len(pinned)} -> {len(live)} "
+                        "fields) — record the addition with "
+                        "--baseline-update", table)
+        return findings
+
+
+class PickleInHotPathChecker(Checker):
+    """Direct ``pickle.dumps``/``pickle.loads`` on the framing hot path
+    outside the blessed helpers.  The zero-pickle frame work holds only
+    as long as per-call code keeps using the struct codec — a stray
+    pickle call in protocol/hotframe/core wire sections silently
+    reintroduces the cost the hot wire removed."""
+
+    rule = "pickle-in-hot-path"
+    prevents = ("the PR 15 hot-frame rebuild: pickled TaskSpec frames "
+                "cost ~an order of magnitude over the struct codec at "
+                "10k calls/s, and a casual pickle.dumps in the framing "
+                "layer regresses it invisibly")
+    scope = ("ant_ray_tpu/_private/protocol.py",
+             "ant_ray_tpu/_private/hotframe.py")
+
+    #: Enclosing functions where pickle IS the job: the generic pickled
+    #: framing helpers, and the hot-codec spots that pickle cold/rare
+    #: sub-payloads (templates: once per connection; trace contexts:
+    #: sampled calls only; exception acks: error path).
+    _BLESSED = frozenset({
+        "_encode_frame", "_encode_raw_head", "_read_frame",
+        "encode_template", "decode_template", "encode_call",
+        "decode_call", "encode_ack_exc", "decode_acks",
+    })
+
+    def check(self, rel_path: str, tree: ast.AST,
+              lines: list[str]) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        stack: list[str] = []
+
+        def visit(node: ast.AST) -> None:
+            is_fn = isinstance(node, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef))
+            if is_fn:
+                stack.append(node.name)
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("dumps", "loads") \
+                    and _terminal_name(node.func.value) == "pickle" \
+                    and not (stack and stack[-1] in self._BLESSED):
+                findings.append(self.finding(
+                    rel_path, node,
+                    f"direct pickle.{node.func.attr}() outside the "
+                    "blessed framing helpers "
+                    f"({', '.join(sorted(self._BLESSED))}) — per-call "
+                    "pickle is what the hot-frame codec exists to "
+                    "avoid; route through the codec or a blessed "
+                    "helper", lines))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_fn:
+                stack.pop()
+
+        visit(tree)
+        return findings
+
+
 FILE_CHECKERS: list[Checker] = [
     BlockingUnderLockChecker(),
     BlockingInAsyncChecker(),
     BannedApisChecker(),
     BaseExceptionSwallowChecker(),
     ResponseTruthinessChecker(),
+    PickleInHotPathChecker(),
 ]
 
 PROJECT_CHECKERS: list[ProjectChecker] = [
     WireSchemaDriftChecker(),
+    FrameSchemaDriftChecker(),
 ]
 
 ALL_CHECKERS = [*FILE_CHECKERS, *PROJECT_CHECKERS]

@@ -168,6 +168,13 @@ class Config:
     task_push_pipeline_depth: int = 8
     # Max concurrent LeaseWorker requests parked per scheduling key.
     max_pending_lease_requests: int = 8
+    # Worker leases requested per LeaseWorker round trip: a burst of N
+    # queued tasks asks the daemon for up to this many workers in ONE
+    # RPC (payload ``count``); the daemon grants extras only from
+    # already-idle capacity (reply ``extra``), and grants the queue
+    # drained past are returned immediately.  1 restores the one-lease-
+    # per-round-trip protocol (and is what pre-batching daemons serve).
+    lease_batch_size: int = 8
     # Pull-before-grant budget for a lease's plasma args (ref:
     # LeaseDependencyManager, lease_dependency_manager.h): the daemon
     # pulls the first queued task's deps node-local before granting,
@@ -232,6 +239,13 @@ class Config:
     # ---- rpc ----
     rpc_connect_timeout_s: float = 10.0
     rpc_call_timeout_s: float = 60.0
+    # Hot-frame wire protocol (hotframe.py): the zero-pickle PushTask
+    # path — struct-packed calls against per-connection header
+    # templates, with coalesced batched acks.  Negotiated per
+    # connection in the HELLO handshake; disabling it (or talking to a
+    # peer that has it disabled / predates it) transparently falls back
+    # to the pickled frames, call for call.
+    hot_wire_enabled: bool = True
     # Deterministic RPC fault injection: "method:prob,method:prob" (chaos
     # testing — ref: src/ray/rpc/rpc_chaos.h).
     testing_rpc_failure: str = ""

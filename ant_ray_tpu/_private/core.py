@@ -36,6 +36,7 @@ from ant_ray_tpu._private.ids import (
     TaskID,
     WorkerID,
 )
+from ant_ray_tpu._private import task_events
 from ant_ray_tpu._private.memory_store import MemoryStore
 from ant_ray_tpu._private.object_store import ArenaClient, open_object
 from ant_ray_tpu._private.protocol import (
@@ -44,6 +45,7 @@ from ant_ray_tpu._private.protocol import (
     RpcConnectionError,
     RpcError,
     RpcServer,
+    _spawn,
 )
 from ant_ray_tpu._private.specs import (
     ACTOR_ALIVE,
@@ -284,6 +286,19 @@ class ClusterRuntime(CoreRuntime):
         # call is an eventfd syscall each, visible at 10k calls/s.
         self._submit_inbox: deque = deque()
         self._inbox_scheduled = False  # GIL-atomic flag
+        # Coalesced best-effort oneway publishes (refcount borrows,
+        # cluster-wide frees): any thread appends, one io-loop drain
+        # groups the burst per destination and ships each group as ONE
+        # transport write — per-event frames and wakeups are visible at
+        # 10k calls/s.  A single sequential drainer preserves per-
+        # destination ordering (BorrowAdd before BorrowRemove).
+        self._oneway_inbox: deque = deque()
+        self._oneway_scheduled = False  # GIL-atomic flag
+        self._oneway_draining = False   # io-loop confined
+        # Shared bound method for the per-call reply callback: binding
+        # once avoids a closure + bound-method allocation per call on
+        # the actor-reply hot path.
+        self._actor_reply_cb = self._on_actor_reply_done
         self._actor_meta_cache: dict[ActorID, dict] = {}
         self._pg_bundle_cache: dict = {}  # pg_id -> [node addresses]
         self._renv_cache: dict = {}       # runtime_env -> wire form
@@ -475,15 +490,43 @@ class ClusterRuntime(CoreRuntime):
     def _send_oneway(self, address: str, method: str, payload):
         if not address or address == "local":
             return
-        client = self._clients.get(address)
+        # The flag is cleared on the loop before draining, so an append
+        # racing the drain at worst costs a redundant wakeup.
+        self._oneway_inbox.append((address, method, payload))
+        if not self._oneway_scheduled:
+            self._oneway_scheduled = True
+            self._io.loop.call_soon_threadsafe(self._kick_oneways)
 
-        async def _send():
-            try:
-                await client.oneway_async(method, payload)
-            except Exception:  # noqa: BLE001 — refcount msgs are best-effort
-                pass
+    def _kick_oneways(self) -> None:
+        # io-loop only.  ONE drainer coroutine at a time: interleaved
+        # drains could reorder a destination's events (BorrowRemove
+        # overtaking its BorrowAdd corrupts refcounts).
+        self._oneway_scheduled = False
+        if self._oneway_draining:
+            return
+        self._oneway_draining = True
+        # _spawn, not bare ensure_future: the drainer suspends on
+        # socket writes and the loop holds only weak task refs.
+        _spawn(self._drain_oneways())
 
-        asyncio.run_coroutine_threadsafe(_send(), self._io.loop)
+    async def _drain_oneways(self) -> None:
+        try:
+            while self._oneway_inbox:
+                grouped: dict[str, list] = {}
+                inbox = self._oneway_inbox
+                while inbox:
+                    address, method, payload = inbox.popleft()
+                    grouped.setdefault(address, []).append(
+                        (method, payload))
+                for address, items in grouped.items():
+                    try:
+                        await self._clients.get(address).oneway_many(items)
+                    except Exception:  # noqa: BLE001 — best-effort msgs
+                        pass
+        finally:
+            self._oneway_draining = False
+            if self._oneway_inbox:
+                self._kick_oneways()
 
     async def _handle_ping(self, _payload):
         return "pong"
@@ -1198,8 +1241,6 @@ class ClusterRuntime(CoreRuntime):
             insight.record_call_submit(spec.function_name,
                                        task_id.hex(), self.role)
         if cfg.enable_task_events:
-            from ant_ray_tpu._private import task_events  # noqa: PLC0415
-
             task_events.record(task_id.hex(), spec.function_name,
                                "submitted")
         self._post_submit(self._enqueue_task, spec, pinned, 0)
@@ -1316,18 +1357,31 @@ class ClusterRuntime(CoreRuntime):
         # the key's only worker executes gets its own lease instead of
         # serializing behind it (ref: NormalTaskSubmitter grows pending
         # lease requests with the task queue, not the lease count).
-        cap = global_config().max_pending_lease_requests
+        # A queue surplus is requested as BATCHED leases: one LeaseWorker
+        # round trip asks for up to lease_batch_size workers (acquiring
+        # counts requested WORKERS, and the cap bounds them the same
+        # way it bounded one-per-request leases).
+        cfg = global_config()
+        cap = cfg.max_pending_lease_requests
+        batch = max(1, cfg.lease_batch_size)
         while (state.acquiring < cap
                and (state.acquiring + max(0, state.workers - state.busy)
                     < len(state.queue))):
-            state.acquiring += 1
-            asyncio.ensure_future(self._acquire_worker(key, state))
+            deficit = len(state.queue) - state.acquiring \
+                - max(0, state.workers - state.busy)
+            want = max(1, min(batch, deficit, cap - state.acquiring))
+            state.acquiring += want
+            # _spawn, not bare ensure_future: the lease round trip and
+            # the grant drains suspend on socket writes, and a GC'd
+            # task would leak the lease (workers count never undone).
+            _spawn(self._acquire_worker(key, state, want))
 
-    async def _acquire_worker(self, key: tuple, state: _SchedKeyState):
+    async def _acquire_worker(self, key: tuple, state: _SchedKeyState,
+                              count: int = 1):
         try:
-            node, worker_addr, worker_id = await self._lease_for_state(state)
+            grants = await self._lease_for_state(state, count)
         except Exception as e:  # noqa: BLE001 — infeasible / saturated
-            state.acquiring -= 1
+            state.acquiring -= count
             # Only a key with no serving capacity at all fails its queue:
             # with live workers the queue still drains through them.
             if state.workers == 0 and state.acquiring == 0:
@@ -1339,10 +1393,33 @@ class ClusterRuntime(CoreRuntime):
                         f"task {spec.function_name}: {e}"))
                     self._unpin(pinned)
             return
-        state.acquiring -= 1
-        state.workers += 1
+        state.acquiring -= count
+        # Count every grant as a worker BEFORE re-examining the queue:
+        # _maybe_acquire reads workers-busy as idle capacity, and the
+        # grants below are exactly that until their drains start.
+        state.workers += len(grants)
+        if len(grants) < count:
+            # Under-granted batch (the daemon had fewer idle workers
+            # than asked): re-request the unfilled deficit NOW — the
+            # pre-batching protocol kept up to cap CONCURRENT lease
+            # requests alive, and a crash-recovery burst must not
+            # serialize behind this one grant finishing its drain.
+            self._maybe_acquire(key, state)
+        # Extra grants (batched lease: one daemon round trip served a
+        # queue surplus) drain concurrently; grants the queue has
+        # already drained past are returned to the daemon immediately.
+        for extra in grants[1:]:
+            _spawn(self._run_granted(key, state, *extra))
+        await self._run_granted(key, state, *grants[0])
+
+    async def _run_granted(self, key: tuple, state: _SchedKeyState,
+                           node, worker_addr: str, worker_id):
+        """Drain the queue through one granted lease, then return it.
+        ``state.workers`` was incremented by the caller (synchronously
+        with the grant, so _maybe_acquire never over-leases)."""
         try:
-            await self._worker_drain(state, worker_addr)
+            if state.queue:
+                await self._worker_drain(state, worker_addr)
         finally:
             state.workers -= 1
             try:
@@ -1356,10 +1433,15 @@ class ClusterRuntime(CoreRuntime):
                   and self._sched_states.get(key) is state):
                 del self._sched_states[key]
 
-    async def _lease_for_state(self, state: _SchedKeyState):
-        """Acquire one worker lease for a scheduling key, following
-        spillback redirects; returns (node_client, worker_addr,
-        worker_id).  Raises on terminal infeasibility/saturation."""
+    async def _lease_for_state(self, state: _SchedKeyState,
+                               count: int = 1):
+        """Acquire worker leases for a scheduling key, following
+        spillback redirects; returns a non-empty list of
+        (node_client, worker_addr, worker_id) grants.  ``count > 1``
+        asks the serving daemon for a batch in the same round trip
+        (payload ``count`` — ignored by pre-batching daemons, which
+        reply with the classic single grant).  Raises on terminal
+        infeasibility/saturation."""
         lease_payload = {"resources": state.resources,
                          "runtime_env": state.runtime_env,
                          "job_id": self.job_id,
@@ -1368,6 +1450,8 @@ class ClusterRuntime(CoreRuntime):
                          "owner": self.address,
                          "label_selector": state.label_selector,
                          "strategy": state.strategy}
+        if count > 1:
+            lease_payload["count"] = count
         if state.queue:
             # Head task's plasma deps ride the lease so the serving node
             # can pull them before the grant (ref:
@@ -1420,7 +1504,11 @@ class ClusterRuntime(CoreRuntime):
                 await asyncio.sleep(min(0.1 * conn_failures, 2.0))
                 continue
             if "granted" in reply:
-                return node, reply["granted"], reply["worker_id"]
+                grants = [(node, reply["granted"], reply["worker_id"])]
+                grants.extend(
+                    (node, e["granted"], e["worker_id"])
+                    for e in reply.get("extra", ()))
+                return grants
             if "spill" in reply:
                 node = self._clients.get(reply["spill"])
                 if reply.get("routed"):
@@ -1494,17 +1582,19 @@ class ClusterRuntime(CoreRuntime):
                 spec.attempt = attempt
                 if spec.trace_ctx is not None:
                     spec._t_send = time.perf_counter()
-                try:
-                    fut = await client.send_request("PushTask", spec,
-                                                    defer=True)
-                except (RpcConnectionError, OSError) as e:
-                    dead = e
-                    state.queue.appendleft((spec, pinned, attempt))
-                    # Frames deferred earlier this burst were never
-                    # shipped — fail their futures (reaped below as
-                    # retries) rather than leaving them to replay.
-                    client.discard_deferred()
-                    break
+                fut = client.try_send_deferred("PushTask", spec)
+                if fut is None:
+                    try:
+                        fut = await client.send_request("PushTask", spec,
+                                                        defer=True)
+                    except (RpcConnectionError, OSError) as e:
+                        dead = e
+                        state.queue.appendleft((spec, pinned, attempt))
+                        # Frames deferred earlier this burst were never
+                        # shipped — fail their futures (reaped below as
+                        # retries) rather than leaving them to replay.
+                        client.discard_deferred()
+                        break
                 inflight.append((spec, pinned, attempt, fut))
             # A worker with pushes in flight is busy — not idle capacity
             # — so _maybe_acquire leases more workers for queue surplus.
@@ -2156,8 +2246,6 @@ class ClusterRuntime(CoreRuntime):
         self._trace_attach(spec)
 
         if global_config().enable_task_events:
-            from ant_ray_tpu._private import task_events  # noqa: PLC0415
-
             task_events.record(task_id.hex(), spec.function_name,
                                "submitted", actor_id=actor_id.hex())
 
@@ -2229,18 +2317,25 @@ class ClusterRuntime(CoreRuntime):
                 spec.attempt = attempt
                 if spec.trace_ctx is not None:
                     spec._t_send = time.perf_counter()
-                try:
-                    fut = await client.send_request("PushTask", spec,
-                                                    defer=True)
-                except RpcConnectionError:
-                    await self._on_actor_connection_loss(
-                        state, spec, pinned, attempt)
-                    continue
+                # Sync defer on a live connection (the hot shape: no
+                # coroutine per call); the async path connects/handles
+                # chaos when the fast path declines.
+                fut = client.try_send_deferred("PushTask", spec)
+                if fut is None:
+                    try:
+                        fut = await client.send_request("PushTask", spec,
+                                                        defer=True)
+                    except RpcConnectionError:
+                        await self._on_actor_connection_loss(
+                            state, spec, pinned, attempt)
+                        continue
                 # Done-callback, not a coroutine per call: at 10k calls/s
                 # a task object per reply is measurable loop overhead.
-                fut.add_done_callback(
-                    lambda f, s=state, sp=spec, p=pinned, a=attempt:
-                    self._on_actor_reply(s, sp, p, a, f))
+                # Context rides ON the future as a preallocated tuple
+                # and the callback is ONE shared bound method — a
+                # 4-default lambda per call allocates a closure each.
+                fut._art_actor_ctx = (state, spec, pinned, attempt)
+                fut.add_done_callback(self._actor_reply_cb)
                 if not state.queue:
                     await self._safe_flush(client)
         finally:
@@ -2249,6 +2344,10 @@ class ClusterRuntime(CoreRuntime):
             if state.queue:  # raced with a new enqueue
                 state.sender_running = True
                 asyncio.ensure_future(self._actor_sender(state))
+
+    def _on_actor_reply_done(self, fut: asyncio.Future):
+        state, spec, pinned, attempt = fut._art_actor_ctx
+        self._on_actor_reply(state, spec, pinned, attempt, fut)
 
     def _on_actor_reply(self, state, spec, pinned, attempt,
                         fut: asyncio.Future):

@@ -67,6 +67,14 @@ class GcsRouter:
         if not seeds:
             raise ValueError(f"empty GCS replica spec: {spec!r}")
         self._known: list[str] = list(dict.fromkeys(seeds))
+        # Single-replica fast path: with one known replica there is no
+        # leader to resolve, no followers to round-robin, and no ring
+        # to shard — bind the plain client once and skip the routing
+        # layer entirely (the per-call shard/epoch arithmetic is
+        # measurable on the ring-write ingest path).
+        self._solo: str | None = (self._known[0]
+                                  if len(self._known) == 1 else None)
+        self._solo_client = None
         self._leader: str = self._known[0]
         self._followers: list[str] = []
         self._live: list[str] = list(self._known)
@@ -181,8 +189,21 @@ class GcsRouter:
 
     # ------------------------------------------------------------- calls
 
+    def _solo_bound(self):
+        """The bound plain client of a single-replica spec (re-fetched
+        from the pool only if it was invalidated under us)."""
+        client = self._solo_client
+        if client is None or client._closed:
+            client = self._solo_client = self._pool.get(self._solo)
+        return client
+
     async def call_async(self, method: str, payload=None,
                          timeout: float | None = None):
+        if self._solo is not None:
+            # Plain-RpcClient semantics: same target, same errors, no
+            # failover spinning, no routing arithmetic.
+            return await self._solo_bound().call_async(
+                method, payload, timeout)
         self._maybe_refresh()
         target = self._route(method)
         deadline = None
@@ -227,6 +248,9 @@ class GcsRouter:
             delay = min(delay * 2, 2.0)
 
     async def oneway_async(self, method: str, payload=None) -> None:
+        if self._solo is not None:
+            await self._solo_bound().oneway_async(method, payload)
+            return
         self._maybe_refresh()
         target = self._route(method)
         try:
@@ -244,6 +268,17 @@ class GcsRouter:
             raise RpcConnectionError(
                 f"no live GCS replica for oneway {method}")
         await self._pool.get(retry).oneway_async(method, payload)
+
+    async def oneway_many(self, items) -> None:
+        """Batched-oneway surface (RpcClient.oneway_many contract, used
+        by the coalesced publish drain).  Solo specs ship the whole
+        batch in one write; replicated specs route per item — each
+        method may shard differently."""
+        if self._solo is not None:
+            await self._solo_bound().oneway_many(items)
+            return
+        for method, payload in items:
+            await self.oneway_async(method, payload)
 
     def call(self, method: str, payload=None,
              timeout: float | None = None, retries: int = 0):

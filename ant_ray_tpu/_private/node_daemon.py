@@ -1476,8 +1476,13 @@ class NodeManager:
                     worker.lease_resources = dict(demand)
                     worker.lease_owner = payload.get("owner") or ""
                     worker.job_id = job_id
-                    return {"granted": worker.address,
-                            "worker_id": worker.worker_id}
+                    reply = {"granted": worker.address,
+                             "worker_id": worker.worker_id}
+                    extra = self._grant_extras(payload, demand, env_key,
+                                               job_id)
+                    if extra:
+                        reply["extra"] = extra
+                    return reply
             elif not pinned_here and time.monotonic() > spill_deadline:
                 node = await gcs.call_async(
                     "SelectNode",
@@ -1498,6 +1503,31 @@ class NodeManager:
                 await asyncio.wait_for(self._lease_event.wait(), timeout=0.2)
             except asyncio.TimeoutError:
                 pass
+
+    def _grant_extras(self, payload, demand, env_key: str,
+                      job_id) -> list[dict]:
+        """Batched lease (payload ``count``): after the primary grant,
+        hand out up to count-1 MORE leases from capacity that is free
+        RIGHT NOW (already-idle workers; never spawns, never waits) so
+        a burst of N queued tasks costs one daemon round trip instead
+        of N.  Grants the client's queue has drained past come straight
+        back via ReturnWorker (core._acquire_worker), so over-granting
+        idle capacity is cheap; under-granting just falls back to the
+        classic lease-per-round-trip cadence for the remainder."""
+        extras: list[dict] = []
+        want = int(payload.get("count", 1)) - 1
+        while len(extras) < want and self._can_allocate(demand):
+            worker = self._idle_worker(env_key)
+            if worker is None:
+                break
+            self._allocate(demand)
+            worker.state = LEASED
+            worker.lease_resources = dict(demand)
+            worker.lease_owner = payload.get("owner") or ""
+            worker.job_id = job_id
+            extras.append({"granted": worker.address,
+                           "worker_id": worker.worker_id})
+        return extras
 
     async def _return_worker(self, payload):
         handle = self._workers.get(payload["worker_id"])

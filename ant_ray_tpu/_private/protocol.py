@@ -22,7 +22,9 @@ import threading
 import time
 from typing import Any, Awaitable, Callable
 
+from ant_ray_tpu._private import hotframe
 from ant_ray_tpu._private.config import global_config
+from ant_ray_tpu._private.specs import TaskSpec
 
 logger = logging.getLogger(__name__)
 
@@ -40,6 +42,10 @@ def _trace_current():
     return current_sampled()
 
 _REQ, _REP, _ERR, _ONEWAY, _HELLO, _GOODBYE = 0, 1, 2, 3, 4, 5
+# Pseudo-kind yielded by _read_frame for hot-flagged frames: the body
+# is handed to the hot-frame codec undecoded (the per-connection
+# template table lives with the caller, not the reader).
+_HOT = 6
 
 # Wire protocol version (ref: protobuf schema versioning — the pickled
 # tuple frames are a fixed contract per version; mixed-version nodes
@@ -58,6 +64,15 @@ _HEADER = 8  # u64 big-endian frame length
 # is additive within PROTOCOL_VERSION — peers that never ask never see
 # one.
 _RAW_FLAG = 1 << 63
+
+# Second header bit marks a HOT frame (hotframe.py): the body is a
+# compact struct-packed PushTask call / template / batched-ack record
+# set that never round-trips through pickle.  Hot frames are only ever
+# sent to peers that advertised ``hot`` in the HELLO handshake (and
+# were acked), so the change is additive within PROTOCOL_VERSION —
+# peers that never negotiated never see one.
+_HOT_FLAG = 1 << 62
+_LEN_MASK = _HOT_FLAG - 1
 
 
 class RawReply:
@@ -225,6 +240,11 @@ def _spawn(coro) -> None:
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
     header = await reader.readexactly(_HEADER)
     length = int.from_bytes(header, "big")
+    if length & _HOT_FLAG:
+        # Hand the body over undecoded: hot-frame decode needs the
+        # per-connection template table, which the caller owns.
+        data = await reader.readexactly(length & _LEN_MASK)
+        return _HOT, -1, "", data
     if length & _RAW_FLAG:
         data = await reader.readexactly(length & ~_RAW_FLAG)
         meta_len = int.from_bytes(data[:4], "big")
@@ -238,6 +258,42 @@ async def _read_frame(reader: asyncio.StreamReader) -> Any:
 def _encode_frame(msg: Any) -> bytes:
     data = pickle.dumps(msg, protocol=5)
     return len(data).to_bytes(_HEADER, "big") + data
+
+
+def _encode_hot_frame(body: bytes) -> bytes:
+    """Frame one hot-codec body (hotframe.py encodes bodies only; the
+    transport header lives here with its sibling flags)."""
+    return (len(body) | _HOT_FLAG).to_bytes(_HEADER, "big") + body
+
+
+class _HotSendState:
+    """Per-connection hot-wire send state: established when the peer's
+    HELLO-ack lands, discarded with the connection (``writer`` is the
+    generation tag — a reconnect invalidates templates the new peer
+    never saw, so stale state must never outlive its socket)."""
+
+    __slots__ = ("writer", "version", "templates")
+
+    def __init__(self, writer, version: int):
+        self.writer = writer
+        self.version = version
+        self.templates = hotframe.TemplateCache()
+
+
+class _ServerConn:
+    """Per-connection server state: the receiver half of the template
+    cache plus the coalesced-ack buffer (one flush = one frame carrying
+    every reply that completed in the same io-loop tick)."""
+
+    __slots__ = ("writer", "write_lock", "templates", "acks",
+                 "flush_scheduled")
+
+    def __init__(self, writer, write_lock):
+        self.writer = writer
+        self.write_lock = write_lock
+        self.templates: dict[int, tuple] = {}
+        self.acks: list[bytes] = []
+        self.flush_scheduled = False
 
 
 def _encode_raw_head(kind: int, msg_id: int, method: str,
@@ -272,6 +328,10 @@ class RpcServer:
         self._server: asyncio.AbstractServer | None = None
         self._io = IoThread.get()
         self.address: str = ""
+        # Per-instance hot-wire gate (seeded from config): never ack a
+        # client's hot advertisement when off — the seam mixed-version
+        # interop tests flip to stand in for a pre-hot-wire server.
+        self._hot_enabled = global_config().hot_wire_enabled
 
     def route(self, method: str, handler: Callable[[Any], Awaitable[Any]]):
         self._routes[method] = handler
@@ -300,6 +360,7 @@ class RpcServer:
         # call from two coroutines at once when flow control pauses the
         # transport (FlowControlMixin._drain_helper asserts).
         write_lock = asyncio.Lock()
+        conn = _ServerConn(writer, write_lock)
         try:
             while True:
                 try:
@@ -319,6 +380,20 @@ class RpcServer:
                               "reason": f"peer wire protocol v{peer} is "
                                         f"not v{PROTOCOL_VERSION}"}))
                         return
+                    # Hot-wire negotiation (additive within the
+                    # version): a peer advertising ``hot`` gets an ack
+                    # and MAY then send hot frames; a peer that never
+                    # advertises (older build, or hot_wire_enabled
+                    # off) never hears back and stays fully pickled.
+                    if (payload or {}).get("hot") and self._hot_enabled:
+                        self._write_reply(
+                            writer, write_lock,
+                            (_HELLO, -1, "__hello__",
+                             {"proto": PROTOCOL_VERSION,
+                              "hot": hotframe.HOT_WIRE_VERSION}))
+                    continue
+                if kind == _HOT:
+                    self._dispatch_hot(conn, payload)
                     continue
                 fast = self._fast_routes.get(method)
                 if fast is not None:
@@ -360,6 +435,96 @@ class RpcServer:
                               (_REP, msg_id, method, result))
         elif isinstance(result, RawReply):
             result.done()
+
+    # ------------------------------------------------------ hot dispatch
+
+    def _dispatch_hot(self, conn: _ServerConn, body) -> None:
+        """Task-free dispatch of one hot frame (io loop).  A HOT_CALL
+        maps to the PushTask fast route by contract; its reply is
+        queued into the connection's coalesced-ack batch instead of
+        going out as its own frame."""
+        hkind = body[0] if body else -1
+        if hkind == hotframe.HOT_TEMPLATE:
+            try:
+                tid, fields = hotframe.decode_template(body)
+            except hotframe.HotFrameError as e:
+                logger.warning("dropped undecodable hot template: %s", e)
+                return
+            conn.templates[tid] = fields
+            return
+        if hkind != hotframe.HOT_CALL:
+            logger.warning("dropped hot frame of unknown kind %r", hkind)
+            return
+        try:
+            msg_id, spec = hotframe.decode_call(body, conn.templates)
+        except hotframe.HotFrameError as e:
+            if e.msg_id is not None:
+                # The head parsed: fail THAT call instead of leaving
+                # its future to hang client-side.
+                self._queue_hot_ack(conn, hotframe.encode_ack_exc(
+                    e.msg_id, RpcError(str(e))))
+            else:
+                logger.warning("dropped undecodable hot call: %s", e)
+            return
+        handler = self._fast_routes.get("PushTask")
+        if handler is None:
+            self._queue_hot_ack(conn, hotframe.encode_ack_exc(
+                msg_id, RpcError("no route for method 'PushTask'")))
+            return
+        try:
+            result = handler(spec)
+        except Exception as e:  # noqa: BLE001 — forwarded to caller
+            self._queue_hot_ack(conn, hotframe.encode_ack_exc(msg_id, e))
+            return
+        if isinstance(result, asyncio.Future):
+            # Context rides ON the future (preallocated tuple + one
+            # shared bound method) — no closure per call.
+            result._art_hot_ctx = (conn, msg_id)
+            result.add_done_callback(self._hot_ack_cb)
+        else:
+            self._queue_hot_reply(conn, msg_id, result)
+
+    def _hot_ack_cb(self, fut: asyncio.Future) -> None:
+        conn, msg_id = fut._art_hot_ctx
+        try:
+            reply = fut.result()
+        except Exception as e:  # noqa: BLE001 — forwarded to caller
+            self._queue_hot_ack(conn, hotframe.encode_ack_exc(msg_id, e))
+            return
+        self._queue_hot_reply(conn, msg_id, reply)
+
+    def _queue_hot_reply(self, conn: _ServerConn, msg_id: int, reply):
+        rec = hotframe.encode_ack(msg_id, reply)
+        if rec is None:
+            # Unknown reply shape: fall back to a pickled reply frame
+            # for just this call — the client resolves futures by
+            # msg_id on either path, so mixing is safe.
+            self._write_reply(conn.writer, conn.write_lock,
+                              (_REP, msg_id, "PushTask", reply))
+            return
+        self._queue_hot_ack(conn, rec)
+
+    def _queue_hot_ack(self, conn: _ServerConn, rec: bytes) -> None:
+        conn.acks.append(rec)
+        if not conn.flush_scheduled:
+            conn.flush_scheduled = True
+            self._io.loop.call_soon(self._flush_hot_acks, conn)
+
+    def _flush_hot_acks(self, conn: _ServerConn) -> None:
+        """One frame, N acks: every reply completed since the last tick
+        ships in a single transport write."""
+        conn.flush_scheduled = False
+        if not conn.acks:
+            return
+        records, conn.acks = conn.acks, []
+        frame = _encode_hot_frame(hotframe.frame_acks(records))
+        try:
+            conn.writer.write(frame)
+            if conn.writer.transport.get_write_buffer_size() > \
+                    _DRAIN_THRESHOLD:
+                _spawn(self._drain_locked(conn.writer, conn.write_lock))
+        except (ConnectionResetError, BrokenPipeError):
+            pass
 
     def _write_reply_of(self, writer, write_lock, msg_id, method,
                         fut: asyncio.Future):
@@ -483,15 +648,30 @@ class RpcClient:
         # unsafe once the transport pauses (see server-side note).  Lock
         # acquisition is FIFO, so sequential senders keep their send order.
         self._write_lock: asyncio.Lock | None = None
-        # (frame, reply-future) pairs deferred by send_request(defer=True),
-        # written in one syscall by flush_deferred() (pipelined task
-        # pushes); discard_deferred() fails the futures of frames that
-        # were never shipped so callers can retry instead of hanging.
-        self._outbox: list[tuple[bytes, asyncio.Future]] = []
+        # (frame, reply-future, writer-tag) triples deferred by
+        # send_request(defer=True), written in one syscall by
+        # flush_deferred() (pipelined task pushes); discard_deferred()
+        # fails the futures of frames that were never shipped so
+        # callers can retry instead of hanging.  The writer tag is None
+        # for connection-agnostic pickled frames; hot frames carry the
+        # writer they were encoded for (their template ids mean nothing
+        # to any other connection) and are failed instead of shipped if
+        # the connection turned over before the flush.
+        self._outbox: list[tuple[bytes, asyncio.Future, Any]] = []
+        # Hot-wire send state, established by the server's HELLO-ack
+        # and keyed to the connection it arrived on (see _HotSendState).
+        self._hot: _HotSendState | None = None
         self._chaos = _ChaosInjector(
             global_config().testing_rpc_failure,
             latency_spec=global_config().testing_rpc_latency_s)
+        # Chaos-free is the production shape: precomputed so the sync
+        # send fast path can skip the injector entirely.
+        self._chaos_active = bool(self._chaos._probs
+                                  or self._chaos._delays)
         self._closed = False
+        # Shared done-callback for pending-entry cleanup (a per-call
+        # lambda with a default-arg cell allocates a closure each).
+        self._pop_pending_cb = self._pop_pending
 
     async def _ensure_connected(self):
         # Lock-free fast path: on an established connection this runs on
@@ -525,11 +705,15 @@ class RpcClient:
             # "__hello__" as a normal request and reply an error frame —
             # which must not collide with a real pending msg_id (the
             # shared counter starts at 0).
-            writer.write(_encode_frame(
-                (_HELLO, -1, "__hello__", {"proto": PROTOCOL_VERSION})))
-            _spawn(self._read_loop(reader))
+            hello = {"proto": PROTOCOL_VERSION}
+            if global_config().hot_wire_enabled:
+                # Advertise the hot wire; frames stay pickled until
+                # (unless) the server's HELLO-ack lands.
+                hello["hot"] = hotframe.HOT_WIRE_VERSION
+            writer.write(_encode_frame((_HELLO, -1, "__hello__", hello)))
+            _spawn(self._read_loop(reader, writer))
 
-    async def _read_loop(self, reader):
+    async def _read_loop(self, reader, writer):
         version_err = None
         try:
             while True:
@@ -540,6 +724,32 @@ class RpcClient:
                         f"{(payload or {}).get('reason', 'version fence')}"
                         " — upgrade the older side")
                     return
+                if kind == _HELLO:
+                    # HELLO-ack: the peer speaks the hot wire.  Fresh
+                    # template cache, keyed to THIS connection.
+                    peer = (payload or {}).get("hot", 0)
+                    if peer:
+                        self._hot = _HotSendState(
+                            writer,
+                            min(peer, hotframe.HOT_WIRE_VERSION))
+                    continue
+                if kind == _HOT:
+                    try:
+                        self._on_hot_acks(payload)
+                    except hotframe.HotFrameError as e:
+                        # decode_acks' contract: an undecodable ack
+                        # frame is a DEAD connection, not a skippable
+                        # record — later record boundaries are unknown,
+                        # so every reply batched behind the corruption
+                        # would leave its caller hanging forever.  Kill
+                        # the socket; the teardown below fails this
+                        # connection's pending futures for retry.
+                        version_err = RpcError(
+                            f"undecodable hot ack frame from "
+                            f"{self.address}: {e}")
+                        writer.close()
+                        return
+                    continue
                 fut = self._pending.get(msg_id)
                 if fut is None or fut.done():
                     continue
@@ -553,16 +763,114 @@ class RpcClient:
         except (asyncio.IncompleteReadError, ConnectionResetError, OSError):
             pass
         finally:
-            self._writer = None
+            # Guarded teardown: a stale loop racing a completed
+            # reconnect must not clobber the NEW connection's writer,
+            # its negotiated hot state, OR its in-flight calls — every
+            # step below is scoped to THIS loop's connection.
+            if self._writer is writer:
+                self._writer = None
+            hot = self._hot
+            if hot is not None and hot.writer is writer:
+                self._hot = None
             # Deferred frames must not survive into a reconnected writer
             # (replaying a stale PushTask double-executes the task).
-            self.discard_deferred()
+            self.discard_deferred(writer=writer)
             err = version_err or RpcConnectionError(
                 f"connection to {self.address} lost")
-            for fut in self._pending.values():
+            for msg_id, fut in list(self._pending.items()):
+                if fut._art_writer is not writer:
+                    continue
+                self._pending.pop(msg_id, None)
                 if not fut.done():
                     fut.set_exception(err)
-            self._pending.clear()
+
+    def _on_hot_acks(self, body) -> None:
+        """Resolve every future whose reply rode the coalesced ack
+        frame (one frame, N acks).  Raises :class:`HotFrameError` on an
+        undecodable frame — the read loop treats that as fatal to the
+        connection (the batched replies behind the corruption are
+        unrecoverable)."""
+        acks = hotframe.decode_acks(body)
+        for msg_id, value, is_err in acks:
+            fut = self._pending.get(msg_id)
+            if fut is None or fut.done():
+                continue
+            if is_err:
+                fut.set_exception(
+                    value if isinstance(value, BaseException)
+                    else RpcError(str(value)))
+            else:
+                fut.set_result(value)
+
+    def _encode_hot_call(self, hot: _HotSendState, spec: TaskSpec,
+                         msg_id: int) -> bytes | None:
+        """Hot-wire encoding of one PushTask, or None when the spec is
+        not hot-eligible / the template cache is full (the caller falls
+        back to the pickled frame).  A first-use template rides framed
+        IMMEDIATELY ahead of its call in the same write, so it can
+        never arrive late."""
+        key = hotframe.template_key(spec)
+        if key is None:
+            hotframe.counters["fallback_ineligible"] += 1
+            return None
+        tid, is_new = hot.templates.intern(key)
+        if tid is None:
+            # Distinct from ineligible: the fix for THIS fallback is
+            # raising the cache bound, not reshaping specs.
+            hotframe.counters["fallback_cache_full"] += 1
+            return None
+        call = _encode_hot_frame(hotframe.encode_call(tid, spec, msg_id))
+        if is_new:
+            return _encode_hot_frame(hotframe.encode_template(tid, spec)) \
+                + call
+        return call
+
+    def _pop_pending(self, fut) -> None:
+        # Cleanup on any terminal state — including cancellation by a
+        # wait_for timeout — so abandoned calls never leak their entry.
+        self._pending.pop(fut._art_msg_id, None)
+
+    def _register_pending(self) -> tuple[int, asyncio.Future]:
+        msg_id = next(self._counter)
+        fut = self._io.loop.create_future()
+        fut._art_msg_id = msg_id
+        # The connection this call belongs to (both registration sites
+        # run unsuspended after the writer check / _ensure_connected):
+        # teardown fails only its own connection's futures with it.
+        fut._art_writer = self._writer
+        self._pending[msg_id] = fut
+        fut.add_done_callback(self._pop_pending_cb)
+        return msg_id, fut
+
+    def _encode_request(self, method: str, payload: Any,
+                        msg_id: int) -> tuple[bytes, Any]:
+        """(frame bytes, writer-tag) for one request — the ONE place
+        that decides hot vs pickled encoding, shared by the sync and
+        async send paths so they cannot desynchronize.  The tag is the
+        connection a hot frame was encoded for (None for pickled)."""
+        if method == "PushTask" and type(payload) is TaskSpec:
+            hot = self._hot
+            if hot is not None and hot.writer is self._writer:
+                frame = self._encode_hot_call(hot, payload, msg_id)
+                if frame is not None:
+                    return frame, hot.writer
+        return _encode_frame((_REQ, msg_id, method, payload)), None
+
+    def try_send_deferred(self, method: str, payload: Any):
+        """Sync defer-enqueue fast path (io-loop only): on an
+        established, chaos-free connection this is the whole per-call
+        send — no coroutine, no awaits.  Returns the reply future, or
+        None when the slow path must run (not connected, or chaos
+        injection is configured — the async path owns those)."""
+        if self._chaos_active:
+            return None
+        writer = self._writer
+        if writer is None or writer.is_closing():
+            return None
+        msg_id, fut = self._register_pending()
+        frame, tag = self._encode_request(method, payload, msg_id)
+        self._outbox.append((frame, fut, tag))
+        return fut
 
     async def send_request(self, method: str, payload: Any = None,
                            defer: bool = False) -> asyncio.Future:
@@ -582,16 +890,10 @@ class RpcClient:
         if delay > 0:
             await asyncio.sleep(delay)
         await self._ensure_connected()
-        msg_id = next(self._counter)
-        fut = asyncio.get_running_loop().create_future()
-        self._pending[msg_id] = fut
-        # Cleanup on any terminal state — including cancellation by a
-        # wait_for timeout — so abandoned calls never leak their entry.
-        fut.add_done_callback(
-            lambda _f, mid=msg_id: self._pending.pop(mid, None))
-        frame = _encode_frame((_REQ, msg_id, method, payload))
+        msg_id, fut = self._register_pending()
+        frame, writer_tag = self._encode_request(method, payload, msg_id)
         if defer:
-            self._outbox.append((frame, fut))
+            self._outbox.append((frame, fut, writer_tag))
             return fut
         await self._write_frame(frame)
         return fut
@@ -613,28 +915,52 @@ class RpcClient:
                 await writer.drain()
 
     async def flush_deferred(self):
-        """Ship all defer-queued frames in a single transport write."""
+        """Ship all defer-queued frames in a single transport write.
+
+        Hot frames that were encoded for a connection that has since
+        turned over are failed instead of shipped — their template ids
+        mean nothing to the new peer (the caller's retry path re-pushes
+        them, re-encoded against the fresh connection)."""
         if not self._outbox:
             return
         entries, self._outbox = self._outbox, []
+        writer = self._writer
+        stale = [e for e in entries
+                 if e[2] is not None and e[2] is not writer]
+        if stale:
+            self._fail_entries(stale)
+            entries = [e for e in entries
+                       if e[2] is None or e[2] is writer]
+            if not entries:
+                return
         try:
             await self._write_frame(entries[0][0] if len(entries) == 1
-                                    else b"".join(f for f, _ in entries))
+                                    else b"".join(f for f, _, _ in entries))
         except BaseException:
             self._fail_entries(entries)
             raise
 
-    def discard_deferred(self):
+    def discard_deferred(self, writer=None):
         """Drop never-shipped deferred frames, failing their futures —
         replaying them on a later (re)connection would double-execute
-        tasks that the caller already rerouted elsewhere."""
-        entries, self._outbox = self._outbox, []
+        tasks that the caller already rerouted elsewhere.  With
+        ``writer``, only entries registered against that connection are
+        dropped: a stale read loop racing a completed reconnect must
+        not fail the new connection's deferred traffic."""
+        if writer is None:
+            entries, self._outbox = self._outbox, []
+        else:
+            entries = [e for e in self._outbox
+                       if e[1]._art_writer is writer]
+            if entries:
+                self._outbox = [e for e in self._outbox
+                                if e[1]._art_writer is not writer]
         self._fail_entries(entries)
 
     def _fail_entries(self, entries):
         err = RpcConnectionError(
             f"request to {self.address} was never sent")
-        for _frame, fut in entries:
+        for _frame, fut, _tag in entries:
             if not fut.done():
                 fut.set_exception(err)
 
@@ -701,6 +1027,15 @@ class RpcClient:
     async def oneway_async(self, method: str, payload: Any = None) -> None:
         await self._ensure_connected()
         await self._write_frame(_encode_frame((_ONEWAY, -1, method, payload)))
+
+    async def oneway_many(self, items) -> None:
+        """Ship a batch of ``(method, payload)`` oneways in one
+        transport write (the coalesced refcount/publish path: a burst
+        of per-call notifications costs one syscall, not N)."""
+        await self._ensure_connected()
+        await self._write_frame(b"".join(
+            _encode_frame((_ONEWAY, -1, method, payload))
+            for method, payload in items))
 
     def call(self, method: str, payload: Any = None,
              timeout: float | None = None, retries: int = 0) -> Any:

@@ -259,7 +259,43 @@ class _ValuePickler(cloudpickle.Pickler):
         return super().reducer_override(obj)
 
 
+# Fast-path eligibility: exact scalar types (subclasses may carry
+# reducers), short strings/bytes (large ones benefit from out-of-band
+# buffer externalization), and shallow small containers of the same.
+# These values cannot contain ObjectRefs, jax arrays, or anything else
+# the custom pickler handles — plain pickle.dumps is byte-compatible
+# with what the full pickler would emit and an order of magnitude
+# cheaper (no pickler construction, no reducer dispatch, no BytesIO).
+_SIMPLE_TYPES = frozenset({int, float, bool, type(None)})
+_SIMPLE_SIZED = frozenset({str, bytes})
+_SIMPLE_MAX_SIZED = 4096
+_SIMPLE_MAX_ITEMS = 8
+
+
+def _is_simple(value: Any, depth: int = 2) -> bool:
+    t = type(value)
+    if t in _SIMPLE_TYPES:
+        return True
+    if t in _SIMPLE_SIZED:
+        return len(value) <= _SIMPLE_MAX_SIZED
+    if depth:
+        if t is tuple or t is list:
+            return (len(value) <= _SIMPLE_MAX_ITEMS
+                    and all(_is_simple(v, depth - 1) for v in value))
+        if t is dict:
+            return (len(value) <= _SIMPLE_MAX_ITEMS
+                    and all(type(k) is str and _is_simple(v, depth - 1)
+                            for k, v in value.items()))
+    return False
+
+
 def serialize(value: Any) -> SerializedObject:
+    # Scalar fast path: the overwhelmingly common actor-call reply /
+    # small-args shape on the control-plane hot path.
+    if _is_simple(value):
+        return SerializedObject(
+            inband=pickle.dumps(value, protocol=5), buffers=[],
+            contained_refs=[])
     buffers: list = []
     contained_refs: list = []
 

@@ -22,6 +22,18 @@ class PromotedArgs:
 
 @dataclass
 class TaskSpec:
+    """One task/actor-call submission, self-contained.
+
+    Wire forms: the pickled positional tuple (``__reduce__`` below —
+    the universal transport), and the hot-frame split
+    (``_private/hotframe.py``): fields invariant per call shape
+    (``TEMPLATE_FIELDS``) are interned once per connection, varying
+    fields (``CALL_FIELDS``) ride each call struct-packed, and
+    ``args_payload`` travels as raw bytes outside pickle entirely.
+    Adding a field here means deciding which side of that split it
+    lands on — the artlint frame-schema snapshot makes the choice
+    explicit and append-only."""
+
     task_id: TaskID
     function_id: str              # GCS-KV key of the cloudpickled function
     function_name: str            # human-readable, for errors

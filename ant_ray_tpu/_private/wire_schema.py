@@ -145,8 +145,13 @@ METHODS: dict[str, dict] = {
     # ---- node daemon (raylet) -----------------------------------------
     "LeaseWorker": _m("node",
                       "{resources, job_id?, label_selector?, strategy?, "
-                      "pg?, runtime_env?, deps?, routed?}",
-                      "{granted, worker_id}|{spill}|{infeasible, reason}"),
+                      "pg?, runtime_env?, deps?, routed?, count?}",
+                      "{granted, worker_id, extra?: [{granted, "
+                      "worker_id}]}|{spill}|{infeasible, reason} — "
+                      "count asks for a batch of leases in one round "
+                      "trip; extras come only from already-idle "
+                      "capacity (both keys additive: old peers ignore "
+                      "count / never send it)"),
     "ReturnWorker": _m("node", "{worker_id}", "bool"),
     "RegisterWorker": _m("node", "{worker_id, address, pid}",
                          "{ok}|{error}"),
@@ -204,7 +209,11 @@ METHODS: dict[str, dict] = {
                   "{data, next_offset, eof}|{error}"),
 
     # ---- worker / owner (core runtime) --------------------------------
-    "PushTask": _m("worker", "TaskSpec (fast route)", "result payload"),
+    "PushTask": _m("worker", "TaskSpec (fast route)",
+                   "result payload — between hot-wire peers this "
+                   "method rides HOT frames (hotframe.py: templated "
+                   "zero-pickle calls, coalesced batched acks); the "
+                   "pickled form stays the negotiation fallback"),
     "CancelTask": _m("worker", "{task_id}",
                      "bool — drop the task if it has not started "
                      "executing (oneway from owners; cooperative: "

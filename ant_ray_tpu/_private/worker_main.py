@@ -19,10 +19,11 @@ import queue
 import threading
 import time
 import traceback
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 
 from ant_ray_tpu import exceptions
-from ant_ray_tpu._private import serialization
+from ant_ray_tpu._private import serialization, task_events
 from ant_ray_tpu._private.config import global_config
 from ant_ray_tpu._private.core import ClusterRuntime
 from ant_ray_tpu._private.ids import JobID, NodeID, ObjectID, WorkerID
@@ -71,6 +72,14 @@ class TaskExecutor:
         # never starves another.
         self._group_pools: dict[str, "ThreadPoolExecutor"] = {}
         self._io = IoThread.get()
+        # Coalesced reply channel: executor threads append completed
+        # replies here and schedule ONE io-loop drain for the whole
+        # burst (the _post_submit idiom) instead of one
+        # call_soon_threadsafe per call — the drain resolves every
+        # future in the same loop tick, which is what lets the server's
+        # hot-ack batch ship a burst of replies as one frame.
+        self._reply_inbox: "deque[tuple]" = deque()
+        self._reply_scheduled = False
         self._main = threading.Thread(target=self._run_loop, daemon=True,
                                       name="art-executor")
         self._main.start()
@@ -79,12 +88,39 @@ class TaskExecutor:
         self.queue.put((spec, reply_fut))
 
     def _reply(self, fut: asyncio.Future, value):
-        self._io.loop.call_soon_threadsafe(
-            lambda: fut.set_result(value) if not fut.done() else None)
+        self._post_reply(fut, value, False)
 
     def _reply_exc(self, fut: asyncio.Future, exc: Exception):
-        self._io.loop.call_soon_threadsafe(
-            lambda: fut.set_exception(exc) if not fut.done() else None)
+        self._post_reply(fut, exc, True)
+
+    def _post_reply(self, fut: asyncio.Future, value, is_exc: bool):
+        # Flag-coalesced wakeup: while the io loop has not yet run a
+        # scheduled drain, further completions just append — a burst
+        # whose replies land while the loop is busy resolves in ONE
+        # tick, which is what lets the server's hot-ack batch ship
+        # them as one frame.  The flag is cleared before draining, so
+        # an append racing the drain at worst costs a redundant
+        # (harmless) wakeup, never a lost reply.  Deliberately NOT
+        # gated on the task queue: holding a reply while a later task
+        # executes can deadlock callers whose blocked call (e.g. a
+        # coordination barrier) is what the deferred reply would have
+        # unblocked.
+        self._reply_inbox.append((fut, value, is_exc))
+        if not self._reply_scheduled:
+            self._reply_scheduled = True
+            self._io.loop.call_soon_threadsafe(self._drain_replies)
+
+    def _drain_replies(self):
+        self._reply_scheduled = False
+        inbox = self._reply_inbox
+        while inbox:
+            fut, value, is_exc = inbox.popleft()
+            if fut.done():
+                continue
+            if is_exc:
+                fut.set_exception(value)
+            else:
+                fut.set_result(value)
 
     def _run_loop(self):
         while True:
@@ -239,8 +275,7 @@ class TaskExecutor:
             started = time.monotonic()
         events = None
         if global_config().enable_task_events:
-            from ant_ray_tpu._private import task_events as events  # noqa: PLC0415
-
+            events = task_events
             events.record(
                 spec.task_id.hex(), spec.function_name, "started",
                 actor_id=spec.actor_id.hex() if spec.actor_id else None,

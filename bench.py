@@ -212,6 +212,14 @@ _GUARDED_METRICS = {
     # number ROADMAP item 2's fast-path work decomposes against.
     "trace_overhead_unsampled_ns": "lower",
     "rpc_p99_actor_call_us": "lower",
+    # Control-plane fast path (PR 15): the hot-frame codec's per-call
+    # encode/decode cost (the floor under every PushTask), and the
+    # tracing-attributed wire-stage mean itself — the end-to-end
+    # throughput guards alone would let framing overhead hide inside
+    # rig variance; the attributed wire cost is fenced directly.
+    "rpc_frame_encode_ns": "lower",
+    "rpc_frame_decode_ns": "lower",
+    "rpc_actor_call_wire_us_mean": "lower",
     # Static-analysis plane (PR 10): a full artlint pass over the
     # package.  Guarded "lower" with a hard 10s budget in run_child —
     # a lint too slow to run every commit stops being run at all.

@@ -144,6 +144,47 @@ def main() -> None:
          (time.perf_counter() - t0) / rounds, "us")
     assert len(ready) == len(refs)
 
+    # ---- hot-frame codec (hotframe.py): per-call encode/decode cost
+    # of the zero-pickle PushTask wire format, measured on the exact
+    # actor-call shape the cluster benches above push.  Guarded "lower"
+    # so framing overhead can never silently regress — this is the
+    # per-call floor under every number in this file.
+    from ant_ray_tpu._private import hotframe  # noqa: PLC0415
+    from ant_ray_tpu._private.ids import ActorID, JobID, TaskID  # noqa: PLC0415
+    from ant_ray_tpu._private.specs import TaskSpec  # noqa: PLC0415
+
+    aid = ActorID.of(JobID.from_random())
+    frame_spec = TaskSpec(
+        task_id=TaskID.for_actor_task(aid), function_id="",
+        function_name="Echo.ping", args_payload=b"x" * 100,
+        num_returns=1, owner_address="127.0.0.1:12345", resources={},
+        actor_id=aid, method_name="ping", sequence_no=1)
+    cache = hotframe.TemplateCache()
+    tid_, _new = cache.intern(hotframe.template_key(frame_spec))
+    table = dict((hotframe.decode_template(
+        hotframe.encode_template(tid_, frame_spec)),))
+    n_frames = max(5000, int(50000 * scale))
+
+    def frame_encode_ns() -> float:
+        t0 = time.perf_counter()
+        for i in range(n_frames):
+            hotframe.encode_call(tid_, frame_spec, i)
+        return (time.perf_counter() - t0) / n_frames * 1e9
+
+    body = hotframe.encode_call(tid_, frame_spec, 7)
+
+    def frame_decode_ns() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n_frames):
+            hotframe.decode_call(body, table)
+        return (time.perf_counter() - t0) / n_frames * 1e9
+
+    frame_encode_ns(), frame_decode_ns()              # warmup
+    emit("rpc_frame_encode_ns",
+         sorted(frame_encode_ns() for _ in range(3))[1], "ns")
+    emit("rpc_frame_decode_ns",
+         sorted(frame_decode_ns() for _ in range(3))[1], "ns")
+
     # ---- device-feed ingest (data/device_feed.py): consumer starve-
     # fraction with prefetch on vs. off, plus end-to-end batches/s.
     # The consumer's "step" is a sleep: like a TPU step (which runs on

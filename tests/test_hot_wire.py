@@ -1,0 +1,564 @@
+"""Hot-frame wire protocol (hotframe.py + protocol.py integration):
+codec round trips, mixed-version negotiation (no flag-day), fuzzed
+malformed frames, reconnect template invalidation, batched leases, and
+the GcsRouter single-replica fast path."""
+
+import asyncio
+import time
+
+import pytest
+
+import ant_ray_tpu as art
+from ant_ray_tpu._private import hotframe, protocol
+from ant_ray_tpu._private.config import global_config
+from ant_ray_tpu._private.ids import ActorID, JobID, TaskID
+from ant_ray_tpu._private.protocol import (
+    ClientPool,
+    IoThread,
+    RpcClient,
+    RpcError,
+    RpcServer,
+)
+from ant_ray_tpu._private.specs import TaskSpec
+
+
+def _actor_spec(seq: int = 0, payload: bytes = b"x" * 64,
+                trace=None, **overrides) -> TaskSpec:
+    aid = overrides.pop("actor_id", None) or ActorID.of(JobID.from_random())
+    fields = dict(
+        task_id=TaskID.for_actor_task(aid), function_id="",
+        function_name="Echo.ping", args_payload=payload, num_returns=1,
+        owner_address="127.0.0.1:7777", resources={}, actor_id=aid,
+        method_name="ping", sequence_no=seq, trace_ctx=trace)
+    fields.update(overrides)
+    return TaskSpec(**fields)
+
+
+# ------------------------------------------------------------- codec unit
+
+
+def test_template_key_eligibility():
+    spec = _actor_spec()
+    key = hotframe.template_key(spec)
+    assert key is not None
+    # Same call shape -> same key (what makes interning work).
+    assert hotframe.template_key(_actor_spec(
+        seq=99, payload=b"other", actor_id=spec.actor_id,
+        task_id=TaskID.for_actor_task(spec.actor_id))) == key
+    # Cold shapes stay on the pickled path.
+    assert hotframe.template_key(_actor_spec(
+        runtime_env={"env_vars": {"A": "1"}})) is None
+    assert hotframe.template_key(_actor_spec(
+        label_selector={"zone": "a"})) is None
+    assert hotframe.template_key(_actor_spec(
+        scheduling_strategy="SPREAD")) is None
+    assert hotframe.template_key(_actor_spec(
+        placement_group_id=object())) is None
+
+
+def test_call_roundtrip_preserves_every_field():
+    spec = _actor_spec(seq=41, payload=b"p" * 257,
+                       trace=("t" * 32, "s" * 16, True))
+    spec.attempt = 3
+    key = hotframe.template_key(spec)
+    cache = hotframe.TemplateCache()
+    tid, is_new = cache.intern(key)
+    assert is_new
+    tid2, fields = hotframe.decode_template(
+        hotframe.encode_template(tid, spec))
+    assert tid2 == tid
+    msg_id, out = hotframe.decode_call(
+        hotframe.encode_call(tid, spec, 12345), {tid2: fields})
+    assert msg_id == 12345
+    import dataclasses
+
+    for f in dataclasses.fields(TaskSpec):
+        assert getattr(out, f.name) == getattr(spec, f.name), f.name
+    # Re-interning the same shape is a cache hit, not a resend.
+    assert cache.intern(key) == (tid, False)
+
+
+def test_ack_roundtrip_all_return_kinds():
+    reply = {"returns": [("inline", b"abc"), ("plasma", 1 << 33),
+                         ("error", b"errpayload"),
+                         ("stream_end", (7, None)),
+                         ("stream_end", (2, b"late-error"))]}
+    records = [hotframe.encode_ack(5, reply),
+               hotframe.encode_ack_exc(6, ValueError("boom"))]
+    assert records[0] is not None
+    acks = hotframe.decode_acks(hotframe.frame_acks(records))
+    assert acks[0] == (5, reply, False)
+    msg_id, exc, is_err = acks[1]
+    assert msg_id == 6 and is_err and isinstance(exc, ValueError)
+    assert str(exc) == "boom"
+
+
+def test_ack_encode_declines_unknown_shapes():
+    # Fallback contract: anything but the known PushTask reply shape
+    # returns None and travels as a pickled frame instead.
+    assert hotframe.encode_ack(1, {"other": 1}) is None
+    assert hotframe.encode_ack(1, "pong") is None
+    assert hotframe.encode_ack(1, {"returns": [("weird", b"")]}) is None
+    assert hotframe.encode_ack(
+        1, {"returns": [("plasma", -5)]}) is None
+    assert hotframe.encode_ack(
+        1, {"returns": [("inline", 123)]}) is None
+
+
+def test_template_cache_bound_falls_back():
+    cache = hotframe.TemplateCache()
+    for i in range(hotframe.TemplateCache.MAX_TEMPLATES):
+        tid, _new = cache.intern(("k", i))
+        assert tid is not None
+    assert cache.intern(("k", "overflow")) == (None, False)
+    # Known keys still intern fine at the bound.
+    assert cache.intern(("k", 0)) == (0, False)
+
+
+def test_decode_call_unknown_template_carries_msg_id():
+    spec = _actor_spec()
+    body = hotframe.encode_call(424242, spec, 77)
+    with pytest.raises(hotframe.HotFrameError) as ei:
+        hotframe.decode_call(body, {})
+    assert ei.value.msg_id == 77
+    assert "template" in str(ei.value)
+
+
+def test_decode_call_truncated_body():
+    spec = _actor_spec()
+    cache = hotframe.TemplateCache()
+    tid, _ = cache.intern(hotframe.template_key(spec))
+    table = dict([hotframe.decode_template(
+        hotframe.encode_template(tid, spec))])
+    body = hotframe.encode_call(tid, spec, 9)
+    with pytest.raises(hotframe.HotFrameError):
+        hotframe.decode_call(body[:8], table)      # inside the head
+    with pytest.raises(hotframe.HotFrameError) as ei:
+        hotframe.decode_call(body[:20], table)     # inside the id/vary
+    assert ei.value.msg_id == 9
+
+
+def test_decode_acks_truncated_raises():
+    rec = hotframe.encode_ack(3, {"returns": [("inline", b"abcdef")]})
+    frame = hotframe.frame_acks([rec])
+    with pytest.raises(hotframe.HotFrameError):
+        hotframe.decode_acks(frame[:len(frame) - 3])
+
+
+# -------------------------------------------- in-process client <-> server
+
+
+def _echo_server(hot: bool = True) -> RpcServer:
+    server = RpcServer()
+    server._hot_enabled = hot
+
+    def push(spec):
+        # Future-returning fast route — the worker_main shape, so hot
+        # acks flow through the coalesced done-callback path.
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        if spec.method_name == "boom":
+            fut.set_exception(ValueError("handler exploded"))
+        else:
+            fut.set_result({"returns": [("inline", spec.args_payload)]})
+        return fut
+
+    server.fast_route("PushTask", push)
+    server.start()
+    return server
+
+
+def _push(client: RpcClient, spec: TaskSpec, timeout: float = 10):
+    return client.call("PushTask", spec, timeout=timeout)
+
+
+def _wait_hot(client: RpcClient, timeout: float = 5) -> None:
+    deadline = time.monotonic() + timeout
+    while client._hot is None:
+        if time.monotonic() > deadline:
+            raise AssertionError("hot wire never negotiated")
+        time.sleep(0.01)
+
+
+def test_hot_negotiation_and_batched_acks():
+    server = _echo_server()
+    client = RpcClient(server.address)
+    try:
+        before = dict(hotframe.counters)
+        assert _push(client, _actor_spec(payload=b"first")) == \
+            {"returns": [("inline", b"first")]}
+        _wait_hot(client)
+
+        aid = ActorID.of(JobID.from_random())
+
+        async def burst(n):
+            futs = [await client.send_request(
+                "PushTask",
+                _actor_spec(seq=i, payload=b"%d" % i, actor_id=aid),
+                defer=True) for i in range(n)]
+            await client.flush_deferred()
+            return [await f for f in futs]
+
+        replies = IoThread.get().run_coro(burst(50))
+        assert [r["returns"][0][1] for r in replies] == \
+            [b"%d" % i for i in range(50)]
+        after = hotframe.counters
+        assert after["calls_encoded"] - before["calls_encoded"] >= 50
+        assert after["acks_decoded"] - before["acks_decoded"] >= 50
+        # 50 calls of one shape, one template interned for them.
+        assert after["templates_encoded"] - before["templates_encoded"] \
+            <= 2
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_old_server_negotiates_down_byte_identical():
+    """New client <-> pre-hot-wire server: no HELLO-ack, every frame
+    pickled, identical results (the no-flag-day contract)."""
+    old = _echo_server(hot=False)
+    new = _echo_server(hot=True)
+    c_old = RpcClient(old.address)
+    c_new = RpcClient(new.address)
+    try:
+        before = dict(hotframe.counters)
+        specs = [_actor_spec(seq=i, payload=b"p%d" % i) for i in range(8)]
+        got_old = [_push(c_old, s) for s in specs]
+        assert c_old._hot is None          # never negotiated
+        assert hotframe.counters["calls_encoded"] == \
+            before["calls_encoded"]        # zero hot frames shipped
+        _push(c_new, _actor_spec())        # connect + negotiate
+        _wait_hot(c_new)
+        got_new = [_push(c_new, s) for s in specs]
+        assert got_old == got_new          # byte-identical results
+        with pytest.raises(ValueError, match="handler exploded"):
+            _push(c_old, _actor_spec(method_name="boom"))
+        with pytest.raises(ValueError, match="handler exploded"):
+            _push(c_new, _actor_spec(method_name="boom"))
+    finally:
+        c_old.close()
+        c_new.close()
+        old.stop()
+        new.stop()
+
+
+def test_old_client_against_new_server(monkeypatch):
+    """Old client (no hot advertisement) <-> new server: the server
+    never acks, never sees a hot frame, and serves pickled frames
+    exactly as before."""
+    server = _echo_server(hot=True)
+    monkeypatch.setattr(global_config(), "hot_wire_enabled", False)
+    client = RpcClient(server.address)
+    try:
+        assert _push(client, _actor_spec(payload=b"plain")) == \
+            {"returns": [("inline", b"plain")]}
+        time.sleep(0.1)                    # a late ack would land here
+        assert client._hot is None
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_ineligible_spec_falls_back_per_call():
+    """A hot connection still ships cold shapes (runtime_env etc.) as
+    pickled frames, call for call."""
+    server = _echo_server()
+    client = RpcClient(server.address)
+    try:
+        _push(client, _actor_spec())
+        _wait_hot(client)
+        before = dict(hotframe.counters)
+        cold = _actor_spec(runtime_env={"env_vars": {"A": "1"}},
+                           payload=b"cold")
+        assert _push(client, cold)["returns"][0][1] == b"cold"
+        assert hotframe.counters["calls_encoded"] == \
+            before["calls_encoded"]
+        assert hotframe.counters["fallback_ineligible"] > \
+            before["fallback_ineligible"]
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_oversized_template_id_gets_error_ack_and_connection_survives():
+    server = _echo_server()
+    client = RpcClient(server.address)
+    io = IoThread.get()
+    try:
+        _push(client, _actor_spec())
+        _wait_hot(client)
+
+        async def forged():
+            # Handcraft a HOT_CALL against a template id the server
+            # never saw (the stale/oversized-template fuzz case).
+            msg_id = next(RpcClient._counter)
+            fut = asyncio.get_running_loop().create_future()
+            client._pending[msg_id] = fut
+            body = hotframe.encode_call(40000, _actor_spec(), msg_id)
+            await client._write_frame(protocol._encode_hot_frame(body))
+            return await asyncio.wait_for(fut, 10)
+
+        with pytest.raises(RpcError, match="template"):
+            io.run_coro(forged())
+        # The connection survives the forged frame.
+        assert _push(client, _actor_spec(payload=b"after")) == \
+            {"returns": [("inline", b"after")]}
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_truncated_hot_frame_is_dropped_not_fatal():
+    server = _echo_server()
+    client = RpcClient(server.address)
+    io = IoThread.get()
+    try:
+        _push(client, _actor_spec())
+        _wait_hot(client)
+
+        async def garbage():
+            # A hot frame whose body is too short for the call head,
+            # and one with an unknown kind byte.
+            await client._write_frame(
+                protocol._encode_hot_frame(bytes([hotframe.HOT_CALL])
+                                           + b"\x01"))
+            await client._write_frame(
+                protocol._encode_hot_frame(b"\xee junk"))
+
+        io.run_coro(garbage())
+        assert _push(client, _actor_spec(payload=b"alive")) == \
+            {"returns": [("inline", b"alive")]}
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_corrupt_ack_frame_fails_pending_calls_not_hangs(monkeypatch):
+    """An undecodable HOT_ACKS frame is fatal to the CONNECTION: the
+    boundaries of the records batched behind the corruption are
+    unknown, so the client must fail its pending futures for retry —
+    never drop the frame and leave the callers hanging to timeout."""
+    server = _echo_server()
+    client = RpcClient(server.address)
+    try:
+        _push(client, _actor_spec(payload=b"warm"))
+        _wait_hot(client)
+        real = hotframe.frame_acks
+        # Corrupt every subsequent batched-ack frame at the source (the
+        # transport length header still matches, so only the hot body
+        # is torn — exactly what a server-side encoding bug looks like).
+        monkeypatch.setattr(hotframe, "frame_acks",
+                            lambda records: real(records)[:-2])
+        t0 = time.monotonic()
+        with pytest.raises(RpcError, match="undecodable hot ack"):
+            _push(client, _actor_spec(seq=1, payload=b"torn"), timeout=10)
+        # Failed by the connection teardown, not by the call timeout.
+        assert time.monotonic() - t0 < 5
+        monkeypatch.setattr(hotframe, "frame_acks", real)
+        # The client reconnects and recovers on the next call.
+        assert _push(client, _actor_spec(seq=2, payload=b"back")) == \
+            {"returns": [("inline", b"back")]}
+    finally:
+        client.close()
+        server.stop()
+
+
+def test_reconnect_invalidates_template_cache():
+    """The stale-template-after-reconnect case: a new connection means
+    a new server-side table, so the client must re-negotiate and
+    re-send templates instead of referencing dead ids."""
+    server = _echo_server()
+    client = RpcClient(server.address)
+    try:
+        _push(client, _actor_spec(payload=b"one"))
+        _wait_hot(client)
+        first_hot = client._hot
+        _push(client, _actor_spec(payload=b"two"))
+        before = dict(hotframe.counters)
+        client.close()                     # connection turns over
+        client._closed = False             # reuse the same instance
+        assert _push(client, _actor_spec(payload=b"three")) == \
+            {"returns": [("inline", b"three")]}
+        _wait_hot(client)
+        assert client._hot is not first_hot
+        deadline = time.monotonic() + 5
+        while client._hot is first_hot and time.monotonic() < deadline:
+            time.sleep(0.01)
+        _push(client, _actor_spec(payload=b"four"))
+        # The shape was re-interned against the fresh connection.
+        assert hotframe.counters["templates_encoded"] > \
+            before["templates_encoded"]
+    finally:
+        client.close()
+        server.stop()
+
+
+# ----------------------------------------------------- cluster-level e2e
+
+
+def _exercise_cluster():
+    @art.remote
+    class Echo:
+        def ping(self, x=None):
+            return x
+
+        def gen(self, n):
+            for i in range(n):
+                yield i * 10
+
+        def boom(self):
+            raise ValueError("kaboom")
+
+    @art.remote
+    def add(a, b):
+        return a + b
+
+    a = Echo.remote()
+    out = {
+        "sync": [art.get(a.ping.remote(i)) for i in range(3)],
+        "async": art.get([a.ping.remote(i) for i in range(40)]),
+        "tasks": art.get([add.remote(i, 1) for i in range(20)]),
+        "stream": [art.get(r) for r in
+                   a.gen.options(num_returns="streaming").remote(4)],
+    }
+    try:
+        art.get(a.boom.remote())
+        out["error"] = None
+    except Exception as e:  # noqa: BLE001
+        out["error"] = (type(e).__name__, "kaboom" in str(e))
+    return out
+
+
+@pytest.mark.parametrize("hot", [True, False], ids=["hot", "pickled"])
+def test_cluster_end_to_end_identical_across_wire_modes(hot):
+    """The same workload over the hot wire and over the pickled wire
+    (standing in for a pre-hot-wire cluster) must produce identical
+    results — sync/async/streaming actor calls, tasks, and errors."""
+    art.init(num_cpus=2,
+             _system_config={"hot_wire_enabled": hot})
+    try:
+        got = _exercise_cluster()
+    finally:
+        art.shutdown()
+    assert got == {
+        "sync": [0, 1, 2],
+        "async": list(range(40)),
+        "tasks": [i + 1 for i in range(20)],
+        "stream": [0, 10, 20, 30],
+        "error": ("ActorError", True),
+    }
+
+
+def test_cancel_queued_actor_call_over_hot_wire():
+    art.init(num_cpus=1)
+    try:
+        @art.remote
+        class Slow:
+            def block(self, s):
+                time.sleep(s)
+                return "done"
+
+            def quick(self):
+                return "q"
+
+        a = Slow.remote()
+        art.get(a.quick.remote())
+        blocker = a.block.remote(3.0)
+        victim = a.block.remote(0.0)
+        art.cancel(victim)
+        with pytest.raises(art.exceptions.TaskCancelledError):
+            art.get(victim, timeout=30)
+        assert art.get(blocker, timeout=30) == "done"
+    finally:
+        art.shutdown()
+
+
+# --------------------------------------------------------- batched leases
+
+
+def test_lease_worker_count_grants_extras_from_idle_pool():
+    art.init(num_cpus=2)
+    try:
+        @art.remote
+        def warm():
+            time.sleep(0.2)
+            return True
+
+        # Two concurrent tasks force two workers into existence...
+        assert art.get([warm.remote(), warm.remote()]) == [True, True]
+        from ant_ray_tpu.api import global_worker
+
+        rt = global_worker.runtime
+        deadline = time.monotonic() + 10
+        reply = None
+        while time.monotonic() < deadline:
+            # ...and once both are back IDLE, a count=2 lease gets the
+            # second one as an extra in the same round trip.
+            reply = rt._node.call(
+                "LeaseWorker",
+                {"resources": {"CPU": 1}, "job_id": rt.job_id,
+                 "owner": rt.address, "count": 2}, timeout=30)
+            if reply.get("extra"):
+                break
+            if "granted" in reply:
+                rt._node.call("ReturnWorker",
+                              {"worker_id": reply["worker_id"]},
+                              timeout=10)
+            time.sleep(0.1)
+        assert reply and reply.get("granted") and reply.get("extra"), \
+            reply
+        assert len(reply["extra"]) == 1
+        for grant in (reply, *reply["extra"]):
+            rt._node.call("ReturnWorker",
+                          {"worker_id": grant["worker_id"]}, timeout=10)
+        # A classic lease (no count) never grows an extra key.
+        classic = rt._node.call(
+            "LeaseWorker", {"resources": {"CPU": 1},
+                            "job_id": rt.job_id,
+                            "owner": rt.address}, timeout=30)
+        assert "extra" not in classic
+        rt._node.call("ReturnWorker",
+                      {"worker_id": classic["worker_id"]}, timeout=10)
+    finally:
+        art.shutdown()
+
+
+def test_burst_through_batched_leases_completes():
+    art.init(num_cpus=2)
+    try:
+        @art.remote
+        def sq(x):
+            return x * x
+
+        for _round in range(3):
+            assert art.get([sq.remote(i) for i in range(60)]) == \
+                [i * i for i in range(60)]
+    finally:
+        art.shutdown()
+
+
+# ------------------------------------------------- GcsRouter solo binding
+
+
+def test_gcs_router_single_replica_fast_path():
+    from ant_ray_tpu._private.gcs_client import GcsRouter
+
+    server = RpcServer()
+
+    async def kv(payload):
+        return b"value"
+
+    server.route("KVGet", kv)
+    addr = server.start()
+    pool = ClientPool()
+    try:
+        solo = GcsRouter(addr, pool)
+        assert solo._solo == addr
+        assert solo.call("KVGet", {"key": "k"}, timeout=10) == b"value"
+        # The plain client is bound once and reused.
+        assert solo._solo_client is pool.get(addr)
+        multi = GcsRouter(addr + "," + addr.replace(
+            addr.rsplit(":", 1)[1], "1"), pool)
+        assert multi._solo is None
+    finally:
+        server.stop()

@@ -714,3 +714,120 @@ def test_baseline_file_is_valid_json_with_schema():
     with open(F.default_baseline_path()) as f:
         data = json.load(f)
     assert isinstance(data.get("findings"), list)
+
+
+# ----------------------------------------------------- frame-schema drift
+
+
+def _frame_drift(kinds, tables, snapshot):
+    checker = C.FrameSchemaDriftChecker(kinds=kinds, tables=tables,
+                                        snapshot=snapshot)
+    return list(checker.check_project(F.package_root()))
+
+
+_KINDS = {"REQ": 0, "HOT": 6, "HOT_CALL": 2}
+_TABLES = {"hot_template_fields": ["function_id", "function_name"],
+           "hot_call_fields": ["task_id", "sequence_no"]}
+
+
+def _frame_snap(kinds=None, tables=None):
+    return {"frame_kinds": kinds if kinds is not None else dict(_KINDS),
+            **(tables if tables is not None else
+               {k: list(v) for k, v in _TABLES.items()})}
+
+
+def test_frame_drift_clean_when_all_agree():
+    assert not _frame_drift(_KINDS, _TABLES, _frame_snap())
+
+
+def test_frame_drift_changed_kind_value_fails():
+    findings = _frame_drift(dict(_KINDS, HOT=9), _TABLES, _frame_snap())
+    assert any("changed" in f.message and "frozen" in f.message
+               for f in findings)
+
+
+def test_frame_drift_removed_kind_fails():
+    kinds = dict(_KINDS)
+    del kinds["HOT_CALL"]
+    findings = _frame_drift(kinds, _TABLES, _frame_snap())
+    assert any("gone from the tree" in f.message for f in findings)
+
+
+def test_frame_drift_new_kind_needs_snapshot_update():
+    findings = _frame_drift(dict(_KINDS, HOT_NEW=7), _TABLES,
+                            _frame_snap())
+    assert any("--baseline-update" in f.message for f in findings)
+
+
+def test_frame_drift_field_reorder_fails_append_passes():
+    reordered = {"hot_template_fields": ["function_name", "function_id"],
+                 "hot_call_fields": list(_TABLES["hot_call_fields"])}
+    findings = _frame_drift(_KINDS, reordered, _frame_snap())
+    assert any("append-only" in f.message for f in findings)
+    grown = {"hot_template_fields":
+             [*_TABLES["hot_template_fields"], "new_field"],
+             "hot_call_fields": list(_TABLES["hot_call_fields"])}
+    findings = _frame_drift(_KINDS, grown, _frame_snap())
+    assert len(findings) == 1 and "--baseline-update" in \
+        findings[0].message
+
+
+def test_frame_snapshot_matches_committed_tree():
+    """The committed wire_frames.json must exactly track the live
+    constants/tables (the real checker runs in the package-lints-clean
+    test; this pins the file against hand edits)."""
+    kinds, tables = C.live_frame_schema()
+    snapshot = C.load_frame_snapshot()
+    assert snapshot.get("frame_kinds") == kinds
+    for table, live in tables.items():
+        assert snapshot.get(table) == live
+
+
+# ----------------------------------------------------- pickle-in-hot-path
+
+
+def test_pickle_in_hot_path_fires_outside_blessed_helpers():
+    src = """
+        import pickle
+
+        def send_request(self, method, payload):
+            return pickle.dumps((method, payload), protocol=5)
+    """
+    findings = lint_src(src, C.PickleInHotPathChecker(),
+                        rel="ant_ray_tpu/_private/protocol.py")
+    assert len(findings) == 1
+    assert "blessed framing helpers" in findings[0].message
+
+
+def test_pickle_in_hot_path_blessed_helper_is_silent():
+    src = """
+        import pickle
+
+        def _encode_frame(msg):
+            return pickle.dumps(msg, protocol=5)
+
+        def encode_template(tid, spec):
+            return pickle.dumps(spec, protocol=5)
+    """
+    assert not lint_src(src, C.PickleInHotPathChecker(),
+                        rel="ant_ray_tpu/_private/hotframe.py")
+
+
+def test_pickle_in_hot_path_scoped_to_framing_layer():
+    checker = C.PickleInHotPathChecker()
+    assert checker.applies_to("ant_ray_tpu/_private/protocol.py")
+    assert checker.applies_to("ant_ray_tpu/_private/hotframe.py")
+    assert not checker.applies_to("ant_ray_tpu/_private/gcs.py")
+    assert not checker.applies_to("ant_ray_tpu/serve/api.py")
+
+
+def test_pickle_in_hot_path_suppression_works():
+    src = """
+        import pickle
+
+        def hot_send(self, payload):
+            # artlint: disable=pickle-in-hot-path — measured cold path
+            return pickle.dumps(payload)
+    """
+    assert not lint_src(src, C.PickleInHotPathChecker(),
+                        rel="ant_ray_tpu/_private/protocol.py")
