@@ -788,6 +788,74 @@ class PickleInHotPathChecker(Checker):
         return findings
 
 
+class MetricTagCardinalityChecker(Checker):
+    """Per-request identifiers used as metric TAGS.  Every distinct tag
+    value mints a new series in the GCS metric store (keyed on
+    ``(name, sorted(tags))`` — gcs.py ``_metric_record``), so tagging
+    by ``task_id``/``trace_id``/... grows the store linearly with
+    traffic until ``MetricsGet`` and the ``/metrics`` scrape drown.
+    High-cardinality samples belong in EXEMPLARS (``observe(...,
+    exemplar=task_id)`` keeps the last sample per bucket, bounded) —
+    the ``exemplar=`` kwarg is deliberately not matched.
+
+    UNDER-match: only literal dict keys in a ``tags={...}`` kwarg on
+    metric-shaped calls (``Counter/Gauge/Histogram`` constructors and
+    ``.inc/.set/.observe/.record`` methods) and literal ``tag_keys=``
+    tuples on the constructors are flagged — a tags dict built in a
+    variable is invisible, and that's the accepted price of zero false
+    positives."""
+
+    rule = "metric-tag-cardinality"
+    prevents = ("observability review: a task_id tag on a latency "
+                "histogram minted one series per task and ballooned "
+                "the GCS metric store past the /metrics scrape budget")
+
+    _BANNED_KEYS = frozenset({"task_id", "trace_id", "object_id",
+                              "request_id"})
+    _METRIC_CTORS = frozenset({"Counter", "Gauge", "Histogram"})
+    _METRIC_METHODS = frozenset({"inc", "set", "observe", "record"})
+
+    def _banned_in(self, node: ast.AST) -> list[str]:
+        """Banned identifier strings appearing as literal keys/items."""
+        if isinstance(node, ast.Dict):
+            items: Iterable[ast.AST] = node.keys
+        elif isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            items = node.elts
+        else:
+            return []
+        return sorted({n.value for n in items
+                       if isinstance(n, ast.Constant)
+                       and isinstance(n.value, str)
+                       and n.value in self._BANNED_KEYS})
+
+    def check(self, rel_path: str, tree: ast.AST,
+              lines: list[str]) -> Iterable[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _terminal_name(node.func)
+            is_ctor = name in self._METRIC_CTORS
+            is_method = (name in self._METRIC_METHODS
+                         and isinstance(node.func, ast.Attribute))
+            if not (is_ctor or is_method):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "tags" or (is_ctor and kw.arg == "tag_keys"):
+                    banned = self._banned_in(kw.value)
+                    if banned:
+                        findings.append(self.finding(
+                            rel_path, node,
+                            f"per-request identifier(s) "
+                            f"{', '.join(banned)} as metric tag(s) on "
+                            f"{name}() — each distinct value mints a "
+                            "new series and grows the GCS metric store "
+                            "with traffic; drop the tag or attach the "
+                            "id as an exemplar (exemplar= stays "
+                            "bounded per bucket)", lines))
+        return findings
+
+
 FILE_CHECKERS: list[Checker] = [
     BlockingUnderLockChecker(),
     BlockingInAsyncChecker(),
@@ -795,6 +863,7 @@ FILE_CHECKERS: list[Checker] = [
     BaseExceptionSwallowChecker(),
     ResponseTruthinessChecker(),
     PickleInHotPathChecker(),
+    MetricTagCardinalityChecker(),
 ]
 
 PROJECT_CHECKERS: list[ProjectChecker] = [

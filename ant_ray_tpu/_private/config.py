@@ -303,6 +303,22 @@ class Config:
     # are pending (age-flushed at 1s regardless).
     trace_publish_batch: int = 128
 
+    # ---- continuous CPU profiling (observability/cpu_profiler.py) ----
+    # Sampling rate of the always-on wall-clock profiler that every
+    # process class (driver, daemons, workers, GCS replicas, agents)
+    # runs.  67 Hz is the classic off-by-one-from-round prime that
+    # avoids lockstep with 10ms/100ms periodic work; 0 disables the
+    # whole profiling plane (sampler, publication, wire-counter
+    # rollups).  Env channel: ART_CPU_PROFILE_HZ.
+    cpu_profile_hz: float = 67.0
+    # How often each process publishes its folded-stack delta (and its
+    # wire-accounting counter deltas) to the GCS CpuProfileAdd ring.
+    cpu_profile_publish_period_s: float = 2.5
+    # Bound on DISTINCT folded stacks aggregated per process; once full,
+    # new stacks collapse into a single "(overflow)" bucket so a
+    # pathological stack churn can't grow memory.
+    cpu_profile_max_stacks: int = 800
+
     # ---- cluster state observatory (_private/task_state.py) ----
     # Per-job cap on the GCS task-state table (ref: GcsTaskManager's
     # MAX_NUM_TASK_EVENTS_PER_JOB GC policy, gcs_task_manager.h:60):

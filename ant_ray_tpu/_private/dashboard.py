@@ -411,8 +411,39 @@ def create_app(gcs_address: str, session_dir: str):
                                  retries=3) or []
             except Exception:  # noqa: BLE001 — pre-upgrade GCS
                 spans = []
+            try:
+                profiles = gcs.call("CpuProfileGet", {"limit": 4000},
+                                    retries=3) or []
+            except Exception:  # noqa: BLE001 — pre-upgrade GCS
+                profiles = []
             return build_chrome_trace(events, step_events=steps,
-                                      span_events=spans)
+                                      span_events=spans,
+                                      cpu_profile=profiles)
+        return web.json_response(await _call(build))
+
+    async def cpuprofile(req):
+        """Merged collapsed-stack capture of the whole cluster (or one
+        node with ``?node_id=<prefix>``): the CLI `profile` data behind
+        an HTTP GET.  ``?since_ts=`` narrows the window."""
+        def build():
+            from ant_ray_tpu.observability import cpu_profiler  # noqa: PLC0415
+
+            payload: dict = {}
+            if req.query.get("node_id"):
+                payload["node_id"] = req.query["node_id"]
+            if req.query.get("proc"):
+                payload["proc"] = req.query["proc"]
+            if req.query.get("since_ts"):
+                payload["since_ts"] = float(req.query["since_ts"])
+            records = gcs.call("CpuProfileGet", payload, retries=3) or []
+            merged = cpu_profiler.merge_folded(records)
+            return {"records": len(records),
+                    "procs": sorted({r.get("proc", "?")
+                                     for r in records}),
+                    "samples": sum(int(r.get("samples") or 0)
+                                   for r in records),
+                    "stacks": merged,
+                    "collapsed": cpu_profiler.render_folded(merged)}
         return web.json_response(await _call(build))
 
     async def trace(req):
@@ -617,6 +648,7 @@ def create_app(gcs_address: str, session_dir: str):
     app.router.add_get("/api/insight", insight)
     app.router.add_get("/api/export_events", export_events)
     app.router.add_get("/api/timeline", timeline)
+    app.router.add_get("/api/cpuprofile", cpuprofile)
     app.router.add_get("/api/trace/{trace_id}", trace)
     app.router.add_get("/api/flightrecorder", flightrecorder)
     app.router.add_get("/api/logs", node_logs)

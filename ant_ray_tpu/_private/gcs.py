@@ -148,6 +148,10 @@ class GcsServer:
         # tracing_plane.py — batch-published per-process flight
         # recorders; /api/trace/{id} and the timeline read it back)
         self._span_events: deque = deque(maxlen=50000)
+        # bounded ring of folded-stack CPU-profile deltas (observability/
+        # cpu_profiler.py — one record per process per publish period;
+        # the CLI `profile` capture and /api/cpuprofile read it back)
+        self._cpu_profile: deque = deque(maxlen=4000)
         self._dirty_locations: set[ObjectID] = set()
         # ---- pubsub (ref: src/ray/pubsub/publisher.h — long-poll
         # channels; here one global sequence + per-event channel tag so a
@@ -224,6 +228,8 @@ class GcsServer:
             "StepEventsGet": self._step_events_get,
             "SpanEventsAdd": self._span_events_add,
             "SpanEventsGet": self._span_events_get,
+            "CpuProfileAdd": self._cpu_profile_add,
+            "CpuProfileGet": self._cpu_profile_get,
             "MetricsExpire": self._metrics_expire,
             "GetHaView": self._get_ha_view,
             "SubPoll": self._sub_poll,
@@ -249,6 +255,28 @@ class GcsServer:
                 self._location_flush_loop(), self._io.loop)
         if self._ha is not None:
             self._ha.start()
+        # Continuous CPU profiling: the GCS ingests its own records —
+        # the publisher appends straight into the local ring (each HA
+        # replica keeps its own shard; CpuProfileGet merges at query
+        # time) and metric rollups run through the local handler on the
+        # io loop.  Instance profiler, not the module singleton: HA
+        # tests run several replicas in one process.
+        from ant_ray_tpu.observability import cpu_profiler  # noqa: PLC0415
+
+        self._cpu_profiler = None
+        if global_config().cpu_profile_hz > 0:
+            def _publish_profile(record, server=self):
+                server._cpu_profile.append(record)
+
+            def _publish_metric(payload, server=self):
+                asyncio.run_coroutine_threadsafe(
+                    server._metric_record(payload), server._io.loop)
+
+            self._cpu_profiler = cpu_profiler.CpuProfiler(
+                "gcs", publish_fn=_publish_profile,
+                metric_fn=_publish_metric,
+                node_id=(f"gcs-{self._ha.replica_id}"
+                         if self._ha is not None else "gcs")).start()
         logger.info("GCS listening on %s%s", self.address,
                     f" (HA replica {self._ha.replica_id})"
                     if self._ha is not None else "")
@@ -494,6 +522,10 @@ class GcsServer:
         sockets close with it anyway."""
         if self._health_task is not None:
             self._health_task.cancel()
+        profiler = getattr(self, "_cpu_profiler", None)
+        if profiler is not None:
+            self._cpu_profiler = None
+            profiler.stop(final_publish=False)
         if self._ha is not None:
             # Releases a held lease so a standby takes over immediately
             # (graceful failover) instead of waiting out the TTL.
@@ -1068,6 +1100,37 @@ class GcsServer:
                 spans.extend(peer_spans)
             spans.sort(key=lambda s: s.get("ts") or 0.0)
         return spans[-limit:]
+
+    # ---------------------------------------------------- cpu profiles
+    # (observability/cpu_profiler.py: every process class publishes its
+    #  folded-stack delta each publish period; one bounded ring like
+    #  step/span events, sharded under HA and merged at query time)
+
+    async def _cpu_profile_add(self, payload):
+        self._cpu_profile.extend(payload.get("records", ()))
+        return True
+
+    async def _cpu_profile_get(self, payload):
+        payload = payload or {}
+        limit = int(payload.get("limit", 4000))
+        node_id = payload.get("node_id")
+        proc = payload.get("proc")
+        since_ts = payload.get("since_ts")
+        records = list(self._cpu_profile)
+        if node_id:
+            records = [r for r in records
+                       if str(r.get("node_id", "")).startswith(node_id)]
+        if proc:
+            records = [r for r in records if r.get("proc") == proc]
+        if since_ts is not None:
+            records = [r for r in records
+                       if (r.get("ts") or 0.0) >= float(since_ts)]
+        if self._ha is not None and not payload.get("local_only"):
+            for peer_records in await self._ha.gather_ring(
+                    "CpuProfileGet", payload):
+                records.extend(peer_records)
+            records.sort(key=lambda r: r.get("ts") or 0.0)
+        return records[-limit:]
 
     # -------------------------------------------------------- metrics
     # (ref: src/ray/stats/metric.h registry + the dashboard metrics

@@ -54,6 +54,7 @@ class NodeAgent:
         self._profiling = threading.Lock()
         self._stop_publish = threading.Event()
         self._publish_thread: threading.Thread | None = None
+        self._cpu_profiler = None
 
     def start(self) -> str:
         self._server.routes({
@@ -73,10 +74,31 @@ class NodeAgent:
                 target=self._publish_device_stats_loop, args=(interval,),
                 daemon=True, name="agent-device-stats")
             self._publish_thread.start()
+        # Continuous CPU profiling: the agent publishes through its own
+        # blocking GCS client (the publish runs on the sampler thread,
+        # never the io loop).
+        from ant_ray_tpu.observability import cpu_profiler  # noqa: PLC0415
+
+        self._cpu_profiler = None
+        if global_config().cpu_profile_hz > 0:
+            def _publish_profile(record, agent=self):
+                agent._clients.get(agent._gcs_address).call(
+                    "CpuProfileAdd", {"records": [record]}, timeout=5)
+
+            def _publish_metric(payload, agent=self):
+                agent._clients.get(agent._gcs_address).call(
+                    "MetricRecord", payload, timeout=5)
+
+            self._cpu_profiler = cpu_profiler.CpuProfiler(
+                "agent", publish_fn=_publish_profile,
+                metric_fn=_publish_metric).start()
         return self.address
 
     def stop(self) -> None:
         self._stop_publish.set()
+        if self._cpu_profiler is not None:
+            profiler, self._cpu_profiler = self._cpu_profiler, None
+            profiler.stop(final_publish=False)
         if self._publish_thread is not None:
             self._publish_thread.join(timeout=2.0)
         self._server.stop()

@@ -327,6 +327,26 @@ class NodeManager:
 
         tracing_plane.set_publisher(_publish_spans)
         tracing_plane.set_metric_recorder(_publish_metric)
+        # Continuous CPU profiling: the daemon's sampler ships folded
+        # stacks (and its wire-counter rollups) through the same
+        # oneway-via-io-loop channel as the span publisher above.  An
+        # instance profiler, not the module singleton — tests run
+        # multiple daemons in one process.
+        from ant_ray_tpu.observability import cpu_profiler  # noqa: PLC0415
+
+        self._cpu_profiler = None
+        if global_config().cpu_profile_hz > 0:
+            def _publish_profile(record, manager=self):
+                gcs = manager._clients.get(manager._gcs_address)
+                asyncio.run_coroutine_threadsafe(
+                    gcs.oneway_async("CpuProfileAdd",
+                                     {"records": [record]}),
+                    manager._io.loop)
+
+            self._cpu_profiler = cpu_profiler.CpuProfiler(
+                "daemon", publish_fn=_publish_profile,
+                metric_fn=_publish_metric,
+                node_id=self.node_id.hex()).start()
         logger.info("node %s listening on %s (resources=%s)",
                     self.node_id.hex()[:8], self.address, self._total)
         return self.address
@@ -744,6 +764,10 @@ class NodeManager:
 
     def stop(self):
         self._stopping = True
+        profiler = getattr(self, "_cpu_profiler", None)
+        if profiler is not None:
+            self._cpu_profiler = None
+            profiler.stop(final_publish=False)
         for t in self._tasks:
             t.cancel()
         # Destroy the store first: everything after can take seconds and
@@ -2125,8 +2149,14 @@ class NodeManager:
                 continue
             # Full round with no viable holder: fail-fast bookkeeping
             # (true holderless rounds only) and the (only) inter-round
-            # sleep.
-            if not holderless:
+            # sleep.  A locally-spilled (or mid-produce) object never
+            # feeds the clock: the holder list excludes THIS node, so
+            # on a single-holder node every round is "holderless" even
+            # while the payload sits in the local spill dir — and a
+            # transiently-failing restore (store full of pinned
+            # entries) would otherwise escalate into a terminal
+            # "no holders" verdict on an object that provably exists.
+            if not holderless or self.store.contains(object_id):
                 no_holders_since = None
             elif fail_fast_after is not None:
                 now = time.monotonic()

@@ -346,7 +346,21 @@ class ObjectStore:
         try:
             self.create(object_id, payload)
         except ObjectStoreFullError:
-            return False               # record kept; retry later
+            # The arena needs a CONTIGUOUS range: pinned entries can
+            # fragment the free space so the alloc fails even though
+            # accounting says the payload fits.  A spilled object must
+            # not become unreadable while capacity exists — fall back
+            # to a file-per-object entry (mmap'd by readers like any
+            # file entry; no contiguous requirement).  Only a true
+            # accounting shortfall (capacity consumed by pins) keeps
+            # the record for a later retry.
+            if self._used + size > self._capacity:
+                return False           # record kept; retry later
+            with open(self.path_of(object_id), "wb") as f:
+                f.write(payload)
+            self._entries[object_id] = ObjectEntry(
+                object_id, size, sealed=True)
+            self._used += size
         del self._spilled[object_id]
         try:
             os.unlink(path)

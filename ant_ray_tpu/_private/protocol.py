@@ -237,22 +237,80 @@ def _spawn(coro) -> None:
     task.add_done_callback(_background_tasks.discard)
 
 
+# ------------------------------------------------- wire cost accounting
+#
+# (method, direction) -> [frames, bytes, encode_ns]: this process's
+# cumulative control-plane wire cost, per wire_schema method.  The
+# hotframe.counters idiom — a module dict mutated without a lock; each
+# list-slot += is a handful of GIL-serialized bytecodes, and a lost
+# increment under a rare interleave is acceptable for accounting that
+# exists to rank methods by cost.  The profiler's publish tick rolls the
+# deltas up into art_rpc_bytes_total / art_rpc_frames_total through
+# MetricRecord (see observability/cpu_profiler.py), so per-node
+# control-plane cost is a scrapeable series.
+
+wire_counters: dict = {}
+_wire_published: dict = {}
+
+
+def _wire_account(method: str, direction: str, nbytes: int,
+                  encode_ns: int = 0, conn_stats: dict | None = None):
+    key = (method, direction)
+    entry = wire_counters.get(key)
+    if entry is None:
+        entry = wire_counters.setdefault(key, [0, 0, 0])
+    entry[0] += 1
+    entry[1] += nbytes
+    entry[2] += encode_ns
+    if conn_stats is not None:
+        conn_entry = conn_stats.get(key)
+        if conn_entry is None:
+            conn_entry = conn_stats.setdefault(key, [0, 0, 0])
+        conn_entry[0] += 1
+        conn_entry[1] += nbytes
+        conn_entry[2] += encode_ns
+
+
+def wire_deltas() -> dict:
+    """(method, direction) -> (frames, bytes, encode_ns) accumulated
+    since the previous call.  Single-consumer by design: the process's
+    profiler publish tick owns the delta cursor; tests and debuggers
+    read ``wire_counters`` directly."""
+    out = {}
+    for key, entry in list(wire_counters.items()):
+        totals = (entry[0], entry[1], entry[2])
+        last = _wire_published.get(key, (0, 0, 0))
+        delta = (totals[0] - last[0], totals[1] - last[1],
+                 totals[2] - last[2])
+        if any(delta):
+            out[key] = delta
+            _wire_published[key] = totals
+    return out
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
+    """One frame off the wire: ``(kind, msg_id, method, payload,
+    nbytes)`` — nbytes is the full on-wire size (header included), the
+    recv side of wire accounting."""
     header = await reader.readexactly(_HEADER)
     length = int.from_bytes(header, "big")
     if length & _HOT_FLAG:
         # Hand the body over undecoded: hot-frame decode needs the
         # per-connection template table, which the caller owns.
-        data = await reader.readexactly(length & _LEN_MASK)
-        return _HOT, -1, "", data
+        body_len = length & _LEN_MASK
+        data = await reader.readexactly(body_len)
+        return _HOT, -1, "", data, _HEADER + body_len
     if length & _RAW_FLAG:
-        data = await reader.readexactly(length & ~_RAW_FLAG)
+        body_len = length & ~_RAW_FLAG
+        data = await reader.readexactly(body_len)
         meta_len = int.from_bytes(data[:4], "big")
         kind, msg_id, method, _ = pickle.loads(data[4:4 + meta_len])
         # Zero-copy hand-off: a view over the (immutable) read buffer.
-        return kind, msg_id, method, memoryview(data)[4 + meta_len:]
+        return (kind, msg_id, method, memoryview(data)[4 + meta_len:],
+                _HEADER + body_len)
     data = await reader.readexactly(length)
-    return pickle.loads(data)
+    kind, msg_id, method, payload = pickle.loads(data)
+    return kind, msg_id, method, payload, _HEADER + length
 
 
 def _encode_frame(msg: Any) -> bytes:
@@ -286,7 +344,7 @@ class _ServerConn:
     every reply that completed in the same io-loop tick)."""
 
     __slots__ = ("writer", "write_lock", "templates", "acks",
-                 "flush_scheduled")
+                 "flush_scheduled", "wire_stats")
 
     def __init__(self, writer, write_lock):
         self.writer = writer
@@ -294,6 +352,9 @@ class _ServerConn:
         self.templates: dict[int, tuple] = {}
         self.acks: list[bytes] = []
         self.flush_scheduled = False
+        # Per-connection (method, direction) -> [frames, bytes,
+        # encode_ns], mirrored into the module-level rollup.
+        self.wire_stats: dict = {}
 
 
 def _encode_raw_head(kind: int, msg_id: int, method: str,
@@ -364,9 +425,16 @@ class RpcServer:
         try:
             while True:
                 try:
-                    kind, msg_id, method, payload = await _read_frame(reader)
+                    kind, msg_id, method, payload, nbytes = \
+                        await _read_frame(reader)
                 except (asyncio.IncompleteReadError, ConnectionResetError):
                     return
+                # Hot frames carry no method name on the wire — that is
+                # the point of the template cache — but every hot call
+                # is a PushTask by contract, so the accounting stays
+                # per-method.
+                _wire_account("PushTask" if kind == _HOT else method,
+                              "recv", nbytes, conn_stats=conn.wire_stats)
                 if kind == _HELLO:
                     peer = (payload or {}).get("proto")
                     if peer != PROTOCOL_VERSION:
@@ -517,7 +585,10 @@ class RpcServer:
         if not conn.acks:
             return
         records, conn.acks = conn.acks, []
+        t0 = time.perf_counter_ns()
         frame = _encode_hot_frame(hotframe.frame_acks(records))
+        _wire_account("PushTask", "send", len(frame),
+                      time.perf_counter_ns() - t0, conn.wire_stats)
         try:
             conn.writer.write(frame)
             if conn.writer.transport.get_write_buffer_size() > \
@@ -541,8 +612,10 @@ class RpcServer:
                 # Two writes, both synchronous: the transport consumes
                 # the payload view before returning, so a shared-memory
                 # window is safe to hand over without copying.
-                writer.write(_encode_raw_head(msg[0], msg[1], msg[2],
-                                              len(data)))
+                head = _encode_raw_head(msg[0], msg[1], msg[2],
+                                        len(data))
+                _wire_account(msg[2], "send", len(head) + len(data))
+                writer.write(head)
                 writer.write(data)
                 if writer.transport.get_write_buffer_size() > \
                         _DRAIN_THRESHOLD:
@@ -552,11 +625,14 @@ class RpcServer:
             finally:
                 msg[3].done()
             return
+        t0 = time.perf_counter_ns()
         try:
             frame = _encode_frame(msg)
         except Exception:  # noqa: BLE001 — unpicklable error payload
             frame = _encode_frame((_ERR, msg[1], msg[2],
                                    RpcError(repr(msg[3]))))
+        _wire_account(msg[2], "send", len(frame),
+                      time.perf_counter_ns() - t0)
         try:
             writer.write(frame)
             if writer.transport.get_write_buffer_size() > _DRAIN_THRESHOLD:
@@ -590,7 +666,10 @@ class RpcServer:
                 self._write_reply(writer, write_lock,
                                   (_REP, msg_id, method, result))
                 return
+            t0 = time.perf_counter_ns()
             frame = _encode_frame((_REP, msg_id, method, result))
+            _wire_account(method, "send", len(frame),
+                          time.perf_counter_ns() - t0)
         except Exception as e:  # noqa: BLE001 — forwarded to caller
             if kind == _ONEWAY:
                 logger.exception("oneway handler %s failed", method)
@@ -599,6 +678,7 @@ class RpcServer:
                 frame = _encode_frame((_ERR, msg_id, method, e))
             except Exception:
                 frame = _encode_frame((_ERR, msg_id, method, RpcError(repr(e))))
+            _wire_account(method, "send", len(frame))
         try:
             # Fast path mirrors RpcClient._write_frame: plain write when
             # the transport buffer is shallow, locked drain only under
@@ -669,6 +749,11 @@ class RpcClient:
         self._chaos_active = bool(self._chaos._probs
                                   or self._chaos._delays)
         self._closed = False
+        # Per-client (method, direction) -> [frames, bytes, encode_ns]
+        # wire cost, mirrored into the module-level rollup (survives
+        # reconnects: the unit of attribution is the peer, not the
+        # socket generation).
+        self.wire_stats: dict = {}
         # Shared done-callback for pending-entry cleanup (a per-call
         # lambda with a default-arg cell allocates a closure each).
         self._pop_pending_cb = self._pop_pending
@@ -710,14 +795,22 @@ class RpcClient:
                 # Advertise the hot wire; frames stay pickled until
                 # (unless) the server's HELLO-ack lands.
                 hello["hot"] = hotframe.HOT_WIRE_VERSION
-            writer.write(_encode_frame((_HELLO, -1, "__hello__", hello)))
+            hello_frame = _encode_frame((_HELLO, -1, "__hello__", hello))
+            _wire_account("__hello__", "send", len(hello_frame),
+                          conn_stats=self.wire_stats)
+            writer.write(hello_frame)
             _spawn(self._read_loop(reader, writer))
 
     async def _read_loop(self, reader, writer):
         version_err = None
         try:
             while True:
-                kind, msg_id, _method, payload = await _read_frame(reader)
+                kind, msg_id, _method, payload, nbytes = \
+                    await _read_frame(reader)
+                # Reply frames carry their method; coalesced hot-ack
+                # frames are all PushTask replies by contract.
+                _wire_account("PushTask" if kind == _HOT else _method,
+                              "recv", nbytes, conn_stats=self.wire_stats)
                 if kind == _GOODBYE:
                     version_err = RpcError(
                         f"{self.address} rejected this process: "
@@ -848,13 +941,20 @@ class RpcClient:
         that decides hot vs pickled encoding, shared by the sync and
         async send paths so they cannot desynchronize.  The tag is the
         connection a hot frame was encoded for (None for pickled)."""
+        t0 = time.perf_counter_ns()
         if method == "PushTask" and type(payload) is TaskSpec:
             hot = self._hot
             if hot is not None and hot.writer is self._writer:
                 frame = self._encode_hot_call(hot, payload, msg_id)
                 if frame is not None:
+                    _wire_account(method, "send", len(frame),
+                                  time.perf_counter_ns() - t0,
+                                  self.wire_stats)
                     return frame, hot.writer
-        return _encode_frame((_REQ, msg_id, method, payload)), None
+        frame = _encode_frame((_REQ, msg_id, method, payload))
+        _wire_account(method, "send", len(frame),
+                      time.perf_counter_ns() - t0, self.wire_stats)
+        return frame, None
 
     def try_send_deferred(self, method: str, payload: Any):
         """Sync defer-enqueue fast path (io-loop only): on an
@@ -1026,16 +1126,25 @@ class RpcClient:
 
     async def oneway_async(self, method: str, payload: Any = None) -> None:
         await self._ensure_connected()
-        await self._write_frame(_encode_frame((_ONEWAY, -1, method, payload)))
+        t0 = time.perf_counter_ns()
+        frame = _encode_frame((_ONEWAY, -1, method, payload))
+        _wire_account(method, "send", len(frame),
+                      time.perf_counter_ns() - t0, self.wire_stats)
+        await self._write_frame(frame)
 
     async def oneway_many(self, items) -> None:
         """Ship a batch of ``(method, payload)`` oneways in one
         transport write (the coalesced refcount/publish path: a burst
         of per-call notifications costs one syscall, not N)."""
         await self._ensure_connected()
-        await self._write_frame(b"".join(
-            _encode_frame((_ONEWAY, -1, method, payload))
-            for method, payload in items))
+        frames = []
+        for method, payload in items:
+            t0 = time.perf_counter_ns()
+            frame = _encode_frame((_ONEWAY, -1, method, payload))
+            _wire_account(method, "send", len(frame),
+                          time.perf_counter_ns() - t0, self.wire_stats)
+            frames.append(frame)
+        await self._write_frame(b"".join(frames))
 
     def call(self, method: str, payload: Any = None,
              timeout: float | None = None, retries: int = 0) -> Any:

@@ -123,6 +123,11 @@ METHODS: dict[str, dict] = {
                                "error?, node_id, pid}]}", "bool"),
     "SpanEventsGet": _m("gcs", "{limit?, trace_id?, node_id?, "
                                "errors_only?, local_only?}", "[span]"),
+    "CpuProfileAdd": _m("gcs", "{records: [{node_id, pid, proc, ts, "
+                               "dur_s, hz, samples, stacks: "
+                               "{folded: count}}]}", "bool"),
+    "CpuProfileGet": _m("gcs", "{limit?, node_id?, proc?, since_ts?, "
+                               "local_only?}", "[record]"),
     "MetricsExpire": _m("gcs", "{match_tags?, name_prefix?}",
                         "int (series dropped; per-entity gauge owners "
                         "call this at teardown so dead nodes/replicas "
@@ -300,12 +305,14 @@ GCS_FOLLOWER_READS = frozenset({
     "ListVirtualClusters", "ListJobs",
     "MetricsGet", "InsightGet",
     "TaskEventsGet", "StepEventsGet", "SpanEventsGet",
+    "CpuProfileGet",
     "ListTasks", "GetTask", "SummarizeTasks",
     "GetHaView",
 })
 
 GCS_RING_WRITES = frozenset({
     "TaskEventsAdd", "StepEventsAdd", "SpanEventsAdd",
+    "CpuProfileAdd",
 })
 
 

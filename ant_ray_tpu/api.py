@@ -109,6 +109,12 @@ def init(
         config=config,
     )
     global_worker.mode = CLUSTER_MODE
+    # Continuous CPU profiling of the driver itself (submission-path
+    # attribution: serialize vs frame-encode vs task_events vs io-loop
+    # — the item the `profile --diff` A/B tool exists for).
+    from ant_ray_tpu.observability import cpu_profiler  # noqa: PLC0415
+
+    cpu_profiler.start("driver")
     _register_atexit_once()
     return ClientContext(
         CLUSTER_MODE,
@@ -147,11 +153,13 @@ _exported_config_env: list = []
 
 def shutdown() -> None:
     from ant_ray_tpu._private import task_events  # noqa: PLC0415
+    from ant_ray_tpu.observability import cpu_profiler  # noqa: PLC0415
 
     try:
         task_events.flush()  # drain before the runtime goes away
     except Exception:  # noqa: BLE001 — observability must not block
         pass             # the disconnect (events are best-effort)
+    cpu_profiler.stop()  # idempotent; final publish rides the runtime
     global_worker.shutdown()
     # Undo _system_config env exports (restoring any pre-existing user
     # value) so the next init() in this process starts clean.

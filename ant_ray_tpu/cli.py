@@ -467,6 +467,83 @@ def cmd_logs(client: StateClient, args) -> int:
     return 1
 
 
+def cmd_profile(client: StateClient, args) -> int:
+    """Whole-cluster CPU capture: wait out the window, then merge every
+    process's published folded-stack deltas into ONE collapsed-stack
+    document (flamegraph.pl / speedscope input).  ``--out`` writes the
+    capture JSON that ``--diff`` consumes."""
+    import time  # noqa: PLC0415
+
+    from ant_ray_tpu.observability import cpu_profiler  # noqa: PLC0415
+
+    t0 = time.time()
+    duration = max(float(args.duration), 0.0)
+    if duration:
+        time.sleep(duration)
+    # Samplers publish on a period, not at capture edges: poll a short
+    # grace window until the record set stops growing, so a capture
+    # barely longer than one publish period still lands every process.
+    records: list = []
+    deadline = time.monotonic() + 6.0
+    while True:
+        payload: dict = {"since_ts": t0}
+        if args.node:
+            payload["node_id"] = args.node
+        fresh = client.call("CpuProfileGet", payload) or []
+        if len(fresh) > len(records):
+            records = fresh
+        elif records:
+            break
+        if time.monotonic() > deadline:
+            break
+        time.sleep(0.5)
+    merged = cpu_profiler.merge_folded(records)
+    capture = {
+        "ts": t0, "duration_s": duration,
+        "node_filter": args.node,
+        "records": len(records),
+        "procs": sorted({r.get("proc", "?") for r in records}),
+        "samples": sum(int(r.get("samples") or 0) for r in records),
+        "stacks": merged,
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(capture, f)
+
+    def render(p):
+        print(cpu_profiler.render_folded(p["stacks"]))
+        print(f"# {p['records']} records, {p['samples']} samples, "
+              f"procs: {','.join(p['procs']) or '-'}",
+              file=sys.stderr)
+
+    _emit(args, capture, render)
+    return 0
+
+
+def cmd_profile_diff(args) -> int:
+    """A/B two capture JSONs (from ``profile --out``): frames ranked by
+    self-time delta, B minus A — regressions first."""
+    from ant_ray_tpu.observability import cpu_profiler  # noqa: PLC0415
+
+    with open(args.diff[0]) as f:
+        a = json.load(f)
+    with open(args.diff[1]) as f:
+        b = json.load(f)
+    rows = cpu_profiler.diff_folded(a.get("stacks") or {},
+                                    b.get("stacks") or {})
+    payload = {"a": args.diff[0], "b": args.diff[1],
+               "frames": [{"frame": frame, "delta": delta,
+                           "a": sa, "b": sb}
+                          for frame, delta, sa, sb in rows]}
+
+    def render(p):
+        _table(p["frames"], [("frame", "FRAME"), ("delta", "DELTA"),
+                             ("a", "A_SAMPLES"), ("b", "B_SAMPLES")])
+
+    _emit(args, payload, render)
+    return 0
+
+
 def cmd_trace(client: StateClient, args) -> int:
     from ant_ray_tpu.observability.tracing_plane import span_tree  # noqa: PLC0415
 
@@ -552,11 +629,32 @@ def build_parser() -> argparse.ArgumentParser:
     p_trace = sub.add_parser("trace",
                              help="render one request's span tree")
     p_trace.add_argument("trace_id")
+
+    p_profile = sub.add_parser(
+        "profile", help="whole-cluster collapsed-stack CPU capture "
+                        "(flamegraph.pl / speedscope input)")
+    p_profile.add_argument("--node", default=None,
+                           help="node id prefix (default: every node)")
+    p_profile.add_argument("--all", action="store_true",
+                           help="whole cluster (the default; explicit "
+                                "for scripts)")
+    p_profile.add_argument("--duration", type=float, default=5.0,
+                           help="capture window seconds")
+    p_profile.add_argument("--out", default=None,
+                           help="write the capture JSON here (the "
+                                "--diff input format)")
+    p_profile.add_argument("--diff", nargs=2,
+                           metavar=("A.json", "B.json"), default=None,
+                           help="rank frames by self-time delta "
+                                "between two captures (no cluster "
+                                "needed)")
     return parser
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.command == "profile" and args.diff:
+        return cmd_profile_diff(args)  # purely local — no cluster
     client = StateClient(_resolve_address(args))
     try:
         if args.command == "status":
@@ -571,6 +669,8 @@ def main(argv: list[str] | None = None) -> int:
             return cmd_logs(client, args)
         if args.command == "trace":
             return cmd_trace(client, args)
+        if args.command == "profile":
+            return cmd_profile(client, args)
         return 2
     finally:
         client.pool.close_all()

@@ -549,6 +549,7 @@ RPC_METHOD_PLANES: dict[str, str] = {
     "SummarizeTasks": "observability", "ListJobs": "observability",
     "StepEventsAdd": "observability", "StepEventsGet": "observability",
     "SpanEventsAdd": "observability", "SpanEventsGet": "observability",
+    "CpuProfileAdd": "observability", "CpuProfileGet": "observability",
     "SubPoll": "control", "PublishLogs": "observability",
     "ExportEventsGet": "observability", "Shutdown": "control",
     "GetHaView": "control",

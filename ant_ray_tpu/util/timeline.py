@@ -64,6 +64,47 @@ def fetch_span_events(limit: int = 50000,
         return []
 
 
+def fetch_cpu_profile(limit: int = 4000) -> list[dict]:
+    from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+    runtime = global_worker.runtime
+    try:
+        return runtime._gcs.call("CpuProfileGet", {"limit": limit},
+                                 retries=3) or []
+    except Exception:  # noqa: BLE001 — pre-upgrade GCS without the ring
+        return []
+
+
+def build_cpu_profile_rows(profile_records: list[dict]) -> list[dict]:
+    """Sampler-publication rows from the continuous CPU profiler
+    (observability/cpu_profiler.py): one ``cpu-profile/<proc>-<pid>``
+    track per publishing process, each publication window an "X" slice
+    whose args carry the window's heaviest folded stacks — the
+    wall-clock task schedule above, where the CPU actually went below."""
+    trace: list[dict] = []
+    pid = "cpu-profile"
+    for rec in profile_records:
+        dur = float(rec.get("dur_s", 0.0))
+        if dur <= 0:
+            continue
+        ts_us = (float(rec.get("ts", 0.0)) - dur) * 1e6
+        node8 = str(rec.get("node_id", ""))[:8]
+        tid = f"{rec.get('proc', '?')}-{rec.get('pid', 0)}"
+        stacks = rec.get("stacks") or {}
+        top = sorted(stacks.items(), key=lambda kv: (-kv[1], kv[0]))[:5]
+        args = {"node_id": node8, "samples": rec.get("samples"),
+                "hz": rec.get("hz")}
+        for rank, (stack, count) in enumerate(top, start=1):
+            args[f"top{rank}"] = f"{count} {stack}"
+        trace.append({
+            "ph": "X", "cat": "cpu_profile",
+            "name": f"samples={rec.get('samples', 0)}",
+            "pid": pid, "tid": tid, "ts": ts_us, "dur": dur * 1e6,
+            "args": args,
+        })
+    return trace
+
+
 def build_request_rows(span_events: list[dict]) -> list[dict]:
     """Per-request rows from published trace spans
     (observability/tracing_plane.py): one ``request/<trace8>`` track per
@@ -141,7 +182,8 @@ def build_step_rows(step_events: list[dict]) -> list[dict]:
 
 def build_chrome_trace(events: list[dict],
                        step_events: list[dict] | None = None,
-                       span_events: list[dict] | None = None
+                       span_events: list[dict] | None = None,
+                       cpu_profile: list[dict] | None = None
                        ) -> list[dict]:
     by_task: dict[str, dict] = {}
     for event in events:
@@ -190,6 +232,8 @@ def build_chrome_trace(events: list[dict],
         trace.extend(build_step_rows(step_events))
     if span_events:
         trace.extend(build_request_rows(span_events))
+    if cpu_profile:
+        trace.extend(build_cpu_profile_rows(cpu_profile))
     return trace
 
 
@@ -202,7 +246,8 @@ def timeline(filename: str | None = None) -> list[dict] | str:
     event list."""
     trace = build_chrome_trace(fetch_task_events(),
                                step_events=fetch_step_events(),
-                               span_events=fetch_span_events())
+                               span_events=fetch_span_events(),
+                               cpu_profile=fetch_cpu_profile())
     if filename is None:
         return trace
     with open(filename, "w") as f:

@@ -238,6 +238,13 @@ _GUARDED_METRICS = {
     # query.  Both "lower".
     "task_state_ingest_overhead_ns": "lower",
     "state_list_tasks_us": "lower",
+    # Continuous profiling plane (PR 16): the always-on sampler's
+    # measured throughput tax on the pipelined actor-call workload
+    # (hard 0.02 budget in microbench), and the wire-accounting view of
+    # PushTask frame size — bytes-per-call creeping up is frame bloat
+    # on the hottest method of the wire.
+    "cpu_profiler_overhead_fraction": "lower",
+    "rpc_pushtask_send_bytes_per_call": "lower",
 }
 
 
@@ -308,10 +315,27 @@ def _step_profiler_overhead_ns(n_steps: int = 20000) -> float:
     return sorted(one_round() for _ in range(3))[1]
 
 
+def _rig_context() -> dict:
+    """The rig facts that decide whether two bench records are even
+    comparable: core count, the 1-minute load average (stamped before
+    AND after the run — a spike between them taints the numbers), and
+    whether the runtime lockcheck was on (it taxes every lock acquire).
+    Summary records carry these so BENCH_*.json archaeology can reject
+    apples-to-oranges comparisons instead of explaining them."""
+    ctx: dict = {"cpu_count": os.cpu_count(),
+                 "lockcheck": os.environ.get("ART_LOCKCHECK", "")}
+    try:
+        ctx["loadavg_1m"] = round(os.getloadavg()[0], 2)
+    except OSError:  # platform without getloadavg
+        ctx["loadavg_1m"] = None
+    return ctx
+
+
 def run_child() -> None:
     """Run one measurement; falls back through remat policies / batch on
     OOM inside this process (backend is known-alive once the first
     compile succeeds)."""
+    rig = _rig_context()
     # "matmuls" (dots_saveable + saved flash residuals) measured best on
     # v5e: no backward recompute, fits HBM at batch 8.  "none" is
     # deliberately absent — it OOMs at 400m/batch-8 and the failed
@@ -331,7 +355,10 @@ def run_child() -> None:
                 continue  # next (cheaper) plan
             break  # non-OOM: report it — parent decides about retry
     if result is None:
-        print(json.dumps(_error_record(last_err or "")))
+        record = _error_record(last_err or "")
+        record["rig"] = {**rig,
+                         "loadavg_1m_after": _rig_context()["loadavg_1m"]}
+        print(json.dumps(record))
         return
     if result.get("backend") in ("tpu", "axon"):
         # Secondary metric: the north-star model SHAPE on one chip —
@@ -386,6 +413,8 @@ def run_child() -> None:
             result["bench_regression"] = regressions
     except Exception as e:  # noqa: BLE001
         result["bench_regression_error"] = repr(e)[:120]
+    rig_after = _rig_context()
+    result["rig"] = {**rig, "loadavg_1m_after": rig_after["loadavg_1m"]}
     print(json.dumps(result))
 
 

@@ -353,6 +353,66 @@ def main() -> None:
     finally:
         cfg.trace_sample_rate = old_rate
 
+    # ---- continuous-profiler overhead (observability/cpu_profiler.py):
+    # the pipelined actor-call workload with the driver's sampler
+    # stopped vs. running.  Arms run in ABBA order — every bench arm
+    # leaves the cluster a little slower (the GCS task table grows with
+    # each burst), so a fixed off-then-on order reads that monotone
+    # drift as profiler overhead; ABBA gives both arms the same mean
+    # position and cancels it.  The fraction compares MEDIANS of the
+    # per-arm rates (a median-of-ratios amplifies single-round noise on
+    # 1-cpu rigs).  Budgeted at <= 2% — the always-on contract the
+    # profiler ships under (bench_error past it, like the other
+    # observability budgets).  Runs AFTER the traced sections: its
+    # extra pipelined calls must not pollute the span recorder the
+    # wire-stage means read.
+    from ant_ray_tpu.observability import cpu_profiler  # noqa: PLC0415
+
+    n_prof = max(400, int(2000 * scale))
+
+    def rate(n) -> float:
+        t0 = time.perf_counter()
+        actor_async(n)
+        return n / (time.perf_counter() - t0)
+
+    def arm(sampler_on: bool) -> float:
+        if sampler_on:
+            cpu_profiler.start("driver")
+        else:
+            cpu_profiler.stop()
+        rate(n_prof // 4)                              # settle each arm
+        return rate(n_prof)
+
+    offs, ons = [], []
+    for sampler_on in (False, True, True, False, False, True, True,
+                       False):
+        (ons if sampler_on else offs).append(arm(sampler_on))
+    prof_frac = max(0.0, 1.0 - sorted(ons)[2] / sorted(offs)[2])
+    emit("cpu_profiler_overhead_fraction", prof_frac, "fraction")
+    if prof_frac > 0.02:
+        print(json.dumps({"metric": "bench_error",
+                          "bench_error":
+                          f"cpu_profiler_overhead_fraction={prof_frac:.4f}"
+                          " exceeds 0.02 budget"}))
+
+    # ---- wire cost accounting smoke (protocol.wire_counters): the
+    # per-method byte counters behind art_rpc_bytes_total, read around
+    # a known burst of pushes.  Guarded "lower": bytes-per-call creeping
+    # up is frame bloat on the hottest method of the wire.
+    from ant_ray_tpu._private import protocol  # noqa: PLC0415
+
+    def push_send_bytes() -> int:
+        entry = protocol.wire_counters.get(("PushTask", "send"))
+        return entry[1] if entry else 0
+
+    before_bytes = push_send_bytes()
+    n_push = max(200, int(1000 * scale))
+    actor_async(n_push)
+    delta_bytes = push_send_bytes() - before_bytes
+    assert delta_bytes > 0, "PushTask wire accounting recorded nothing"
+    emit("rpc_pushtask_send_bytes_per_call", delta_bytes / n_push,
+         "bytes/call")
+
     # ---- cluster state observatory (_private/task_state.py): (a) the
     # per-event fold cost on the TaskEventsAdd ingest path — the gcs.py
     # export-gate comment pins why per-event work there must stay ~free

@@ -831,3 +831,55 @@ def test_pickle_in_hot_path_suppression_works():
     """
     assert not lint_src(src, C.PickleInHotPathChecker(),
                         rel="ant_ray_tpu/_private/protocol.py")
+
+
+# ----------------------------------------------- metric-tag-cardinality
+
+
+def test_metric_tag_cardinality_fires_on_tags_and_tag_keys():
+    src = """
+        def report(self, task_id, dur):
+            self._latency.observe(dur, tags={"task_id": task_id})
+            hist = Histogram("art_task_s", tag_keys=("node_id", "trace_id"))
+            self._count.inc(1, tags={"node_id": "n", "request_id": rid})
+    """
+    findings = lint_src(src, C.MetricTagCardinalityChecker())
+    assert len(findings) == 3
+    assert all(f.rule == "metric-tag-cardinality" for f in findings)
+    messages = " ".join(f.message for f in findings)
+    assert "task_id" in messages and "trace_id" in messages \
+        and "request_id" in messages
+
+
+def test_metric_tag_cardinality_fix_and_exemplar_are_silent():
+    src = """
+        def report(self, task_id, dur):
+            # bounded tags are fine; the id rides as an exemplar
+            self._latency.observe(dur, tags={"node_id": "n"},
+                                  exemplar=task_id)
+            hist = Histogram("art_task_s", tag_keys=("node_id", "method"))
+            self._count.inc(1)
+    """
+    assert not lint_src(src, C.MetricTagCardinalityChecker())
+
+
+def test_metric_tag_cardinality_under_matches_non_metric_calls():
+    # .set() on a non-metric receiver, a dict built elsewhere, and a
+    # plain function taking tags= are all outside the matched shapes.
+    src = """
+        def other(self, task_id):
+            self._event.set()
+            tags = {"task_id": task_id}
+            self._latency.observe(1.0, tags=tags)
+            route(payload, tags={"task_id": task_id})
+    """
+    assert not lint_src(src, C.MetricTagCardinalityChecker())
+
+
+def test_metric_tag_cardinality_suppression_works():
+    src = """
+        def report(self, dur, tid):
+            # artlint: disable=metric-tag-cardinality — bounded test ids
+            self._latency.observe(dur, tags={"task_id": tid})
+    """
+    assert not lint_src(src, C.MetricTagCardinalityChecker())

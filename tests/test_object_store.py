@@ -143,3 +143,60 @@ def test_abort_buffer_allows_retry(store):
     store.abort_buffer(oid)
     store.create_buffer(oid, 64)  # retriable after abort
     store.seal_buffer(oid)
+
+
+@pytest.fixture
+def spill_store(tmp_path):
+    s = ObjectStore(str(tmp_path / "store"), capacity_bytes=1 << 20,
+                    spill_dir=str(tmp_path / "spill"))
+    yield s
+    s.destroy()
+
+
+def test_spilled_restore_file_fallback_on_fragmentation(
+        spill_store, monkeypatch):
+    """Arena fragmentation (pinned entries carving free space into
+    sub-payload holes) must not make a spilled object unreadable while
+    capacity exists: restore falls back to a file-per-object entry."""
+    if not spill_store.uses_arena:
+        pytest.skip("arena-only failure mode")
+    oid = ObjectID.from_random()
+    payload = os.urandom(256 * 1024)
+    spill_store.create(oid, payload)
+    for _ in range(5):                       # evict oid → spilled
+        spill_store.create(ObjectID.from_random(), os.urandom(256 * 1024))
+    assert spill_store.contains(oid)         # spilled, still ours
+
+    def fragmented(size):
+        raise ObjectStoreFullError("arena fragmented and nothing evictable")
+
+    monkeypatch.setattr(spill_store, "_arena_alloc", fragmented)
+    info = spill_store.locate(oid)           # restore under fragmentation
+    assert info is not None
+    assert info["offset"] is None            # file-backed fallback
+    assert bytes(open_object(info["path"])) == payload
+    assert spill_store.spilled_bytes == 0 or oid not in \
+        spill_store._spilled                 # spill record consumed
+
+
+def test_spilled_restore_retries_after_transient_full(spill_store):
+    """A restore rejected by TRUE accounting pressure (capacity consumed
+    by pins) keeps the spill record so a later access retries — the
+    object is never dropped."""
+    a, b = ObjectID.from_random(), ObjectID.from_random()
+    payload = os.urandom(900 * 1024)
+    spill_store.create(a, payload)
+    spill_store.create(b, os.urandom(900 * 1024))   # evicts a → spilled
+    assert spill_store.contains(a)
+    spill_store.pin(b, token=7)
+    assert spill_store.locate(a) is None     # restore blocked by the pin
+    assert spill_store.contains(a)           # record kept
+    spill_store.unpin(b, token=7)
+    info = spill_store.locate(a)             # retry succeeds (b evicts)
+    assert info is not None
+    if info["offset"] is not None:
+        client = ArenaClient()
+        assert bytes(client.view(info["path"], info["offset"],
+                                 len(payload))) == payload
+    else:
+        assert bytes(open_object(info["path"])) == payload

@@ -116,17 +116,28 @@ class _StepDecay(tune.Trainable):
         self.iter = 0
         self._rendezvous = config.get("rendezvous")
         self._rendezvous_count = config.get("rendezvous_count", 2)
+        self._step_sleep = config.get("step_sleep", 0.0)
         if self._rendezvous:
             open(os.path.join(self._rendezvous,
                               f"up_{config['offset']}"), "w").close()
 
     def step(self):
         if self._rendezvous and self.iter == 0:
-            deadline = time.monotonic() + 60
+            # Generous deadline: on a loaded CI rig the peer's actor can
+            # take tens of seconds to spawn behind the suite's other
+            # workers — a tight deadline turns fail-open into fail-flaky.
+            deadline = time.monotonic() + 120
             pattern = os.path.join(self._rendezvous, "up_*")
             while time.monotonic() < deadline and \
                     len(glob.glob(pattern)) < self._rendezvous_count:
                 time.sleep(0.02)      # fail-open: proceed at deadline
+        if self._step_sleep:
+            # Pace the steps: the rendezvous only aligns the START, and
+            # sub-millisecond steps let one trial finish its whole run
+            # between the peer's scheduler ticks when the rig is loaded.
+            # A small per-step sleep keeps the population overlapped
+            # through every perturbation interval.
+            time.sleep(self._step_sleep)
         self.iter += 1
         return {"loss": self.offset + 1.0 / self.iter}
 
@@ -179,7 +190,8 @@ def test_pbt_exploits_checkpoint_and_mutates_config(cluster, tmp_path):
     tuner = tune.Tuner(
         _StepDecay,
         param_space={"offset": tune.grid_search([0.0, 5.0]),
-                     "rendezvous": str(tmp_path)},
+                     "rendezvous": str(tmp_path),
+                     "step_sleep": 0.05},
         tune_config=tune.TuneConfig(
             stop={"training_iteration": 8},
             scheduler=tune.PopulationBasedTraining(
