@@ -232,12 +232,25 @@ class BannedApisChecker(Checker):
       ``deadline_ts`` (the wire-deadline naming convention) are
       allowlisted automatically; other deliberate sites carry a
       ``# artlint: disable=banned-apis — <why>`` rationale.
+    * bare ``asyncio.ensure_future(...)`` (result discarded, or the
+      function passed as a callback) in ``_private/`` →
+      ``protocol._spawn``: the event loop keeps only a WEAK reference
+      to tasks, so a fire-and-forget task with no strong ref can be
+      garbage-collected mid-flight and silently never finish (the
+      actor-sender restart path would strand a whole actor's queue).
+      Holding the returned task (assignment, container, await) is the
+      other sanctioned fix and is not flagged.
     """
 
     rule = "banned-apis"
     prevents = ("PR 5 root cause: asyncio.iscoroutine matched plain "
                 "generators on py<3.12 (all 8 pre-existing tier-1 "
-                "failures); NTP steps break time.time() intervals")
+                "failures); NTP steps break time.time() intervals; "
+                "GC'd fire-and-forget tasks strand actor send queues")
+
+    #: Where the ensure_future rule applies: the always-on control-plane
+    #: daemons, where a GC'd background task is a silent outage.
+    _SPAWN_SCOPE = ("ant_ray_tpu/_private/",)
 
     #: Identifiers whose presence on the flagged line marks the value as
     #: a cross-process wire field (wall clock is correct there).
@@ -253,6 +266,19 @@ class BannedApisChecker(Checker):
                     and isinstance(node.func, ast.Attribute)
                     and node.func.attr == "time"
                     and _base_name(node.func) == "time")
+
+        def _is_ensure_future(node: ast.AST) -> bool:
+            return (isinstance(node, ast.Attribute)
+                    and node.attr == "ensure_future"
+                    and _base_name(node) == "asyncio")
+
+        spawn_scoped = any(rel_path.startswith(p)
+                           for p in self._SPAWN_SCOPE)
+        # Attribute nodes serving as a call's callee — a bare
+        # `asyncio.ensure_future` reference OUTSIDE this set is being
+        # passed around as a callback (the call_soon_threadsafe shape).
+        callee_ids = {id(n.func) for n in ast.walk(tree)
+                      if isinstance(n, ast.Call)}
 
         class V(_StmtTracker):
             def _flag_time_arith(self, node: ast.AST):
@@ -286,6 +312,29 @@ class BannedApisChecker(Checker):
                         "asyncio.iscoroutine() also matches plain "
                         "generators on py<3.12 — use "
                         "inspect.iscoroutine()", lines))
+                self.generic_visit(node)
+
+            def visit_Expr(self, node: ast.Expr):
+                v = node.value
+                if (spawn_scoped and isinstance(v, ast.Call)
+                        and _is_ensure_future(v.func)):
+                    findings.append(checker.finding(
+                        rel_path, node,
+                        "bare asyncio.ensure_future() discards its "
+                        "task — the loop holds only a weak ref, so it "
+                        "can be GC'd mid-flight; use protocol._spawn "
+                        "(or hold the returned task)", lines))
+                self.generic_visit(node)
+
+            def visit_Attribute(self, node: ast.Attribute):
+                if (spawn_scoped and _is_ensure_future(node)
+                        and id(node) not in callee_ids):
+                    findings.append(checker.finding(
+                        rel_path, self.anchor(node),
+                        "asyncio.ensure_future passed as a bare "
+                        "callback — nothing holds the spawned task, so "
+                        "it can be GC'd mid-flight; pass "
+                        "protocol._spawn instead", lines))
                 self.generic_visit(node)
 
             def visit_BinOp(self, node: ast.BinOp):

@@ -372,3 +372,19 @@ def node_labels() -> dict[str, str]:
         if gen:
             labels["tpu-pod-type"] = infer_pod_type(topology, gen)
     return labels
+
+
+def slice_groups(pod_names) -> list:
+    """Group ranks by the TPU slice they sit on: ranks whose nodes
+    advertise the same ``tpu-pod-name`` label share ICI; distinct pod
+    names only reach each other over DCN.  Input is one pod name per
+    rank (``None``/"" ranks are treated as a standalone slice each —
+    a CPU stand-in host is its own 'slice').  Returns rank tuples,
+    ordered by each slice's lowest rank, for
+    ``SliceTopology.from_labels``."""
+    by_pod: dict = {}
+    for rank, pod in enumerate(pod_names):
+        key = pod if pod else f"_solo_{rank}"
+        by_pod.setdefault(key, []).append(rank)
+    return [tuple(ranks)
+            for ranks in sorted(by_pod.values(), key=lambda r: r[0])]

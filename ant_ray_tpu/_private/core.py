@@ -818,7 +818,7 @@ class ClusterRuntime(CoreRuntime):
         def _release():
             try:
                 loop.call_soon_threadsafe(
-                    asyncio.ensure_future,
+                    _spawn,
                     node.oneway_async("ReadDone", {"object_id": oid,
                                                    "pin_token": token}))
             except Exception:  # noqa: BLE001 — interpreter shutdown
@@ -898,7 +898,7 @@ class ClusterRuntime(CoreRuntime):
                 self._live_pins.add(pin)
                 if not self._pin_renewer_started:
                     self._pin_renewer_started = True
-                    asyncio.ensure_future(self._pin_renew_loop())
+                    _spawn(self._pin_renew_loop())
                 return memoryview(view), pin
             # Unpinned arena window (shouldn't happen): copy out for
             # safety — the slot could be recycled under us.
@@ -2267,7 +2267,7 @@ class ClusterRuntime(CoreRuntime):
         state.queue.append((spec, pinned, 0))
         if not state.sender_running:
             state.sender_running = True
-            asyncio.ensure_future(self._actor_sender(state))
+            _spawn(self._actor_sender(state))
 
     @staticmethod
     async def _safe_flush(client):
@@ -2343,7 +2343,7 @@ class ClusterRuntime(CoreRuntime):
             state.sender_running = False
             if state.queue:  # raced with a new enqueue
                 state.sender_running = True
-                asyncio.ensure_future(self._actor_sender(state))
+                _spawn(self._actor_sender(state))
 
     def _on_actor_reply_done(self, fut: asyncio.Future):
         state, spec, pinned, attempt = fut._art_actor_ctx
@@ -2356,7 +2356,7 @@ class ClusterRuntime(CoreRuntime):
             self._store_returns(spec, reply["returns"])
             self._unpin(pinned)
         except (RpcConnectionError, asyncio.CancelledError):
-            asyncio.ensure_future(self._on_actor_connection_loss(
+            _spawn(self._on_actor_connection_loss(
                 state, spec, pinned, attempt))
         except Exception as e:  # noqa: BLE001
             self._store_error(spec, exceptions.ArtError(repr(e)))
@@ -2378,7 +2378,7 @@ class ClusterRuntime(CoreRuntime):
             state.queue.appendleft((spec, pinned, attempt + 1))
             if not state.sender_running:
                 state.sender_running = True
-                asyncio.ensure_future(self._actor_sender(state))
+                _spawn(self._actor_sender(state))
             return
         if not may_restart:
             state.dead_reason = (info or {}).get(

@@ -19,7 +19,12 @@ from dataclasses import dataclass, field
 
 from ant_ray_tpu._private.config import global_config
 from ant_ray_tpu._private.ids import ActorID, JobID, NodeID, ObjectID
-from ant_ray_tpu._private.protocol import ClientPool, IoThread, RpcServer
+from ant_ray_tpu._private.protocol import (
+    ClientPool,
+    IoThread,
+    RpcServer,
+    _spawn,
+)
 from ant_ray_tpu._private.specs import (
     ACTOR_ALIVE,
     ACTOR_DEAD,
@@ -584,7 +589,7 @@ class GcsServer:
                 async with self._pub_cond:
                     self._pub_cond.notify_all()
 
-            asyncio.ensure_future(_notify())
+            _spawn(_notify())
 
     async def _export_events_get(self, payload):
         """Read back export-pipeline events (dashboard /api and tests;
@@ -1303,7 +1308,7 @@ class GcsServer:
         if spec.name:
             self._named_actors[key] = spec.actor_id
         self._save_actor(record)
-        asyncio.ensure_future(self._schedule_actor(record))
+        _spawn(self._schedule_actor(record))
         return {"ok": True}
 
     async def _schedule_actor(self, record: ActorRecord):
@@ -1646,7 +1651,7 @@ class GcsServer:
                 "actor_id": record.spec.actor_id,
                 "state": ACTOR_RESTARTING, "address": "",
                 "death_reason": ""})
-            asyncio.ensure_future(self._schedule_actor(record))
+            _spawn(self._schedule_actor(record))
         else:
             record.state = ACTOR_DEAD
             record.death_reason = reason
@@ -1723,6 +1728,7 @@ class GcsServer:
             "reason": "",
             "bundle_selectors": payload.get("bundle_label_selectors"),
             "same_label": payload.get("same_label"),
+            "same_label_groups": payload.get("same_label_groups"),
         }
         self._placement_groups[payload["pg_id"]] = record
         self._save_pg(record)
@@ -1731,12 +1737,13 @@ class GcsServer:
                 "EXPORT_PLACEMENT_GROUP", "PENDING", payload["pg_id"],
                 {"strategy": record["strategy"], "name": record["name"],
                  "bundles": record["bundles"]})
-        asyncio.ensure_future(self._schedule_placement_group(record))
+        _spawn(self._schedule_placement_group(record))
         return True
 
     def _plan_bundles(self, bundles, strategy, job_id=None,
                       bundle_selectors=None,
-                      same_label=None) -> list[NodeInfo] | None:
+                      same_label=None,
+                      same_label_groups=None) -> list[NodeInfo] | None:
         """Choose a node per bundle against the availability view; None if
         no valid assignment right now.  Candidates respect the job's
         virtual cluster.
@@ -1745,11 +1752,59 @@ class GcsServer:
         match).  ``same_label``: a label key whose VALUE must be shared by
         every chosen node — the slice-affinity constraint ("all bundles on
         one tpu-pod-name") behind SlicePlacementGroup (ref:
-        python/ray/util/tpu.py:52, bundle_label_selector)."""
+        python/ray/util/tpu.py:52, bundle_label_selector).
+        ``same_label_groups``: lists of bundle indices, each group pinned
+        to ONE value of ``same_label`` and distinct groups to DISTINCT
+        values — the multi-slice gang constraint (each slice's ranks
+        co-located on one pod, different slices on different pods)."""
         allowed = self._allowed_nodes_for_job(job_id)
         alive = [n for n in self._nodes.values()
                  if n.alive and not getattr(n, "draining", False)
                  and (allowed is None or n.node_id in allowed)]
+        if same_label is not None and same_label_groups:
+            # Groups claim disjoint label values, so their node pools are
+            # disjoint — planning them sequentially with independent
+            # resource views is exact, not an approximation.  Greedy
+            # first-fit value choice per group (deterministic order so
+            # repeated attempts converge).
+            values = sorted({n.labels.get(same_label) for n in alive
+                             if n.labels.get(same_label) is not None})
+            plan_by_index: dict = {}
+            used_values: set = set()
+            for group in same_label_groups:
+                sub_bundles = [bundles[i] for i in group]
+                sub_selectors = ([bundle_selectors[i] for i in group]
+                                 if bundle_selectors else None)
+                placed = False
+                for value in values:
+                    if value in used_values:
+                        continue
+                    pool = [n for n in alive
+                            if n.labels.get(same_label) == value]
+                    plan = self._plan_bundles_in(
+                        pool, sub_bundles, strategy, sub_selectors)
+                    if plan is not None:
+                        used_values.add(value)
+                        for i, node in zip(group, plan):
+                            plan_by_index[i] = node
+                        placed = True
+                        break
+                if not placed:
+                    return None
+            # Bundles outside every group (none for multi-slice PGs, but
+            # the contract allows it) plan unconstrained.
+            rest = [i for i in range(len(bundles))
+                    if i not in plan_by_index]
+            if rest:
+                rest_plan = self._plan_bundles_in(
+                    alive, [bundles[i] for i in rest], strategy,
+                    [bundle_selectors[i] for i in rest]
+                    if bundle_selectors else None)
+                if rest_plan is None:
+                    return None
+                for i, node in zip(rest, rest_plan):
+                    plan_by_index[i] = node
+            return [plan_by_index[i] for i in range(len(bundles))]
         if same_label is not None:
             # Try each value-group of the shared label independently;
             # first group that fits wins.  Deterministic order so
@@ -1844,7 +1899,8 @@ class GcsServer:
             plan = self._plan_bundles(
                 bundles, record["strategy"], record.get("job_id"),
                 bundle_selectors=record.get("bundle_selectors"),
-                same_label=record.get("same_label"))
+                same_label=record.get("same_label"),
+                same_label_groups=record.get("same_label_groups"))
             if plan is not None:
                 prepared = []
                 ok = True

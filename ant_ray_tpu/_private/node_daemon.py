@@ -34,6 +34,7 @@ from ant_ray_tpu._private.protocol import (
     RpcError,
     RpcServer,
     RpcTimeoutError,
+    _spawn,
 )
 from ant_ray_tpu._private.specs import ACTOR_DEAD, ActorSpec, NodeInfo
 
@@ -862,7 +863,7 @@ class NodeManager:
         was_actor = handle.actor_spec is not None
         if was_actor:
             client = self._clients.get(handle.address)
-            asyncio.ensure_future(
+            _spawn(
                 client.call_async("InstantiateActor", handle.actor_spec,
                                   timeout=-1))
             handle.state = ACTOR
@@ -906,7 +907,7 @@ class NodeManager:
                     # fire-and-forget here loses the actor forever
                     # (restored as ALIVE on resync with no one to
                     # correct it), so retry in the background.
-                    asyncio.ensure_future(self._report_worker_died(
+                    _spawn(self._report_worker_died(
                         gcs, worker_id, handle))
                 self._lease_event.set()
 
@@ -1644,7 +1645,7 @@ class NodeManager:
             finally:
                 self._owner_sweep_running = False
 
-        asyncio.ensure_future(_sweep())
+        _spawn(_sweep())
 
     async def _gcs_alive_hosts(self) -> set:
         """Host IPs of nodes the GCS currently believes alive — the
@@ -2556,7 +2557,7 @@ class NodeManager:
         try:
             gcs = self._clients.get(self._gcs_address)
             self._io.loop.call_soon_threadsafe(
-                asyncio.ensure_future,
+                _spawn,
                 gcs.oneway_async("ObjectLocationRemove", {
                     "object_id": object_id, "node_id": self.node_id}))
         except Exception:  # noqa: BLE001 — best-effort during teardown

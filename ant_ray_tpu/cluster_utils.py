@@ -18,6 +18,32 @@ from ant_ray_tpu._private import services
 from ant_ray_tpu._private.protocol import ClientPool
 
 
+def _descendant_pids(root_pid: int) -> list[int]:
+    """Every live descendant of ``root_pid`` (workers, agents, ...),
+    via one /proc scan.  Workers detach into their own sessions
+    (``start_new_session=True``) so a process-group kill can't reach
+    them — but their PPID still names the daemon that spawned them."""
+    children: dict[int, list[int]] = {}
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        try:
+            with open(f"/proc/{entry}/stat") as f:
+                # "pid (comm) state ppid ..." — comm may itself contain
+                # parens/spaces, so split off the LAST ')'.
+                ppid = int(f.read().rsplit(")", 1)[1].split()[1])
+        except (OSError, IndexError, ValueError):
+            continue
+        children.setdefault(ppid, []).append(int(entry))
+    out: list[int] = []
+    stack = [root_pid]
+    while stack:
+        for child in children.get(stack.pop(), ()):
+            out.append(child)
+            stack.append(child)
+    return out
+
+
 class Cluster:
     def __init__(self, initialize_head: bool = True,
                  head_node_args: dict | None = None):
@@ -25,6 +51,7 @@ class Cluster:
         self._gcs_procs: list[tuple[subprocess.Popen, str]] = []
         self._node_procs: list[subprocess.Popen] = []
         self._node_addresses: list[str] = []
+        self._node_labels: list[dict] = []
         self._gcs_standbys = 0
         self._gcs_replica_seq = 0
         self._pool = ClientPool()
@@ -87,6 +114,7 @@ class Cluster:
             self.gcs_address, node_resources, self._session_dir, labels)
         self._node_procs.append(proc)
         self._node_addresses.append(address)
+        self._node_labels.append(dict(labels or {}))
         return address
 
     def add_gcs_standby(self) -> str:
@@ -190,6 +218,42 @@ class Cluster:
         else:
             proc.kill()
         proc.wait(timeout=5)
+
+    def nodes_with_label(self, key: str, value: str) -> list[str]:
+        """Addresses of live node daemons started with label
+        ``key=value`` (e.g. every host of one simulated TPU slice)."""
+        return [addr
+                for addr, labels, proc in zip(self._node_addresses,
+                                              self._node_labels,
+                                              self._node_procs)
+                if labels.get(key) == value and proc.poll() is None]
+
+    def kill_slice(self, slice_id: str,
+                   label: str = "art-slice-id") -> list[str]:
+        """SIGKILL every process of every node labeled as slice
+        ``slice_id`` — the whole-slice failure domain of multi-slice
+        training (one DCN-linked slice loses power as a UNIT, taking
+        daemon, agent AND workers with it; single-node kills never
+        exercise the gang's all-ranks-at-once recovery).  Unlike
+        ``remove_node`` — whose orphaned workers model a daemon crash
+        and suicide only after their lagged liveness poll — power loss
+        is instantaneous, so the daemon's whole process tree dies
+        first.  Returns the killed addresses."""
+        import signal
+
+        victims = self.nodes_with_label(label, str(slice_id))
+        if not victims:
+            raise RuntimeError(
+                f"no live nodes labeled {label}={slice_id!r}")
+        for address in victims:
+            daemon = self._node_procs[self._node_addresses.index(address)]
+            for pid in _descendant_pids(daemon.pid):
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+            self.remove_node(address)
+        return victims
 
     def connect(self, **init_kwargs):
         import ant_ray_tpu as art  # noqa: PLC0415

@@ -47,9 +47,13 @@ PHASES = ("data_wait", "h2d", "compute", "collective")
 _FEED_PHASE_KEYS = (("consumer_starve_s", "data_wait"),
                     ("transfer_issue_s", "h2d"))
 # fusion stat key -> phase (pack/unpack are host work *for* the
-# collective; transfer is the bucket's host→device hop)
+# collective; transfer is the bucket's host→device hop).  overlap_s
+# (collective time hidden under backward compute by the ready-hook
+# GradientSyncer) carries weight -1: the collective phase reports only
+# the EXPOSED communication time.
 _FUSION_PHASE_KEYS = (("pack_s", "collective"), ("unpack_s", "collective"),
                       ("collective_s", "collective"), ("transfer_s", "h2d"))
+_FUSION_NEGATIVE_KEYS = (("overlap_s", "collective"),)
 
 
 @dataclass
@@ -258,6 +262,15 @@ class StepProfiler:
                     if delta > 0:
                         phases[phase] = phases.get(phase, 0.0) + delta
                     snap[key] = value
+        for entry in self._fusion_fns:
+            live, snap = entry["fn"](), entry["snap"]
+            for key, phase in _FUSION_NEGATIVE_KEYS:
+                value = live.get(key, 0.0)
+                delta = value - snap.get(key, 0.0)
+                if delta > 0 and phase in phases:
+                    # Compute-hidden share: subtract, never below zero.
+                    phases[phase] = max(0.0, phases[phase] - delta)
+                snap[key] = value
 
     # -------------------------------------------------- materialization
 

@@ -16,6 +16,7 @@ from ant_ray_tpu.train.session import (
     get_dataset_shard,
     get_world_rank,
     get_world_size,
+    gradient_syncer,
     report,
     sync_gradients,
 )
@@ -38,6 +39,7 @@ __all__ = [
     "get_dataset_shard",
     "get_world_rank",
     "get_world_size",
+    "gradient_syncer",
     "load_pytree",
     "report",
     "save_pytree",

@@ -23,6 +23,13 @@ class ScalingConfig:
     # ranks when the cluster can't place num_workers, growing back on
     # later restarts.  Incompatible with a whole-slice topology.
     min_workers: int = 0
+    # Multi-slice training: the gang spans this many accelerator
+    # slices (num_workers % num_slices == 0, contiguous rank blocks per
+    # slice).  >1 feeds sync_gradients a SliceTopology so the fused
+    # allreduce runs its two-level intra-slice (ICI) / inter-slice
+    # (DCN) schedule, and with use_tpu the gang reserves one placement
+    # group per slice (co-located by tpu-pod-name).
+    num_slices: int = 1
     use_tpu: bool = False
     topology: str = ""                  # e.g. "4x8" (whole-slice reservation)
     accelerator_type: str = "TPU-V5E"   # generation for slice math

@@ -47,12 +47,14 @@ class TrainWorker:
     """One rank of the worker group (actor)."""
 
     def __init__(self, rank: int, world_size: int, storage_path: str,
-                 experiment_name: str, use_tpu: bool):
+                 experiment_name: str, use_tpu: bool,
+                 num_slices: int = 1):
         self._rank = rank
         self._world_size = world_size
         self._storage_path = storage_path
         self._experiment_name = experiment_name
         self._use_tpu = use_tpu
+        self._num_slices = num_slices
 
     def propose_coordinator(self) -> str:
         """Rank 0 advertises host:port for the jax.distributed
@@ -89,6 +91,17 @@ class TrainWorker:
 
     def run(self, loop_fn, loop_config, controller, latest_checkpoint,
             attempt: int = 0, dataset_shards: dict | None = None):
+        topo = None
+        if (self._num_slices > 1
+                and self._world_size % self._num_slices == 0):
+            # Contiguous rank blocks per slice — matches the multi-slice
+            # PG's bundle layout (bundle s*hosts+i = host i of slice s),
+            # so sync_gradients' hierarchical allreduce keeps its DCN
+            # exchange to one message per slice.
+            from ant_ray_tpu.util.collective.types import SliceTopology  # noqa: PLC0415
+
+            topo = SliceTopology.regular(self._world_size,
+                                         self._num_slices)
         ctx = TrainContext(
             world_rank=self._rank,
             world_size=self._world_size,
@@ -99,6 +112,7 @@ class TrainWorker:
             latest_checkpoint=latest_checkpoint,
             attempt=attempt,
             use_tpu=self._use_tpu,
+            slice_topology=topo,
             dataset_shards=dataset_shards or {},
         )
         _set_context(ctx)
@@ -479,7 +493,8 @@ class TrainController:
                 ).remote(rank, world,
                          self._storage_path,
                          self._run_config.name or "run",
-                         scaling.use_tpu)
+                         scaling.use_tpu,
+                         getattr(scaling, "num_slices", 1))
                 for rank in range(world)
             ]
             # Rendezvous: rank 0's host coordinates (multi-host slices).
@@ -654,6 +669,32 @@ class TrainController:
         laptop path free of reservation latency)."""
         world = world if world is not None else scaling.num_workers
         if scaling.use_tpu and scaling.topology:
+            num_slices = getattr(scaling, "num_slices", 1)
+            if num_slices > 1:
+                from ant_ray_tpu.util.tpu import (  # noqa: PLC0415
+                    multi_slice_placement_group,
+                )
+
+                extra = {k: v
+                         for k, v in scaling.worker_resources().items()
+                         if k != "TPU"}
+                ms_pg = multi_slice_placement_group(
+                    scaling.topology, num_slices,
+                    scaling.accelerator_type,
+                    name=self._run_config.pg_name(),
+                    bundle_extra=extra)
+                if scaling.num_workers != ms_pg.num_hosts:
+                    ms_pg.remove()
+                    raise ValueError(
+                        f"num_workers={scaling.num_workers} does not "
+                        f"match the {ms_pg.num_hosts} hosts of "
+                        f"{num_slices}x slice {scaling.topology}")
+                if not ms_pg.ready(timeout=120):
+                    ms_pg.remove()
+                    raise RuntimeError(
+                        f"could not reserve {num_slices} TPU slices of "
+                        f"{scaling.topology}")
+                return ms_pg.placement_group, ms_pg
             from ant_ray_tpu.util.tpu import slice_placement_group  # noqa: PLC0415
 
             # Bundles must cover everything a rank actor demands — the

@@ -25,6 +25,11 @@ class TrainContext:
     # Whether this rank binds TPU chips (picks the collective backend
     # for sync_gradients: xla on TPU gangs, gloo on CPU gangs).
     use_tpu: bool = False
+    # Rank→slice partition of the gang (collective.types.SliceTopology)
+    # when the job spans multiple accelerator slices; sync_gradients
+    # routes through the hierarchical intra-slice (ICI) / inter-slice
+    # (DCN) allreduce when set.
+    slice_topology: Any = None
     # name -> DataIterator for this rank (from the trainer's datasets=).
     dataset_shards: dict = field(default_factory=dict)
     # The loop's StepProfiler (observability/step_profiler.py) — it
@@ -129,14 +134,31 @@ def sync_gradients(grads, op=None, *, group_name: str | None = None,
     (attempt-unique name, so a restarted gang never collides with its
     predecessor's) — xla backend on TPU gangs, gloo on CPU gangs.
     ``fusion_knobs`` forward to ``collective.sync_pytree``
-    (``bucket_bytes``, ``transport_dtype``, ``overlap``).  World size
-    1 returns the pytree unchanged."""
+    (``bucket_bytes``, ``transport_dtype``, ``overlap``,
+    ``hierarchy``); when the gang spans multiple slices
+    (``ScalingConfig.num_slices`` / TPU pod labels), the context's
+    slice topology is the default hierarchy.  World size 1 returns the
+    pytree unchanged."""
     ctx = get_context()
     if ctx.world_size <= 1:
         return grads
 
     from ant_ray_tpu.util import collective as col  # noqa: PLC0415
     from ant_ray_tpu.util.collective import ReduceOp  # noqa: PLC0415
+
+    group = _ensure_gang_group(ctx, group_name)
+    fusion_knobs.setdefault("hierarchy", ctx.slice_topology)
+    return col.sync_pytree(grads, group_name=group,
+                           op=ReduceOp.AVERAGE if op is None else op,
+                           **fusion_knobs)
+
+
+def _ensure_gang_group(ctx: TrainContext,
+                       group_name: "str | None" = None) -> str:
+    """Lazily create this gang's collective group (shared by
+    sync_gradients and gradient_syncer) and wire its fusion stats into
+    the step profiler."""
+    from ant_ray_tpu.util import collective as col  # noqa: PLC0415
 
     group = group_name or (
         f"train-sync-{ctx.experiment_name or 'run'}-a{ctx.attempt}")
@@ -151,9 +173,32 @@ def sync_gradients(grads, op=None, *, group_name: str | None = None,
                 ctx.step_profiler.attach_fusion_stats(group)
             except Exception:  # noqa: BLE001 — telemetry is best-effort
                 pass
-    return col.sync_pytree(grads, group_name=group,
-                           op=ReduceOp.AVERAGE if op is None else op,
-                           **fusion_knobs)
+    return group
+
+
+def gradient_syncer(op=None, *, group_name: str | None = None,
+                    **fusion_knobs):
+    """Ready-hook gradient sync for overlapping communication with the
+    backward pass (util/collective/fusion.py GradientSyncer): leaves
+    are assigned to buckets in reverse-topological order, and each
+    bucket's collective launches the moment its last leaf
+    materializes — call ``begin(template)`` once per step,
+    ``ready(i, grad)`` as each leaf's gradient lands, and ``wait()``
+    for the averaged pytree.  ``sync_gradients`` is the one-shot
+    degenerate form.  Returns None at world size 1 (nothing to sync —
+    callers fall back to their local gradients)."""
+    ctx = get_context()
+    if ctx.world_size <= 1:
+        return None
+
+    from ant_ray_tpu.util import collective as col  # noqa: PLC0415
+    from ant_ray_tpu.util.collective import ReduceOp  # noqa: PLC0415
+
+    group = _ensure_gang_group(ctx, group_name)
+    fusion_knobs.setdefault("hierarchy", ctx.slice_topology)
+    return col.gradient_syncer(
+        group, op=ReduceOp.AVERAGE if op is None else op,
+        **fusion_knobs)
 
 
 def get_checkpoint():

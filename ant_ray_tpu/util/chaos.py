@@ -176,6 +176,26 @@ class ChaosSchedule:
 
         return self.at_step(step, _kill, label="kill_leader")
 
+    def fault_slice(self, step: int, slice_id: str, cluster,
+                    label: str = "art-slice-id") -> "ChaosSchedule":
+        """Schedule a **whole-slice failure** at logical step ``step``:
+        SIGKILL every node daemon of one accelerator slice (nodes
+        labeled ``label=slice_id``) in the same fire — the multi-slice
+        failure domain, where a slice's power/DCN drops all its hosts
+        as a unit and the training gang must drain and restart from the
+        last checkpoint with zero steps lost.  Membership resolves at
+        fire time (nodes may have joined since scheduling).  The killed
+        addresses land in :attr:`killed_slices` for assertions."""
+        self.killed_slices: dict[str, list[str]] = getattr(
+            self, "killed_slices", {})
+
+        def _kill():
+            self.killed_slices[str(slice_id)] = cluster.kill_slice(
+                slice_id, label=label)
+
+        return self.at_step(step, _kill,
+                            label=f"fault_slice:{slice_id}")
+
     def fire(self, step: int) -> list[str]:
         """Run every not-yet-fired action scheduled at or before
         ``step`` (deterministic order: step, then registration).

@@ -49,6 +49,21 @@ class BaseGroup:
                                        timeout_ms=opts.timeout_ms))[0])
         return out
 
+    def bucket_transfer(self, flat, bucket,
+                        opts: types.AllReduceCoalescedOptions):
+        """Stage one packed bucket payload toward the backend (host→HBM
+        ``device_put`` for xla, torch wrap for gloo).  Exposed per
+        bucket so both ``run_coalesced`` and the ready-hook
+        ``GradientSyncer`` can drive single buckets."""
+        raise NotImplementedError
+
+    def bucket_reduce(self, staged, bucket,
+                      opts: types.AllReduceCoalescedOptions):
+        """Run one bucket's fused reduction on a staged payload and
+        return the reduced flat buffer (accumulated at float32 for
+        reduced-precision transports)."""
+        raise NotImplementedError
+
     def fusion_stats(self) -> dict:
         """Cumulative fused-collective stats (device_feed idiom); the
         naive fallback has nothing to report."""

@@ -46,6 +46,8 @@ class GlooGroup(BaseGroup):
         dist.init_process_group(
             "gloo", init_method=init_method, rank=rank,
             world_size=world_size)
+        # topology.slices -> prebuilt intra-slice / leaders subgroups
+        self._hier_cache: dict = {}
 
     @classmethod
     def backend(cls):
@@ -104,34 +106,203 @@ class GlooGroup(BaseGroup):
         per-call gloo round trip is paid ~#buckets times, not #tensors
         times.  A reduced-precision bucket (``transport_dtype``) was
         quantized once at pack time; the reduction itself runs at
-        float32 (accumulate-in-f32, EQuARX-style)."""
+        float32 (accumulate-in-f32, EQuARX-style).  With
+        ``opts.hierarchy`` the reduction runs the two-level intra-slice
+        / inter-slice schedule (see :meth:`bucket_reduce`)."""
+        from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
+
+        if getattr(self, "_fusion_stats", None) is None:
+            self._fusion_stats = fusion.FusionStats()
+
+        def transfer(flat, bucket):
+            return self.bucket_transfer(flat, bucket, opts)
+
+        def reduce_bucket(staged, bucket):
+            return self.bucket_reduce(staged, bucket, opts)
+
+        return fusion.run_coalesced(tensors, opts, transfer_fn=transfer,
+                                    collective_fn=reduce_bucket,
+                                    stats=self._fusion_stats)
+
+    # ---- per-bucket stages (driven by run_coalesced AND GradientSyncer)
+
+    def bucket_transfer(self, flat, bucket,
+                        opts: types.AllReduceCoalescedOptions):
+        import torch  # noqa: PLC0415
+
+        if bucket.transport_dtype == "int8":
+            # pack_bucket produced (codes, scales); ship both as one
+            # contiguous byte tensor — THESE are the wire bytes
+            # (≈ size + 4·size/QUANT_BLOCK vs 4·size for float32).
+            q, scales = flat
+            wire = np.concatenate([q.view(np.uint8),
+                                   scales.view(np.uint8)])
+            return torch.from_numpy(wire)
+        if bucket.transport_dtype != bucket.dtype:
+            # The lossy cast already happened in pack_bucket;
+            # upcast so gloo accumulates at full precision.
+            flat = flat.astype(np.float32)
+        try:
+            return torch.from_numpy(flat)   # zero-copy wrap
+        except TypeError:
+            # ml_dtypes bucket (bfloat16 leaves): float32 bridge —
+            # unpack restores the leaf dtype.
+            return torch.from_numpy(flat.astype(np.float32))
+
+    def bucket_reduce(self, staged, bucket,
+                      opts: types.AllReduceCoalescedOptions):
+        from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
+
+        if getattr(self, "_fusion_stats", None) is None:
+            self._fusion_stats = fusion.FusionStats()
+        stats = self._fusion_stats
+        hier = self._hier_state(opts)
+        if bucket.transport_dtype == "int8":
+            return self._reduce_bucket_q8(staged, bucket, opts, hier,
+                                          stats)
+        return self._reduce_bucket_plain(staged, opts, hier, stats)
+
+    # ---- hierarchical (two-level) schedule
+
+    def _hier_state(self, opts) -> dict | None:
+        """Prebuilt torch.distributed subgroups for ``opts.hierarchy``,
+        or None when the topology degenerates to flat.  Every rank
+        creates every subgroup in the same deterministic order (a
+        ``dist.new_group`` contract); results are cached per topology.
+        """
+        topo = getattr(opts, "hierarchy", None)
+        if (topo is None or self._world_size == 1
+                or topo.num_slices <= 1):
+            return None
+        state = self._hier_cache.get(topo.slices)
+        if state is not None:
+            return state
+        dist = _dist()
+        topo.validate(self._world_size)
+        my_slice = topo.slice_of(self._rank)
+        intra_group = None
+        for sid, ranks in enumerate(topo.slices):
+            group = dist.new_group(list(ranks))
+            if sid == my_slice:
+                intra_group = group
+        leaders_group = dist.new_group(list(topo.leaders()))
+        state = {
+            "topo": topo,
+            "intra": intra_group,
+            "intra_ranks": topo.slices[my_slice],
+            "leaders": leaders_group,
+            "leader_rank": topo.leader(my_slice),
+            "is_leader": self._rank == topo.leader(my_slice),
+        }
+        self._hier_cache[topo.slices] = state
+        return state
+
+    def _reduce_bucket_plain(self, t, opts, hier, stats):
+        """Full-precision (or bf16-upcast) bucket reduction.  Flat: one
+        world-wide all_reduce.  Hierarchical: reduce inside each slice
+        (the ICI-analog hop), exchange once per *slice* between slice
+        leaders (the DCN hop — num_slices participants, not
+        world_size), then fan the result back out within each slice."""
+        dist = _dist()
+        if hier is None:
+            dist.all_reduce(t, op=_REDUCE_MAP[opts.reduce_op])
+            stats.dcn_participants += self._world_size
+            return t.numpy()
+        average = opts.reduce_op == types.ReduceOp.AVERAGE
+        # AVERAGE averaged per level would double-divide; SUM both
+        # levels and divide once at the end.  MIN/MAX/PRODUCT compose
+        # level-wise unchanged.
+        level_op = _REDUCE_MAP[types.ReduceOp.SUM if average
+                               else opts.reduce_op]
+        intra_n = len(hier["intra_ranks"])
+        if intra_n > 1:
+            dist.all_reduce(t, op=level_op, group=hier["intra"])
+        if hier["is_leader"]:
+            dist.all_reduce(t, op=level_op, group=hier["leaders"])
+        if intra_n > 1:
+            dist.broadcast(t, src=hier["leader_rank"],
+                           group=hier["intra"])
+        if average:
+            t = t / self._world_size
+        stats.dcn_participants += hier["topo"].num_slices
+        return t.numpy()
+
+    # ---- int8 blockwise-quantized wire
+
+    def _split_q8(self, wire: np.ndarray, size: int):
+        """One wire byte buffer → (int8 codes, float32 scales)."""
+        from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
+
+        n_blocks = fusion.quant_blocks(size)
+        codes = wire[:size].view(np.int8)
+        scales = wire[size:size + 4 * n_blocks].view(np.float32)
+        return codes, scales
+
+    def _gather_dequant_sum(self, wire_t, size: int, group, n: int
+                            ) -> np.ndarray:
+        """all_gather the quantized wire buffers of ``n`` peers (int8
+        codes + scales — the only bytes that cross this link), then
+        dequantize each contribution and accumulate at float32
+        (EQuARX-style: the wire is narrow, the math is not)."""
         import torch  # noqa: PLC0415
 
         from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
 
         dist = _dist()
-        if getattr(self, "_fusion_stats", None) is None:
-            self._fusion_stats = fusion.FusionStats()
+        if n == 1:
+            codes, scales = self._split_q8(wire_t.numpy(), size)
+            return fusion.dequantize_blockwise(codes, scales)
+        bufs = [torch.empty_like(wire_t) for _ in range(n)]
+        if group is None:
+            dist.all_gather(bufs, wire_t)
+        else:
+            dist.all_gather(bufs, wire_t, group=group)
+        acc: np.ndarray | None = None
+        for buf in bufs:
+            codes, scales = self._split_q8(buf.numpy(), size)
+            part = fusion.dequantize_blockwise(codes, scales)
+            acc = part if acc is None else acc + part
+        return acc
 
-        def transfer(flat, bucket):
-            if bucket.transport_dtype != bucket.dtype:
-                # The lossy cast already happened in pack_bucket;
-                # upcast so gloo accumulates at full precision.
-                flat = flat.astype(np.float32)
-            try:
-                return torch.from_numpy(flat)   # zero-copy wrap
-            except TypeError:
-                # ml_dtypes bucket (bfloat16 leaves): float32 bridge —
-                # unpack restores the leaf dtype.
-                return torch.from_numpy(flat.astype(np.float32))
+    def _reduce_bucket_q8(self, wire_t, bucket, opts, hier, stats):
+        """Quantized bucket reduction: peers exchange int8 codes +
+        scales and every rank accumulates the dequantized contributions
+        at float32.  Hierarchical: the intra-slice gather runs within
+        the slice, then each slice LEADER re-quantizes its partial sum
+        for the once-per-slice DCN exchange and fans the float32 result
+        back out."""
+        import torch  # noqa: PLC0415
 
-        def reduce_bucket(t, bucket):
-            dist.all_reduce(t, op=_REDUCE_MAP[opts.reduce_op])
-            return t.numpy()
+        from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
 
-        return fusion.run_coalesced(tensors, opts, transfer_fn=transfer,
-                                    collective_fn=reduce_bucket,
-                                    stats=self._fusion_stats)
+        dist = _dist()
+        size = bucket.size
+        average = opts.reduce_op == types.ReduceOp.AVERAGE
+        if hier is None:
+            acc = self._gather_dequant_sum(wire_t, size, None,
+                                           self._world_size)
+            stats.dcn_participants += self._world_size
+        else:
+            intra_n = len(hier["intra_ranks"])
+            acc = self._gather_dequant_sum(wire_t, size, hier["intra"],
+                                           intra_n)
+            if hier["is_leader"]:
+                q2, s2 = fusion.quantize_blockwise(acc)
+                wire2 = torch.from_numpy(np.concatenate(
+                    [q2.view(np.uint8), s2.view(np.uint8)]))
+                acc = self._gather_dequant_sum(
+                    wire2, size, hier["leaders"],
+                    hier["topo"].num_slices)
+            if intra_n > 1:
+                acc_t = torch.from_numpy(
+                    np.ascontiguousarray(acc, dtype=np.float32))
+                dist.broadcast(acc_t, src=hier["leader_rank"],
+                               group=hier["intra"])
+                acc = acc_t.numpy()
+            stats.dcn_participants += hier["topo"].num_slices
+        if average:
+            acc = acc / self._world_size
+        return acc
 
     def barrier(self, opts: types.BarrierOptions):
         _dist().barrier()

@@ -90,6 +90,11 @@ class XLAGroup(BaseGroup):
 
         devices = (self._rank_devices if n_dev == len(self._rank_devices)
                    else self._devices)
+        if verb.startswith("hier_"):
+            return self._compile_hierarchical(verb, shape, n_dev, extra,
+                                              devices)
+        if verb.endswith("_q8"):
+            return self._compile_q8(verb, shape, n_dev, extra, devices)
         mesh = Mesh(np.array(devices[:n_dev]), ("world",))
         axis = "world"
 
@@ -137,6 +142,85 @@ class XLAGroup(BaseGroup):
 
         fn = _shard_map()(op, mesh=mesh, in_specs=P(axis), out_specs=P(axis))
         return jax.jit(fn), mesh, NamedSharding(mesh, P(axis))
+
+    def _compile_q8(self, verb: str, shape: tuple, n_dev: int, extra,
+                    devices):
+        """Blockwise-int8 quantized allreduce (EQuARX-style): the
+        all_gather moves int8 codes plus the float32 scale sidecar —
+        the only bytes on the wire — and every rank dequantizes and
+        accumulates at float32.  ``verb`` is ``allreduce_{sum,average}_q8``,
+        ``extra`` is ``(block, n_blocks)``; one compiled program per
+        bucket shape rides the same ``_compiled`` LRU as the plain
+        verbs."""
+        jax = _jax()
+        import jax.numpy as jnp  # noqa: PLC0415
+        from jax.sharding import Mesh, NamedSharding  # noqa: PLC0415
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        block, _n_blocks = extra
+        size = shape[0]
+        average = verb.startswith("allreduce_average")
+        mesh = Mesh(np.array(devices[:n_dev]), ("world",))
+        axis = "world"
+
+        def op(q, s):
+            # q: (1, size) int8, s: (1, n_blocks) float32
+            qg = jax.lax.all_gather(q[0], axis)      # (n, size) — wire
+            sg = jax.lax.all_gather(s[0], axis)      # (n, n_blocks)
+            scale = jnp.repeat(sg, block, axis=1)[:, :size]
+            out = (qg.astype(jnp.float32) * scale).sum(axis=0)
+            if average:
+                out = out / n_dev
+            return out[None]
+
+        fn = _shard_map()(op, mesh=mesh, in_specs=(P(axis), P(axis)),
+                          out_specs=P(axis))
+        return jax.jit(fn), mesh, NamedSharding(mesh, P(axis))
+
+    def _compile_hierarchical(self, verb: str, shape: tuple, n_dev: int,
+                              extra, devices):
+        """Two-level allreduce over a (slice, intra) mesh: reduce-
+        scatter within each slice (ICI), psum across slices (the DCN
+        exchange — each chunk crosses slice boundaries ONCE per slice,
+        so cross-slice traffic scales with num_slices, not world size),
+        then all_gather within the slice to rebuild the bucket.
+        ``verb`` is ``hier_allreduce_{sum,average}[_accf32]``; ``extra``
+        is the SliceTopology's rank partition (must be the regular
+        contiguous layout matching device order)."""
+        jax = _jax()
+        import jax.numpy as jnp  # noqa: PLC0415
+        from jax.sharding import Mesh, NamedSharding  # noqa: PLC0415
+        from jax.sharding import PartitionSpec as P  # noqa: PLC0415
+
+        slices = extra
+        num_slices = len(slices)
+        per = n_dev // num_slices
+        size = shape[0]
+        accf32 = verb.endswith("_accf32")
+        average = "allreduce_average" in verb
+        mesh = Mesh(np.array(devices[:n_dev]).reshape(num_slices, per),
+                    ("slice", "intra"))
+
+        def op(x):
+            y = x[0, 0]                               # (size,)
+            if accf32:
+                y = y.astype(jnp.float32)
+            if per > 1 and size % per == 0:
+                y = jax.lax.psum_scatter(y, "intra", tiled=True)
+                y = jax.lax.psum(y, "slice")
+                y = jax.lax.all_gather(y, "intra", tiled=True)
+            else:
+                # Odd-sized bucket: no clean scatter tiling — reduce
+                # whole within the slice, then across slices.
+                y = jax.lax.psum(y, "intra")
+                y = jax.lax.psum(y, "slice")
+            if average:
+                y = y / n_dev
+            return y[None, None]
+
+        spec = P("slice", "intra")
+        fn = _shard_map()(op, mesh=mesh, in_specs=spec, out_specs=spec)
+        return jax.jit(fn), mesh, NamedSharding(mesh, spec)
 
     # ------------------------------------------------------------ runners
 
@@ -227,21 +311,116 @@ class XLAGroup(BaseGroup):
 
         if getattr(self, "_fusion_stats", None) is None:
             self._fusion_stats = fusion.FusionStats()
-        verb = self._reduce_verb(opts.reduce_op)
 
         def transfer(flat, bucket):
-            wire_verb = verb + ("_accf32"
-                                if bucket.transport_dtype != bucket.dtype
-                                else "")
-            return self._stage_rank_verb(wire_verb, flat)
+            return self.bucket_transfer(flat, bucket, opts)
 
         def reduce_bucket(staged, bucket):
-            jitted, arr = staged
-            return jitted(arr).addressable_shards[0].data[0]
+            return self.bucket_reduce(staged, bucket, opts)
 
         return fusion.run_coalesced(tensors, opts, transfer_fn=transfer,
                                     collective_fn=reduce_bucket,
                                     stats=self._fusion_stats)
+
+    # ---- per-bucket stages (driven by run_coalesced AND GradientSyncer)
+
+    def _hier_topology(self, opts):
+        """The validated hierarchy for this group, or None.  The xla
+        mesh reshape needs the regular contiguous rank→slice layout
+        (rank i on mesh cell (i // per, i % per)); anything else falls
+        back to the flat ring."""
+        from ant_ray_tpu.util.collective.types import SliceTopology  # noqa: PLC0415
+
+        topo = getattr(opts, "hierarchy", None)
+        if topo is None:
+            return None
+        world = self._world_size
+        if world % max(1, topo.num_slices) != 0:
+            return None
+        if topo.slices != SliceTopology.regular(
+                world, topo.num_slices).slices:
+            return None
+        return topo
+
+    def bucket_transfer(self, flat, bucket,
+                        opts: types.AllReduceCoalescedOptions):
+        """Transfer stage of one fused bucket: compile-cache lookup +
+        host→HBM ``device_put``.  Picks the wire program — plain,
+        ``_accf32`` (narrow-float transport, f32 accumulate), ``_q8``
+        (blockwise int8 + scale sidecar), or ``hier_*`` (two-level
+        slice schedule; quantized buckets keep the flat q8 exchange)."""
+        jax = _jax()
+        from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
+
+        verb = self._reduce_verb(opts.reduce_op)
+        if bucket.transport_dtype == "int8":
+            q, scales = flat
+            jitted, arr_q = self._stage_rank_operand(
+                verb + "_q8", q,
+                key_shape=tuple(q.shape),
+                key_dtype="int8",
+                extra=(fusion.QUANT_BLOCK,
+                       fusion.quant_blocks(bucket.size)))
+            _jit2, arr_s = self._stage_rank_operand(
+                verb + "_q8", scales,
+                key_shape=tuple(q.shape), key_dtype="int8",
+                extra=(fusion.QUANT_BLOCK,
+                       fusion.quant_blocks(bucket.size)),
+                operand_index=1)
+            return ("q8", jitted, (arr_q, arr_s), self._world_size)
+        topo = self._hier_topology(opts)
+        if topo is not None:
+            t = np.asarray(flat)
+            wire_verb = "hier_" + verb + (
+                "_accf32" if bucket.transport_dtype != bucket.dtype
+                else "")
+            jitted, mesh, sharding = self._compiled(
+                wire_verb, tuple(t.shape), str(t.dtype),
+                len(self._rank_devices), topo.slices)
+            per = self._world_size // topo.num_slices
+            shard = jax.device_put(t[None, None],
+                                   self._rank_devices[self._rank])
+            arr = jax.make_array_from_single_device_arrays(
+                (topo.num_slices, per) + t.shape, sharding, [shard])
+            return ("hier", jitted, (arr,), topo.num_slices)
+        wire_verb = verb + ("_accf32"
+                            if bucket.transport_dtype != bucket.dtype
+                            else "")
+        jitted, arr = self._stage_rank_verb(wire_verb, flat)
+        return ("flat", jitted, (arr,), self._world_size)
+
+    def _stage_rank_operand(self, verb: str, tensor, *, key_shape,
+                            key_dtype, extra, operand_index: int = 0):
+        """Stage one operand of a (possibly multi-input) compiled verb:
+        the LRU key is pinned to the BUCKET's shape/dtype so sidecar
+        operands (q8 scales) do not mint extra cache entries."""
+        jax = _jax()
+        if not self._federated_ok:
+            raise RuntimeError(
+                f"xla group {self._group_name!r} needs "
+                f"{self._world_size} federated processes but "
+                f"jax.process_count() == {jax.process_count()}.")
+        t = np.asarray(tensor)
+        jitted, mesh, sharding = self._compiled(
+            verb, key_shape, key_dtype, len(self._rank_devices), extra)
+        shard = jax.device_put(t[None], self._rank_devices[self._rank])
+        arr = jax.make_array_from_single_device_arrays(
+            (self._world_size,) + t.shape, sharding, [shard])
+        return jitted, arr
+
+    def bucket_reduce(self, staged, bucket,
+                      opts: types.AllReduceCoalescedOptions):
+        from ant_ray_tpu.util.collective import fusion  # noqa: PLC0415
+
+        if getattr(self, "_fusion_stats", None) is None:
+            self._fusion_stats = fusion.FusionStats()
+        kind, jitted, args, dcn = staged
+        out = jitted(*args)
+        block = out.addressable_shards[0].data
+        self._fusion_stats.dcn_participants += dcn
+        if kind == "hier":
+            return block[0, 0]
+        return block[0]
 
     def barrier(self, opts: types.BarrierOptions):
         if self._world_size > 1:

@@ -21,12 +21,21 @@ bucketed flat-buffer fix:
   same discipline as ``data/device_feed.py``).
 * **Reduced-precision transport** — opt-in ``transport_dtype=
   "bfloat16"`` packs float buckets at half width (halving host→HBM
-  bytes); the reduction itself accumulates in float32
+  bytes); ``transport_dtype="int8"`` goes further with blockwise-scaled
+  int8 quantization (one float32 scale per :data:`QUANT_BLOCK`
+  elements riding a small sidecar array — ~0.25x the float32 wire
+  bytes).  The reduction itself always accumulates in float32
   (EQuARX-style, arXiv:2506.17615) and results upcast back to the
   leaf dtype.
+* **Gradient-ready overlap** — :class:`GradientSyncer` assigns leaves
+  to buckets in *reverse* input order (reverse-topological: the last
+  layers' grads, which backward materializes first, fill bucket 0) and
+  launches each bucket's collective on a worker thread the moment its
+  last leaf is marked ready — so wire time hides under the remaining
+  backward compute (DDP-style ready hooks; T3, arXiv:2401.16677).
 
 Every call records per-bucket stats (pack / transfer / collective /
-unpack seconds, overlap fraction) into the owning group's
+unpack seconds, wire bytes, overlap fraction) into the owning group's
 ``_fusion_stats`` — surfaced via ``collective.fusion_stats()``, the
 same stats idiom ``DataIterator.stats()["device_feed"]`` established.
 """
@@ -46,6 +55,15 @@ DEFAULT_BUCKET_BYTES = 4 << 20          # 4 MiB
 # dtypes eligible for reduced-precision transport (casting ints would
 # silently corrupt exact reductions).
 _FLOAT_KINDS = ("f",)
+
+#: Elements per int8 quantization block: one float32 scale per block,
+#: so the sidecar adds 4/QUANT_BLOCK bytes per element (~1.6% at 256).
+QUANT_BLOCK = 256
+
+#: Reduce ops whose cross-rank combine survives blockwise int8
+#: round-tripping (dequantize → accumulate at f32).  MIN/MAX/PRODUCT
+#: fall back to unquantized transport.
+_QUANT_OK_OPS = ("sum", "average")
 
 
 def resolve_dtype(name: str) -> np.dtype:
@@ -128,16 +146,26 @@ def _restore_leaf_type(like, arr: np.ndarray):
 
 @functools.lru_cache(maxsize=128)
 def _plan_for_signature(signature: tuple, bucket_bytes: int,
-                        transport_dtype: str | None) -> CoalescedPlan:
+                        transport_dtype: str | None,
+                        reverse: bool = False) -> CoalescedPlan:
     """Pack leaves (by signature) into dtype-segregated flat buckets.
 
     Leaves keep their input order within a dtype so unpack is a pure
     layout lookup; a leaf larger than ``bucket_bytes`` still gets
     exactly one (oversized) bucket — coalescing must never split a
     tensor across collectives.
+
+    ``reverse=True`` assigns leaves to buckets in reverse input order
+    (reverse-topological for a params pytree: backward produces the
+    LAST leaves' grads first, so bucket 0 fills — and its collective
+    can launch — earliest).  Slot offsets stay layout lookups either
+    way; only the bucket membership/order changes.
     """
     by_dtype: dict[str, list] = {}
-    for index, (shape, dtype) in enumerate(signature):
+    ordered = reversed(range(len(signature))) if reverse \
+        else range(len(signature))
+    for index in ordered:
+        shape, dtype = signature[index]
         by_dtype.setdefault(dtype, []).append((index, shape))
 
     buckets: list[Bucket] = []
@@ -166,22 +194,74 @@ def _plan_for_signature(signature: tuple, bucket_bytes: int,
 
 
 def plan_buckets(leaves, bucket_bytes: int = DEFAULT_BUCKET_BYTES,
-                 transport_dtype: str | None = None) -> CoalescedPlan:
+                 transport_dtype: str | None = None, *,
+                 reverse: bool = False) -> CoalescedPlan:
     return _plan_for_signature(leaf_signature(leaves), int(bucket_bytes),
-                               transport_dtype)
+                               transport_dtype, reverse)
 
 
 def plan_cache_info():
     return _plan_for_signature.cache_info()
 
 
-def pack_bucket(bucket: Bucket, leaves) -> np.ndarray:
+# ------------------------------------------------- int8 wire quantization
+
+def quant_blocks(size: int, block: int = QUANT_BLOCK) -> int:
+    """Number of scale blocks covering ``size`` elements (final block
+    may be odd-sized)."""
+    return max(1, -(-size // block))
+
+
+def quantize_blockwise(flat: np.ndarray, block: int = QUANT_BLOCK
+                       ) -> tuple[np.ndarray, np.ndarray]:
+    """float → (int8 codes, per-block float32 scales).
+
+    Each block of ``block`` elements is scaled by max(|x|)/127 so the
+    widest element maps to ±127; an all-zero block keeps scale 1.0
+    (codes are 0, avoiding 0-division on dequant).  The sidecar costs
+    4/block bytes per element — ~1.6% at the default 256."""
+    flat = np.asarray(flat, dtype=np.float32).reshape(-1)
+    size = flat.size
+    n_blocks = quant_blocks(size, block)
+    padded = np.zeros((n_blocks * block,), np.float32)
+    padded[:size] = flat
+    grid = padded.reshape(n_blocks, block)
+    scales = np.abs(grid).max(axis=1) / 127.0
+    scales[scales == 0.0] = 1.0
+    scales = scales.astype(np.float32)
+    q = np.clip(np.rint(grid / scales[:, None]), -127, 127).astype(np.int8)
+    return q.reshape(-1)[:size], scales
+
+
+def dequantize_blockwise(q: np.ndarray, scales: np.ndarray,
+                         block: int = QUANT_BLOCK) -> np.ndarray:
+    """(int8 codes, per-block scales) → float32 values."""
+    q = np.asarray(q, dtype=np.int8).reshape(-1)
+    scales = np.asarray(scales, dtype=np.float32).reshape(-1)
+    expanded = np.repeat(scales, block)[:q.size]
+    return q.astype(np.float32) * expanded
+
+
+def payload_nbytes(payload) -> int:
+    """Wire bytes of a packed bucket payload (plain array or the
+    int8 ``(codes, scales)`` pair)."""
+    if isinstance(payload, tuple):
+        return sum(int(np.asarray(p).nbytes) for p in payload)
+    return int(np.asarray(payload).nbytes)
+
+
+def pack_bucket(bucket: Bucket, leaves):
     """Leaves → one contiguous flat buffer in the bucket's wire dtype.
 
     The transport cast (e.g. float32→bfloat16) happens HERE, once, on
     the host — that is the lossy step; the reduction itself accumulates
-    at float32 (see the backend paths)."""
-    flat = np.empty((bucket.size,), dtype=resolve_dtype(bucket.transport_dtype))
+    at float32 (see the backend paths).  An ``int8`` transport bucket
+    returns the ``(codes, scales)`` pair from
+    :func:`quantize_blockwise` instead of a single array."""
+    quantized = bucket.transport_dtype == "int8"
+    pack_dtype = (np.dtype(np.float32) if quantized
+                  else resolve_dtype(bucket.transport_dtype))
+    flat = np.empty((bucket.size,), dtype=pack_dtype)
     for slot in bucket.slots:
         leaf = leaves[slot.leaf_index]
         try:
@@ -190,6 +270,8 @@ def pack_bucket(bucket: Bucket, leaves) -> np.ndarray:
             arr = np.asarray(leaf.float())
         flat[slot.offset:slot.offset + slot.size] = (
             arr.reshape(-1).astype(flat.dtype, copy=False))
+    if quantized:
+        return quantize_blockwise(flat)
     return flat
 
 
@@ -324,12 +406,25 @@ class PipelinedRunner:
 
 @dataclass
 class FusionStats:
-    """Cumulative per-group fusion counters (device_feed stats idiom)."""
+    """Cumulative per-group fusion counters (device_feed stats idiom).
+
+    ``wire_bytes`` is what actually crossed the wire (post transport
+    cast / quantization, sidecar scales included) vs ``bytes`` which is
+    the logical leaf payload.  ``dcn_participants`` counts ranks that
+    took part in a cross-slice (DCN) exchange, cumulative per bucket
+    collective: a flat allreduce adds world_size, the hierarchical path
+    adds num_slices — their ratio is the hierarchy's win.
+    ``overlap_s`` is collective time hidden under concurrent pack/
+    transfer (pipelined path) or remaining backward compute
+    (:class:`GradientSyncer`); ``overlap_fraction`` is the hidden share
+    of total collective time."""
 
     calls: int = 0
     tensors: int = 0
     buckets: int = 0
     bytes: int = 0
+    wire_bytes: int = 0
+    dcn_participants: int = 0
     pack_s: float = 0.0
     transfer_s: float = 0.0
     collective_s: float = 0.0
@@ -339,22 +434,38 @@ class FusionStats:
     last: dict = field(default_factory=dict)
 
     def as_dict(self) -> dict:
-        total_prepare = self.pack_s + self.transfer_s
         return {
             "calls": self.calls,
             "tensors": self.tensors,
             "buckets": self.buckets,
             "bytes": self.bytes,
+            "wire_bytes": self.wire_bytes,
+            "wire_ratio": (self.wire_bytes / self.bytes
+                           if self.bytes > 0 else 1.0),
+            "dcn_participants": self.dcn_participants,
             "pack_s": self.pack_s,
             "transfer_s": self.transfer_s,
             "collective_s": self.collective_s,
             "unpack_s": self.unpack_s,
             "overlap_s": self.overlap_s,
-            "overlap_fraction": (self.overlap_s / total_prepare
-                                 if total_prepare > 0 else 0.0),
+            "overlap_fraction": (min(1.0, self.overlap_s
+                                     / self.collective_s)
+                                 if self.collective_s > 0 else 0.0),
             "plan_cache_hits": self.plan_cache_hits,
             "last": dict(self.last),
         }
+
+
+def effective_transport(opts) -> str | None:
+    """The transport dtype actually usable for this reduce op: int8's
+    dequantize-then-accumulate combine only composes for SUM/AVERAGE
+    (MIN/MAX/PRODUCT fall back to unquantized transport)."""
+    transport = opts.transport_dtype
+    if transport == "int8":
+        op = getattr(opts.reduce_op, "value", str(opts.reduce_op))
+        if op not in _QUANT_OK_OPS:
+            return None
+    return transport
 
 
 def run_coalesced(tensors, opts, *, transfer_fn, collective_fn,
@@ -371,11 +482,13 @@ def run_coalesced(tensors, opts, *, transfer_fn, collective_fn,
     tensors = list(tensors)
     if not tensors:
         return []
+    transport = effective_transport(opts)
     hits_before = _plan_for_signature.cache_info().hits
-    plan = plan_buckets(tensors, opts.bucket_bytes, opts.transport_dtype)
+    plan = plan_buckets(tensors, opts.bucket_bytes, transport)
     plan_hit = _plan_for_signature.cache_info().hits > hits_before
 
     timings = {"pack_s": 0.0, "transfer_s": 0.0, "collective_s": 0.0}
+    wire = {"bytes": 0}
     lock = threading.Lock()
 
     def prepare(bucket: Bucket, _index: int):
@@ -387,6 +500,7 @@ def run_coalesced(tensors, opts, *, transfer_fn, collective_fn,
         with lock:
             timings["pack_s"] += t1 - t0
             timings["transfer_s"] += t2 - t1
+            wire["bytes"] += payload_nbytes(flat)
         return bucket, staged
 
     def reduce_one(staged, _index: int):
@@ -414,7 +528,8 @@ def run_coalesced(tensors, opts, *, transfer_fn, collective_fn,
             "tensors": plan.n_leaves,
             "buckets": len(plan.buckets),
             "bytes": plan.total_bytes,
-            "transport_dtype": opts.transport_dtype or "",
+            "wire_bytes": wire["bytes"],
+            "transport_dtype": transport or "",
             "plan_cache_hit": plan_hit,
             "overlap_s": overlap_s,
             "unpack_s": unpack_s,
@@ -424,6 +539,7 @@ def run_coalesced(tensors, opts, *, transfer_fn, collective_fn,
         stats.tensors += plan.n_leaves
         stats.buckets += len(plan.buckets)
         stats.bytes += plan.total_bytes
+        stats.wire_bytes += wire["bytes"]
         stats.pack_s += timings["pack_s"]
         stats.transfer_s += timings["transfer_s"]
         stats.collective_s += timings["collective_s"]
@@ -432,6 +548,200 @@ def run_coalesced(tensors, opts, *, transfer_fn, collective_fn,
         stats.plan_cache_hits += int(plan_hit)
         stats.last = last
     return out
+
+
+# ----------------------------------------------------- gradient overlap
+
+class GradientSyncer:
+    """DDP-style gradient-ready overlap over a collective group.
+
+    ``begin(template)`` plans buckets from the grads pytree in
+    *reverse* leaf order (backward produces the last layers' grads
+    first, so bucket 0 completes earliest) and starts a worker thread
+    that processes buckets strictly in plan order: wait until every
+    leaf of bucket k is marked ready, pack, stage the transfer, run the
+    collective.  The fixed order keeps cross-rank launch order
+    deterministic — every rank reduces bucket k before bucket k+1 —
+    while bucket k's wire time hides under the compute still producing
+    bucket k+1's leaves.
+
+    The caller marks leaves via ``ready(leaf_index, grad)`` as backward
+    materializes them (leaf indices follow ``flatten_pytree`` order)
+    and collects the reduced pytree with ``wait()``.  ``sync(tree)`` is
+    the one-shot degenerate case: every leaf is ready up front, so it
+    behaves like ``allreduce_coalesced`` with reverse bucket order —
+    the signature ``train.sync_gradients`` keeps.
+
+    Overlap accounting rides the :class:`PipelinedRunner` tick
+    machinery (``_mark`` / ``_windows`` with an injectable clock): the
+    compute window spans ``begin()`` → ``wait()`` entry, and collective
+    time inside it was hidden under backward — fed into the group's
+    ``FusionStats.overlap_s`` and the ``overlap_fraction`` the step
+    profiler and ``art_train_step_phase_fraction{collective}`` gauge
+    consume.
+    """
+
+    def __init__(self, group, opts, *, clock=time.perf_counter):
+        self._group = group
+        self._opts = opts
+        self._clock = clock
+        self._state: dict | None = None
+
+    # ------------------------------------------------------------ begin
+
+    def begin(self, template) -> "GradientSyncer":
+        """Plan buckets from ``template`` (the grads pytree — shapes
+        and dtypes are read, values ignored) and start the bucket
+        worker.  One sync may be in flight at a time."""
+        if self._state is not None:
+            raise RuntimeError("a gradient sync is already in flight; "
+                               "call wait() first")
+        leaves, treedef = flatten_pytree(template)
+        transport = effective_transport(self._opts)
+        hits_before = _plan_for_signature.cache_info().hits
+        plan = plan_buckets(leaves, self._opts.bucket_bytes, transport,
+                            reverse=True)
+        plan_hit = _plan_for_signature.cache_info().hits > hits_before
+        bucket_of = {}
+        remaining = []
+        for bi, bucket in enumerate(plan.buckets):
+            remaining.append(len(bucket.slots))
+            for slot in bucket.slots:
+                bucket_of[slot.leaf_index] = bi
+        runner = PipelinedRunner(None, None, clock=self._clock)
+        state = {
+            "plan": plan, "treedef": treedef, "plan_hit": plan_hit,
+            "leaves": leaves, "values": list(leaves),
+            "bucket_of": bucket_of, "remaining": remaining,
+            "bucket_ready": [threading.Event() for _ in plan.buckets],
+            "reduced": [None] * len(plan.buckets),
+            "wire_bytes": 0, "error": None,
+            "runner": runner, "lock": threading.Lock(),
+            "timings": {"pack_s": 0.0, "transfer_s": 0.0,
+                        "collective_s": 0.0},
+        }
+        runner._mark("compute_start", 0)
+        thread = threading.Thread(target=self._drain, args=(state,),
+                                  daemon=True, name="gradient-syncer")
+        state["thread"] = thread
+        self._state = state
+        thread.start()
+        return self
+
+    def _drain(self, state: dict) -> None:
+        runner: PipelinedRunner = state["runner"]
+        timings = state["timings"]
+        try:
+            for bi, bucket in enumerate(state["plan"].buckets):
+                state["bucket_ready"][bi].wait()
+                t0 = time.perf_counter()
+                runner._mark("prepare_start", bi)
+                flat = pack_bucket(bucket, state["values"])
+                t1 = time.perf_counter()
+                staged = self._group.bucket_transfer(flat, bucket,
+                                                     self._opts)
+                runner._mark("prepare_end", bi)
+                t2 = time.perf_counter()
+                runner._mark("collective_start", bi)
+                out = self._group.bucket_reduce(staged, bucket,
+                                                self._opts)
+                runner._mark("collective_end", bi)
+                t3 = time.perf_counter()
+                with state["lock"]:
+                    timings["pack_s"] += t1 - t0
+                    timings["transfer_s"] += t2 - t1
+                    timings["collective_s"] += t3 - t2
+                    state["wire_bytes"] += payload_nbytes(flat)
+                state["reduced"][bi] = out
+        except BaseException as e:  # noqa: BLE001 — re-raised by wait()
+            state["error"] = e
+
+    # ------------------------------------------------------------ ready
+
+    def ready(self, leaf_index: int, grad=None) -> None:
+        """Mark one leaf's gradient as materialized (optionally
+        replacing the template's value).  When a bucket's last leaf
+        arrives its collective becomes eligible immediately."""
+        state = self._state
+        if state is None:
+            raise RuntimeError("no gradient sync in flight; call begin()")
+        bi = state["bucket_of"].get(leaf_index)
+        if bi is None:
+            raise IndexError(f"leaf index {leaf_index} is not in the plan")
+        with state["lock"]:
+            if grad is not None:
+                state["values"][leaf_index] = grad
+            state["remaining"][bi] -= 1
+            fire = state["remaining"][bi] == 0
+        if fire:
+            state["bucket_ready"][bi].set()
+
+    def wait(self):
+        """Block until every bucket reduced; unpack and return the
+        synced pytree.  Collective windows that closed before this call
+        were fully hidden under backward compute."""
+        state = self._state
+        if state is None:
+            raise RuntimeError("no gradient sync in flight; call begin()")
+        runner: PipelinedRunner = state["runner"]
+        runner._mark("compute_end", 0)
+        state["thread"].join()
+        self._state = None
+        if state["error"] is not None:
+            raise state["error"]
+
+        plan: CoalescedPlan = state["plan"]
+        t0 = time.perf_counter()
+        out: list = [None] * plan.n_leaves
+        for bucket, flat in zip(plan.buckets, state["reduced"]):
+            unpack_bucket(bucket, flat, out)
+        out = [_restore_leaf_type(leaf, arr)
+               for leaf, arr in zip(state["leaves"], out)]
+        unpack_s = time.perf_counter() - t0
+
+        compute = runner._windows("compute")
+        overlap_s = 0.0
+        for c0, c1 in runner._windows("collective"):
+            for w0, w1 in compute:
+                overlap_s += max(0.0, min(c1, w1) - max(c0, w0))
+        stats = getattr(self._group, "_fusion_stats", None)
+        if stats is None:
+            stats = self._group._fusion_stats = FusionStats()
+        timings = state["timings"]
+        stats.calls += 1
+        stats.tensors += plan.n_leaves
+        stats.buckets += len(plan.buckets)
+        stats.bytes += plan.total_bytes
+        stats.wire_bytes += state["wire_bytes"]
+        stats.pack_s += timings["pack_s"]
+        stats.transfer_s += timings["transfer_s"]
+        stats.collective_s += timings["collective_s"]
+        stats.unpack_s += unpack_s
+        stats.overlap_s += overlap_s
+        stats.plan_cache_hits += int(state["plan_hit"])
+        stats.last = {
+            "tensors": plan.n_leaves, "buckets": len(plan.buckets),
+            "bytes": plan.total_bytes,
+            "wire_bytes": state["wire_bytes"],
+            "transport_dtype": effective_transport(self._opts) or "",
+            "plan_cache_hit": state["plan_hit"],
+            "overlap_s": overlap_s, "unpack_s": unpack_s,
+            "collective_s_clock": runner.stage_seconds("collective"),
+            **timings,
+        }
+        return unflatten_pytree(state["treedef"], out)
+
+    # --------------------------------------------------------- one-shot
+
+    def sync(self, tree):
+        """One-shot sync: every leaf is already materialized — the
+        degenerate case with reverse bucket order and identical
+        numerics to the hook-driven path."""
+        self.begin(tree)
+        state = self._state
+        for leaf_index in reversed(range(len(state["leaves"]))):
+            self.ready(leaf_index)
+        return self.wait()
 
 
 # -------------------------------------------------------------- pytree

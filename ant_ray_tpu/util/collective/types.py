@@ -8,6 +8,71 @@ import enum
 from dataclasses import dataclass
 
 
+@dataclass(frozen=True)
+class SliceTopology:
+    """Partition of a collective group's ranks into accelerator slices.
+
+    Ranks inside one slice share fast interconnect (ICI); distinct
+    slices talk over the datacenter network (DCN).  The hierarchical
+    allreduce reduces within each slice first, exchanges once per
+    *slice* across DCN, then fans back out — so the cross-slice
+    message count scales with ``num_slices``, not world size.
+
+    Hashable (tuples all the way down) so it can key compile caches.
+    """
+
+    slices: tuple                        # tuple[tuple[int, ...], ...]
+
+    @property
+    def num_slices(self) -> int:
+        return len(self.slices)
+
+    @staticmethod
+    def regular(world_size: int, num_slices: int) -> "SliceTopology":
+        """Contiguous equal partition: rank r sits in slice
+        r // (world_size // num_slices)."""
+        if num_slices <= 0 or world_size % num_slices != 0:
+            raise ValueError(
+                f"world_size {world_size} not divisible into "
+                f"{num_slices} slices")
+        per = world_size // num_slices
+        return SliceTopology(tuple(
+            tuple(range(s * per, (s + 1) * per))
+            for s in range(num_slices)))
+
+    @staticmethod
+    def from_labels(pod_names) -> "SliceTopology":
+        """Derive membership from each rank's ``tpu-pod-name`` node
+        label (accelerators/tpu.py topology metadata): ranks on the
+        same physical slice share a pod name."""
+        from ant_ray_tpu._private.accelerators import tpu as tpu_accel  # noqa: PLC0415
+
+        return SliceTopology(tuple(tpu_accel.slice_groups(pod_names)))
+
+    def validate(self, world_size: int) -> None:
+        flat = sorted(r for ranks in self.slices for r in ranks)
+        if flat != list(range(world_size)):
+            raise ValueError(
+                f"slice topology {self.slices} is not a partition of "
+                f"ranks 0..{world_size - 1}")
+
+    def slice_of(self, rank: int) -> int:
+        for sid, ranks in enumerate(self.slices):
+            if rank in ranks:
+                return sid
+        raise ValueError(f"rank {rank} is in no slice")
+
+    def peers(self, rank: int) -> tuple:
+        return self.slices[self.slice_of(rank)]
+
+    def leader(self, slice_id: int) -> int:
+        """The slice's DCN representative (lowest rank)."""
+        return min(self.slices[slice_id])
+
+    def leaders(self) -> tuple:
+        return tuple(self.leader(s) for s in range(self.num_slices))
+
+
 class Backend:
     """Supported backends: ``xla`` (XLA collectives over ICI/DCN — the
     TPU-native replacement for NCCL) and ``gloo`` (CPU fallback over
@@ -50,15 +115,21 @@ class AllReduceCoalescedOptions:
 
     ``bucket_bytes`` — flat-buffer budget per collective (a leaf larger
     than it gets its own oversized bucket).  ``transport_dtype`` —
-    opt-in reduced-precision wire format for wide float buckets
-    (e.g. "bfloat16"; accumulation stays float32, EQuARX-style).
+    opt-in reduced-precision wire format for wide float buckets:
+    ``"bfloat16"`` halves wire width, ``"int8"`` ships blockwise-scaled
+    int8 codes plus a float32 scale sidecar (~0.25x the float32 wire
+    bytes; SUM/AVERAGE only — other ops fall back to unquantized).
+    Accumulation stays float32 either way (EQuARX-style).
     ``overlap`` — pipeline bucket k+1's pack+transfer with bucket k's
-    collective (False = sequential naive-order baseline)."""
+    collective (False = sequential naive-order baseline).
+    ``hierarchy`` — a :class:`SliceTopology` switching the reduction to
+    the two-level intra-slice (ICI) / inter-slice (DCN) schedule."""
 
     reduce_op: ReduceOp = ReduceOp.SUM
     bucket_bytes: int = 4 << 20
     transport_dtype: "str | None" = None
     overlap: bool = True
+    hierarchy: "SliceTopology | None" = None
     timeout_ms: int = 30_000
 
 

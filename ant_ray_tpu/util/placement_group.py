@@ -61,12 +61,18 @@ class PlacementGroup:
 def placement_group(bundles: list[dict], strategy: str = "PACK",
                     name: str = "",
                     bundle_label_selectors: list[dict] | None = None,
-                    _same_label: str | None = None) -> PlacementGroup:
+                    _same_label: str | None = None,
+                    _same_label_groups: "list | None" = None
+                    ) -> PlacementGroup:
     """``bundle_label_selectors``: optional per-bundle node-label
     constraints (ref: bundle_label_selector in reserve_tpu_slice,
     python/ray/_private/accelerators/tpu.py:213).  ``_same_label``: a
     label key whose value must be shared by every bundle's node — the
-    slice-affinity primitive behind slice_placement_group()."""
+    slice-affinity primitive behind slice_placement_group().
+    ``_same_label_groups``: lists of bundle indices; each group's nodes
+    share one ``_same_label`` value and distinct groups get DISTINCT
+    values — the multi-slice primitive (one group per physical slice)
+    behind multi_slice_placement_group()."""
     from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
 
     if strategy not in VALID_STRATEGIES:
@@ -88,6 +94,8 @@ def placement_group(bundles: list[dict], strategy: str = "PACK",
         "job_id": runtime.job_id,  # VC-aware bundle placement
         "bundle_label_selectors": bundle_label_selectors,
         "same_label": _same_label,
+        "same_label_groups": ([list(g) for g in _same_label_groups]
+                              if _same_label_groups else None),
     }, retries=3)
     return PlacementGroup(pg_id, tuple(tuple(sorted(b.items()))
                                        for b in bundles), strategy)

@@ -99,3 +99,91 @@ def slice_placement_group(topology: str,
     return SlicePlacementGroup(
         placement_group=pg, topology=topology, generation=generation,
         num_hosts=num_hosts, chips_per_host=chips)
+
+
+@dataclass(frozen=True)
+class MultiSlicePlacementGroup:
+    """A reserved gang spanning ``num_slices`` whole TPU slices.
+
+    ONE placement group holds ``num_slices * hosts_per_slice`` bundles
+    in contiguous per-slice blocks: bundle ``s * hosts_per_slice + i``
+    is host ``i`` of slice ``s``.  The GCS planner's same-label-groups
+    constraint pins each block to one ``tpu-pod-name`` and distinct
+    blocks to distinct pods, so the whole multi-slice reservation
+    commits (or rolls back) atomically.  The matching rank→slice
+    partition for the hierarchical allreduce is
+    ``SliceTopology.regular(num_hosts, num_slices)``.
+    """
+
+    placement_group: PlacementGroup
+    topology: str
+    generation: str
+    num_slices: int
+    hosts_per_slice: int
+    chips_per_host: int
+
+    @property
+    def num_hosts(self) -> int:
+        return self.num_slices * self.hosts_per_slice
+
+    @property
+    def pod_type(self) -> str:
+        return tpu_accel.infer_pod_type(self.topology, self.generation)
+
+    def slice_of_bundle(self, index: int) -> int:
+        return index // self.hosts_per_slice
+
+    def ready(self, timeout: float = 100.0) -> bool:
+        return self.placement_group.ready(timeout=timeout)
+
+    def remove(self) -> None:
+        remove_placement_group(self.placement_group)
+
+
+def multi_slice_placement_group(topology: str,
+                                num_slices: int,
+                                accelerator_type: str = "TPU-V5E",
+                                name: str = "",
+                                bundle_extra: dict | None = None
+                                ) -> MultiSlicePlacementGroup:
+    """Reserve ``num_slices`` whole TPU slices of ``topology`` each —
+    the multi-slice (DCN data-parallel) gang reservation.
+
+    Per slice s: bundle ``s * num_hosts + i`` lands on the host with
+    ``tpu-worker-id == i`` of one physical slice (all of slice s's
+    bundles share a ``tpu-pod-name``; distinct s get distinct pods),
+    and bundle ``s * num_hosts`` additionally reserves that pod's
+    ``TPU-<pod_type>-head`` resource so no other job grabs the slice.
+    """
+    if num_slices <= 0:
+        raise ValueError(f"num_slices must be positive, got {num_slices}")
+    generation = tpu_accel.normalize_generation(accelerator_type)
+    num_hosts = tpu_accel.hosts_in_slice(topology, generation)
+    chips = tpu_accel.chips_per_host(topology, generation)
+    pod_type = tpu_accel.infer_pod_type(topology, generation)
+
+    bundles: list[dict] = []
+    selectors: list[dict] = []
+    groups: list[list[int]] = []
+    for s in range(num_slices):
+        groups.append(list(range(s * num_hosts, (s + 1) * num_hosts)))
+        for host in range(num_hosts):
+            bundle = {"TPU": float(chips), **(bundle_extra or {})}
+            if host == 0:
+                bundle[f"TPU-{pod_type}-head"] = 1.0
+            bundles.append(bundle)
+            selectors.append({"tpu-worker-id": str(host),
+                              "tpu-generation": generation})
+
+    pg = placement_group(
+        bundles,
+        strategy="STRICT_SPREAD" if num_hosts > 1 else "PACK",
+        name=name or f"multislice-{pod_type}x{num_slices}",
+        bundle_label_selectors=selectors,
+        _same_label="tpu-pod-name",
+        _same_label_groups=groups,
+    )
+    return MultiSlicePlacementGroup(
+        placement_group=pg, topology=topology, generation=generation,
+        num_slices=num_slices, hosts_per_slice=num_hosts,
+        chips_per_host=chips)

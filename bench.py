@@ -190,6 +190,16 @@ _GUARDED_METRICS = {
     "wait_1k_ready_refs_us": "lower",
     "collective_allreduce_fused_naive_ratio": "higher",
     "collective_fused_naive_ratio": "higher",   # bench.py summary alias
+    # Multi-slice collectives (PR 14): share of collective wall time
+    # hidden under backward compute by the gradient-ready syncer
+    # (acceptance >= 0.5), wire bytes crossing per logical f32 byte
+    # under int8 blockwise transport (acceptance <= 0.35), and the
+    # cross-slice participant ratio of the hierarchical vs flat verb
+    # (num_slices/world — 0.5 on the 2x2 sim; 1.0 means the two-level
+    # path stopped engaging).
+    "collective_overlap_fraction": "higher",
+    "collective_int8_wire_bytes_ratio": "lower",
+    "allreduce_hierarchical_vs_flat_rpc_ratio": "lower",
     "step_profiler_overhead_ns": "lower",
     # Resilience plane (PR 6): failure-detection + gang-relaunch +
     # restore latency, and productive-step fraction under an induced

@@ -250,6 +250,36 @@ def main() -> None:
     t0 = time.perf_counter()
     fused_rounds(r_fused)
     fused_per_s = len(grads) * r_fused / (time.perf_counter() - t0)
+    # ---- int8 wire quantization (EQuARX-style blockwise int8 codes +
+    # f32 scale sidecar): bytes that actually crossed the wire vs the
+    # logical f32 payload.  Guarded "lower" — the acceptance bar is
+    # <= 0.35x; drifting up means the quantized path stopped engaging.
+    col.allreduce_coalesced(grads, group_name="bench_fusion",
+                            transport_dtype="int8")
+    q8 = col.fusion_stats("bench_fusion")["last"]
+    emit("collective_int8_wire_bytes_ratio",
+         q8["wire_bytes"] / q8["bytes"] if q8["bytes"] else 1.0,
+         "fraction")
+
+    # ---- gradient-ready overlap (fusion.GradientSyncer): leaves are
+    # marked ready one at a time with real compute between them (the
+    # backward-pass shape), so bucket k's collective runs while leaves
+    # of bucket k+1 are still being "produced".  The metric is the
+    # share of collective wall time hidden under that compute window —
+    # the DDP overlap number ROADMAP item 3 targets (>= 0.5).
+    syncer = col.gradient_syncer(group_name="bench_fusion",
+                                 bucket_bytes=64 << 10)
+    leaf_compute_s = 0.002
+    for _ in range(2):                 # round 0 warms plan/lazy init
+        syncer.begin(grads)
+        for i in reversed(range(len(grads))):
+            time.sleep(leaf_compute_s)
+            syncer.ready(i)
+        syncer.wait()
+    ov = col.fusion_stats("bench_fusion")["last"]
+    emit("collective_overlap_fraction",
+         min(1.0, ov["overlap_s"] / ov["collective_s"])
+         if ov["collective_s"] else 0.0, "fraction")
     col.destroy_collective_group("bench_fusion")
     emit("collective_allreduce_naive_per_s", naive_per_s, "tensors/s")
     emit("collective_allreduce_fused_per_s", fused_per_s, "tensors/s")
@@ -488,6 +518,48 @@ def main() -> None:
         print(json.dumps({"metric": "bench_error",
                           "bench_error":
                           f"striped bench failed: {e!r}"[:300]}))
+
+    # ---- hierarchical allreduce DCN economics: 4 gloo ranks simulate
+    # 2 slices x 2 hosts; the two-level verb reduces intra-slice
+    # first and exchanges once per SLICE, so its cross-slice (DCN)
+    # participant count per bucket is num_slices while the flat verb's
+    # is world_size.  The ratio (0.5 here) is the wire-message scaling
+    # the 100k-GPU topology split buys; guarded "lower" — drifting to
+    # 1.0 means the hierarchy stopped engaging.
+    try:
+        from ant_ray_tpu.util import collective as col  # noqa: PLC0415
+
+        art.init(num_cpus=4, ignore_reinit_error=True)
+        topo = col.SliceTopology.regular(4, 2)
+
+        @art.remote
+        class _HierRanker(col.CollectiveActorMixin):
+            def sync(self, rank, hierarchy):
+                tensors = [np.full((4096,), float(rank + 1),
+                                   np.float32)]
+                col.allreduce_coalesced(tensors, group_name="bench_hier",
+                                        hierarchy=hierarchy)
+                dcn_hier = col.fusion_stats(
+                    "bench_hier")["dcn_participants"]
+                col.allreduce_coalesced(tensors, group_name="bench_hier")
+                dcn_total = col.fusion_stats(
+                    "bench_hier")["dcn_participants"]
+                return dcn_hier, dcn_total - dcn_hier
+
+        actors = [_HierRanker.remote() for _ in range(4)]
+        col.create_collective_group(actors, world_size=4,
+                                    ranks=[0, 1, 2, 3], backend="gloo",
+                                    group_name="bench_hier")
+        replies = art.get([a.sync.remote(rank, topo)
+                           for rank, a in enumerate(actors)])
+        dcn_hier, dcn_flat = replies[0]
+        emit("allreduce_hierarchical_vs_flat_rpc_ratio",
+             dcn_hier / dcn_flat if dcn_flat else 1.0, "fraction")
+        art.shutdown()
+    except Exception as e:  # noqa: BLE001 — bench must not die here
+        print(json.dumps({"metric": "bench_error",
+                          "bench_error":
+                          f"hierarchical bench failed: {e!r}"[:300]}))
 
     # ---- resilience plane: recovery time + goodput under chaos.
     # A 1-worker fit crashes deterministically mid-run (attempt 0,

@@ -294,6 +294,257 @@ def test_base_group_naive_fallback():
     assert group.fusion_stats()["calls"] == 0
 
 
+# ------------------------------------------------- int8 wire quantization
+
+def test_quantize_blockwise_roundtrip_odd_tail_and_zero_block():
+    from ant_ray_tpu.util.collective.fusion import QUANT_BLOCK
+
+    rng = np.random.default_rng(11)
+    size = QUANT_BLOCK * 2 + 37                    # odd final block
+    flat = (rng.standard_normal((size,)) * 5).astype(np.float32)
+    flat[:QUANT_BLOCK] = 0.0                       # an all-zero block
+    q, scales = fusion.quantize_blockwise(flat)
+    assert q.dtype == np.int8 and q.size == size
+    assert scales.dtype == np.float32
+    assert scales.shape == (fusion.quant_blocks(size),) == (3,)
+    assert scales[0] == 1.0           # zero block: scale 1, codes 0 —
+    assert not q[:QUANT_BLOCK].any()  # no 0-division on dequant
+    back = fusion.dequantize_blockwise(q, scales)
+    assert back.shape == (size,) and back.dtype == np.float32
+    # per-element error is bounded by half the block's quantization step
+    bound = np.repeat(scales, QUANT_BLOCK)[:size] * 0.5 + 1e-6
+    assert np.all(np.abs(back - flat) <= bound)
+
+
+def test_int8_payload_wire_bytes_under_ratio():
+    """codes + scales sidecar ≤ 0.35× the float32 payload (the
+    acceptance ratio int8 transport must actually deliver)."""
+    flat = np.ones((4096,), np.float32)
+    payload = fusion.quantize_blockwise(flat)
+    assert fusion.payload_nbytes(payload) / flat.nbytes <= 0.35
+
+
+@pytest.mark.parametrize("backend_fixture", ["xla_group", "gloo_group"])
+@pytest.mark.parametrize("op", [ReduceOp.SUM, ReduceOp.AVERAGE])
+def test_int8_transport_parity(backend_fixture, request, op):
+    group = request.getfixturevalue(backend_fixture)
+    rng = np.random.default_rng(5)
+    tensors = [(rng.standard_normal((300,)) * (i + 1)).astype(np.float32)
+               for i in range(3)]      # one 900-float bucket, odd tail
+    out = col.allreduce_coalesced(tensors, group_name=group, op=op,
+                                  transport_dtype="int8")
+    atol = max(float(np.abs(t).max()) for t in tensors) / 127 + 1e-6
+    for f, t in zip(out, tensors):
+        assert np.asarray(f).dtype == np.float32
+        np.testing.assert_allclose(np.asarray(f), t, rtol=0, atol=atol)
+    last = col.fusion_stats(group)["last"]
+    assert last["transport_dtype"] == "int8"
+    assert last["wire_bytes"] <= 0.35 * last["bytes"]
+
+
+def test_int8_transport_falls_back_for_min_max(gloo_group):
+    """Quantized codes can't carry MIN/MAX (the reduction happens on
+    dequantized sums) — the transport silently stays exact."""
+    t = [np.array([3.0, -7.0, 2.0], np.float32)]
+    out = col.allreduce_coalesced(t, group_name="fg", op=ReduceOp.MIN,
+                                  transport_dtype="int8")
+    np.testing.assert_array_equal(np.asarray(out[0]), t[0])   # bit-exact
+    assert col.fusion_stats("fg")["last"]["transport_dtype"] != "int8"
+
+
+def test_int8_transport_leaves_ints_exact(gloo_group):
+    from ant_ray_tpu.util.collective.fusion import QUANT_BLOCK
+
+    ints = np.array([7, -9, 1 << 20], np.int32)
+    out = col.allreduce_coalesced(
+        [ints, np.full((QUANT_BLOCK + 5,), 2.5, np.float32)],
+        group_name="fg", op=ReduceOp.SUM, transport_dtype="int8")
+    # int bucket never quantizes; float bucket does (within step/2).
+    np.testing.assert_array_equal(np.asarray(out[0]), ints)
+    np.testing.assert_allclose(np.asarray(out[1]), 2.5, atol=2.5 / 127)
+    # empty input with int8 transport: no buckets, no quantization
+    assert col.allreduce_coalesced([], group_name="fg",
+                                   transport_dtype="int8") == []
+
+
+def test_int8_compile_cache_one_entry_per_bucket(xla_group):
+    """The (codes, scales) pair is staged as ONE compiled entry keyed
+    on the bucket, not one per operand."""
+    from ant_ray_tpu.util.collective.collective import _group_mgr
+
+    group = _group_mgr.get_group("fx")
+    tensors = [np.ones((40 + i,), np.float32) for i in range(6)]
+    before = group._compiled.cache_info().currsize
+    col.allreduce_coalesced(tensors, group_name="fx",
+                            transport_dtype="int8")
+    grew = group._compiled.cache_info().currsize - before
+    assert grew == 1, f"expected 1 new compiled entry, got {grew}"
+
+
+# --------------------------------------------------- gradient-ready overlap
+
+@pytest.mark.parametrize("backend_fixture", ["xla_group", "gloo_group"])
+def test_gradient_syncer_matches_one_shot(backend_fixture, request):
+    group = request.getfixturevalue(backend_fixture)
+    rng = np.random.default_rng(9)
+    tree = {"a": rng.standard_normal((64,)).astype(np.float32),
+            "b": {"c": rng.standard_normal((8, 8)).astype(np.float32),
+                  "d": rng.standard_normal((257,)).astype(np.float32)}}
+    leaves, _ = fusion.flatten_pytree(tree)
+    syncer = col.gradient_syncer(group_name=group, op=ReduceOp.AVERAGE,
+                                 bucket_bytes=512)    # force >1 bucket
+    # hook-driven path, leaves ready in backward (reverse) order
+    syncer.begin(tree)
+    for i in reversed(range(len(leaves))):
+        syncer.ready(i, leaves[i])
+    out = syncer.wait()
+    # one-shot degenerate path on the same syncer
+    out2 = syncer.sync(tree)
+    for got in (out, out2):
+        flat, _ = fusion.flatten_pytree(got)
+        for g, want in zip(flat, leaves):
+            assert np.asarray(g).dtype == np.float32
+            np.testing.assert_allclose(np.asarray(g), want, rtol=1e-5)
+
+
+def test_gradient_syncer_out_of_order_ready(gloo_group):
+    """Leaves arriving in FORWARD order (bucket 0 — the last leaves —
+    completes last) still reduce correctly: launch order is plan
+    order, readiness order is free."""
+    leaves = [np.full((70,), float(i), np.float32) for i in range(4)]
+    syncer = col.gradient_syncer(group_name="fg", op=ReduceOp.SUM,
+                                 bucket_bytes=280)
+    syncer.begin(leaves)
+    for i in range(len(leaves)):
+        syncer.ready(i)
+    out = syncer.wait()
+    for i, g in enumerate(out):
+        np.testing.assert_allclose(np.asarray(g), float(i))
+
+
+def test_gradient_syncer_single_leaf_and_in_flight_guard(gloo_group):
+    syncer = col.gradient_syncer(group_name="fg", op=ReduceOp.SUM)
+    out = syncer.sync([np.ones((3,), np.float32)])
+    np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+    with pytest.raises(RuntimeError, match="no gradient sync"):
+        syncer.ready(0)
+    with pytest.raises(RuntimeError, match="no gradient sync"):
+        syncer.wait()
+    syncer.begin([np.ones((3,), np.float32)])
+    with pytest.raises(RuntimeError, match="already in flight"):
+        syncer.begin([np.ones((3,), np.float32)])
+    with pytest.raises(IndexError):
+        syncer.ready(7)
+    syncer.ready(0)
+    syncer.wait()
+
+
+def test_gradient_syncer_overlap_accounting_logical_clock(gloo_group):
+    """Injectable-clock overlap math: force the collective window to
+    close BEFORE wait() is entered — the window then falls entirely
+    inside the compute span, so overlap_s equals the full collective
+    tick-time (fully hidden under backward), no wall-clock involved."""
+    from ant_ray_tpu.util.collective.collective import _group_mgr
+
+    group = _group_mgr.get_group("fg")
+    ticks = itertools.count()
+    syncer = col.gradient_syncer(group_name="fg", op=ReduceOp.SUM,
+                                 clock=lambda: next(ticks))
+    reduced = threading.Event()
+    orig = group.bucket_reduce
+
+    def traced(staged, bucket, opts):
+        out = orig(staged, bucket, opts)
+        reduced.set()
+        return out
+
+    group.bucket_reduce = traced
+    try:
+        syncer.begin([np.ones((500,), np.float32)])
+        syncer.ready(0)
+        assert reduced.wait(timeout=10), "bucket collective never ran"
+        out = syncer.wait()
+    finally:
+        group.bucket_reduce = orig
+    np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+    last = group.fusion_stats()["last"]
+    assert last["collective_s_clock"] > 0
+    assert last["overlap_s"] == last["collective_s_clock"]
+
+
+# -------------------------------------------------- hierarchical allreduce
+
+def test_slice_topology_accessors_and_validation():
+    topo = col.SliceTopology.regular(8, 2)
+    assert topo.num_slices == 2
+    assert topo.slices == ((0, 1, 2, 3), (4, 5, 6, 7))
+    assert topo.slice_of(5) == 1
+    assert topo.peers(5) == (4, 5, 6, 7)
+    assert topo.leader(1) == 4
+    assert topo.leaders() == (0, 4)
+    topo.validate(8)
+    with pytest.raises(ValueError):
+        topo.validate(6)
+    with pytest.raises(ValueError):
+        col.SliceTopology.regular(8, 3)
+    # hashable — usable as a compile-cache key
+    assert hash(topo) == hash(col.SliceTopology.regular(8, 2))
+
+
+def test_slice_topology_from_labels():
+    topo = col.SliceTopology.from_labels(
+        ["pod-a", "pod-b", "pod-a", "pod-b"])
+    assert topo.num_slices == 2
+    assert sorted(topo.slices) == [(0, 2), (1, 3)]
+
+
+@pytest.mark.parametrize("backend_fixture", ["xla_group", "gloo_group"])
+def test_hierarchy_world1_identity(backend_fixture, request):
+    group = request.getfixturevalue(backend_fixture)
+    topo = col.SliceTopology.regular(1, 1)
+    t = [np.arange(600, dtype=np.float32)]
+    out = col.allreduce_coalesced(t, group_name=group, hierarchy=topo)
+    np.testing.assert_allclose(np.asarray(out[0]), t[0], rtol=1e-6)
+
+
+def test_gloo_hierarchical_across_actors(shutdown_only):
+    """4 ranks in 2 slices: two-level allreduce (intra + leaders +
+    fan-out) must match the flat verb rank-for-rank, for SUM and the
+    divide-once AVERAGE, and record one DCN participant per SLICE."""
+    import ant_ray_tpu as art
+
+    art.init(num_cpus=4, num_tpus=0)
+    topo = col.SliceTopology.regular(4, 2)
+
+    @art.remote
+    class Ranker(col.CollectiveActorMixin):
+        def sync(self, rank):
+            tensors = [np.full((300,), float(rank + 1), np.float32)]
+            hier_sum = col.allreduce_coalesced(
+                tensors, group_name="hier_g", op=ReduceOp.SUM,
+                hierarchy=topo)
+            dcn = col.fusion_stats("hier_g")["dcn_participants"]
+            hier_avg = col.allreduce_coalesced(
+                tensors, group_name="hier_g", op=ReduceOp.AVERAGE,
+                hierarchy=topo)
+            flat_sum = col.allreduce_coalesced(
+                tensors, group_name="hier_g", op=ReduceOp.SUM)
+            return (float(np.asarray(hier_sum[0])[0]),
+                    float(np.asarray(hier_avg[0])[0]),
+                    float(np.asarray(flat_sum[0])[0]), dcn)
+
+    actors = [Ranker.remote() for _ in range(4)]
+    col.create_collective_group(actors, world_size=4,
+                                ranks=[0, 1, 2, 3], backend="gloo",
+                                group_name="hier_g")
+    results = art.get([a.sync.remote(rank)
+                       for rank, a in enumerate(actors)])
+    for hier_sum, hier_avg, flat_sum, dcn in results:
+        assert hier_sum == flat_sum == 10.0          # 1+2+3+4
+        assert hier_avg == 2.5
+        assert dcn == topo.num_slices                # 2, not world 4
+
+
 def test_gloo_fused_across_actors(shutdown_only):
     """Two actor processes: fused coalesced allreduce must equal the
     per-tensor naive loop rank-for-rank."""

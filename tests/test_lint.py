@@ -191,6 +191,57 @@ def test_banned_time_time_compound_header_not_exempted_by_body():
     assert len(bad) == 1
 
 
+def test_banned_bare_ensure_future_fires_and_spawn_is_fine():
+    bad = lint_src("""
+        import asyncio
+
+        def kick(self, state):
+            asyncio.ensure_future(self._actor_sender(state))
+    """, C.BannedApisChecker())
+    assert len(bad) == 1 and "_spawn" in bad[0].message
+    assert not lint_src("""
+        from ant_ray_tpu._private.protocol import _spawn
+
+        def kick(self, state):
+            _spawn(self._actor_sender(state))
+    """, C.BannedApisChecker())
+
+
+def test_banned_ensure_future_as_callback_fires():
+    bad = lint_src("""
+        import asyncio
+
+        def release(self, loop, coro):
+            loop.call_soon_threadsafe(asyncio.ensure_future, coro)
+    """, C.BannedApisChecker())
+    assert len(bad) == 1 and "callback" in bad[0].message
+
+
+def test_banned_ensure_future_held_task_is_fine():
+    # Assignment, containers, and awaited gathers all HOLD the task —
+    # the weak-ref GC hazard only exists for discarded results.
+    assert not lint_src("""
+        import asyncio
+
+        async def run(self, coros):
+            task = asyncio.ensure_future(coros[0])
+            tasks = [asyncio.ensure_future(c) for c in coros]
+            self._by_task = {asyncio.ensure_future(coros[1]): "x"}
+            await asyncio.gather(task, *tasks)
+    """, C.BannedApisChecker())
+
+
+def test_banned_ensure_future_scoped_to_private():
+    # Outside the control-plane daemons the rule stays quiet (user-level
+    # code has other idioms and its own supervision).
+    assert not lint_src("""
+        import asyncio
+
+        def kick(self, coro):
+            asyncio.ensure_future(coro)
+    """, C.BannedApisChecker(), rel="ant_ray_tpu/serve/api.py")
+
+
 def test_blocking_checkers_anchor_multiline_statements():
     # A disable comment above a multi-line statement must suppress a
     # blocking call sitting on a continuation line (the documented

@@ -222,6 +222,115 @@ def test_drain_notice_zero_step_loss(shutdown_only, tmp_path):
         cluster.shutdown()
 
 
+# ------------------------------------------------- whole-slice failure
+
+
+def test_fault_slice_gang_restarts_zero_step_loss(shutdown_only,
+                                                  tmp_path,
+                                                  chaos_schedule):
+    """A whole-slice failure mid-step (chaos ``fault_slice``: every
+    daemon of one slice SIGKILLed as a unit — the multi-slice failure
+    domain) kills that slice's rank; the gang drains and restarts from
+    the last checkpoint on a replacement node with zero steps LOST:
+    every step is eventually executed and reported exactly through the
+    end, resuming from the registered checkpoint."""
+    from ant_ray_tpu.cluster_utils import Cluster
+
+    steplog = tmp_path / "steps.log"
+    cluster = Cluster(head_node_args={"num_cpus": 2})
+    cluster.add_node(num_cpus=2, resources={"gang": 1},
+                     labels={"art-slice-id": "0"})
+    cluster.add_node(num_cpus=2, resources={"gang": 1},
+                     labels={"art-slice-id": "1"})
+    cluster.connect()
+    # Slice 1 dies as a unit once the gang demonstrably runs; a
+    # replacement node (slice 2) joins in the same fire so the
+    # restarted gang has somewhere to land.
+    chaos_schedule.fault_slice(3, "1", cluster)
+    chaos_schedule.at_step(
+        3, lambda: cluster.add_node(num_cpus=2, resources={"gang": 1},
+                                    labels={"art-slice-id": "2"}),
+        label="replacement_node")
+    try:
+        def loop(config):
+            import numpy as np
+
+            ctx = train.get_context()
+            start = 0
+            if ctx.latest_checkpoint is not None:
+                start = int(ctx.latest_checkpoint
+                            .to_pytree()["step"]) + 1
+            # num_slices=2 fed the context the 2-slice rank partition
+            # (the hierarchical-allreduce default for sync_gradients).
+            assert ctx.slice_topology is not None
+            assert ctx.slice_topology.num_slices == 2
+            for step in range(start, 8):
+                if ctx.world_rank == 0:
+                    with open(config["steplog"], "a") as f:
+                        f.write(f"{step} "
+                                f"{os.environ.get('ART_NODE_ID', '')} "
+                                f"{ctx.attempt}\n")
+                time.sleep(0.25)  # real step work; the kill lands mid-run
+                # The gang's own hierarchical allreduce is the lock-step:
+                # once slice 1 dies, the survivor blocks here instead of
+                # racing to finish alone — exactly how a real multi-slice
+                # gang experiences a slice loss.
+                grads = train.sync_gradients(
+                    {"g": np.full(8, float(step), np.float32)})
+                assert float(grads["g"][0]) == float(step)
+                train.report({"step": step}, checkpoint={"step": step})
+
+        trainer = JaxTrainer(
+            loop, train_loop_config={"steplog": str(steplog)},
+            scaling_config=ScalingConfig(
+                num_workers=2, num_slices=2,
+                resources_per_worker={"CPU": 1.0, "gang": 1.0}),
+            run_config=RunConfig(
+                name="fault-slice-zero-loss",
+                storage_path=str(tmp_path / "store"),
+                failure_config=FailureConfig(
+                    max_failures=1, group_restart_backoff_s=0.2)))
+
+        import threading
+
+        box = {}
+        t = threading.Thread(
+            target=lambda: box.update(result=trainer.fit()), daemon=True)
+        t.start()
+        # Fire the schedule once the gang has logged >= 3 steps — the
+        # logical trigger that keeps the fault deterministic.
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            if steplog.exists() and \
+                    len(steplog.read_text().splitlines()) >= 3:
+                break
+            time.sleep(0.2)
+        assert steplog.exists(), "gang never started"
+        fired = chaos_schedule.fire(3)
+        assert "fault_slice:1" in fired
+        assert len(chaos_schedule.killed_slices["1"]) == 1
+        t.join(timeout=150)
+        assert not t.is_alive(), "fit never finished after slice fault"
+        result = box["result"]
+        assert result.error is None
+        rows = [line.split() for line in
+                steplog.read_text().splitlines()]
+        steps = [int(r[0]) for r in rows]
+        # Zero steps LOST: every step reached the log (a crash-kill may
+        # re-execute the step in flight — that one can appear twice,
+        # but none may be skipped) and the run resumed from the
+        # checkpoint, not from scratch.
+        assert sorted(set(steps)) == list(range(8))
+        assert max(int(r[2]) for r in rows) == 1, "gang never restarted"
+        restarted = [r for r in rows if int(r[2]) == 1]
+        assert restarted and min(int(r[0]) for r in restarted) > 0, \
+            "restart re-ran from step 0 — checkpoint resume failed"
+        assert result.metrics["step"] == 7
+    finally:
+        art.shutdown()
+        cluster.shutdown()
+
+
 # ------------------------------------- replicated-checkpoint restore
 
 

@@ -149,6 +149,64 @@ def test_train_fit_on_fake_slice(two_slices, tmp_path_factory):
     assert rank0_node["Labels"]["tpu-pod-name"] in ("slice-A", "slice-B")
 
 
+def test_multi_slice_pg_spans_distinct_slices(two_slices):
+    """ONE placement group covering BOTH slices: per-slice bundle
+    blocks land on distinct pods (same_label_groups planner), rank
+    order inside each block follows tpu-worker-id."""
+    from ant_ray_tpu.util.tpu import multi_slice_placement_group
+
+    ms = multi_slice_placement_group("2x2x2", num_slices=2,
+                                     accelerator_type="TPU-V4")
+    try:
+        assert ms.num_hosts == 4 and ms.hosts_per_slice == 2
+        assert ms.ready(timeout=60)
+        labels = [_node_labels_by_address(
+            ms.placement_group.bundle_node(i)) for i in range(4)]
+        pods = [la["tpu-pod-name"] for la in labels]
+        # bundles [0,1] one slice, [2,3] the other — and NOT the same.
+        assert pods[0] == pods[1] and pods[2] == pods[3]
+        assert pods[0] != pods[2]
+        assert [la["tpu-worker-id"] for la in labels] == \
+            ["0", "1", "0", "1"]
+        assert [ms.slice_of_bundle(i) for i in range(4)] == [0, 0, 1, 1]
+    finally:
+        ms.remove()
+    # Only two physical slices exist: a 3-slice group can't be placed
+    # (6 spread bundles > 5 nodes fails the eager feasibility check).
+    ms3 = multi_slice_placement_group("2x2x2", num_slices=3,
+                                      accelerator_type="TPU-V4")
+    with pytest.raises(RuntimeError, match="infeasible"):
+        ms3.ready(timeout=3)
+    ms3.remove()
+
+
+def test_train_controller_reserves_multi_slice(two_slices):
+    """num_slices=2 routes gang reservation through the multi-slice
+    placement group: 4 ranks, contiguous 2-rank blocks per slice."""
+    from ant_ray_tpu.train.config import RunConfig, ScalingConfig
+    from ant_ray_tpu.train.controller import TrainController
+
+    controller = TrainController(
+        loop_fn=lambda: None, loop_config=None,
+        scaling=ScalingConfig(num_workers=4, num_slices=2,
+                              use_tpu=True, topology="2x2x2",
+                              accelerator_type="TPU-V4",
+                              chips_per_worker=4),
+        run_config=RunConfig(name="multi-slice-test"))
+    pg, ms = controller._reserve_gang(controller._scaling)
+    try:
+        assert ms is not None and ms.num_hosts == 4
+        labels = [_node_labels_by_address(pg.bundle_node(i))
+                  for i in range(4)]
+        pods = [la["tpu-pod-name"] for la in labels]
+        assert pods[0] == pods[1] and pods[2] == pods[3]
+        assert pods[0] != pods[2]
+    finally:
+        controller._worker_pg = pg
+        controller._worker_slice = ms
+        controller._release_gang()
+
+
 def test_train_controller_reserves_slice(two_slices):
     """TrainController gang-reserves a slice and pins rank i to slice
     host i (ref: worker_group.py:269 PG creation)."""
