@@ -189,10 +189,18 @@ class NodeManager:
         # queue instead of pulling a dataset larger than memory at once.
         self._pull_bytes_inflight = 0
         self._pull_quota_cv: asyncio.Condition = asyncio.Condition()
+        # pull_bytes_bulk vs pull_bytes_relayed split the pull volume by
+        # path: holder-direct bulk-socket chunks vs chunks relayed
+        # through the daemon RPC loop (ReadChunkRaw/ReadChunk fallback).
+        # Their ratio is the `object_pull_relayed_fraction` gauge — the
+        # "before" number for the owner-direct-pull plane (ROADMAP item
+        # 2), which should drive it toward ~0.
         self.transfer_stats = {"chunk_reads": 0, "chunk_cache_hits": 0,
                                "quota_waits": 0, "stripe_cache_hits": 0,
                                "stripe_pulls": 0, "stripe_failovers": 0,
-                               "holder_failures": 0, "pull_bytes": 0}
+                               "holder_failures": 0, "pull_bytes": 0,
+                               "pull_bytes_bulk": 0,
+                               "pull_bytes_relayed": 0}
         # Holder-side log of served transfer-chunk requests (bounded),
         # for stripe tests/debugging: (object_hex, offset, length).
         self._chunk_read_log: deque = deque(maxlen=8192)
@@ -686,6 +694,10 @@ class NodeManager:
         series.append(("art_node_transfer_chunk_cache_bytes",
                        self._chunk_cache_bytes,
                        "holder-side transfer chunk cache bytes"))
+        series.append(("art_node_object_pull_relayed_fraction",
+                       self._pull_relayed_fraction(),
+                       "fraction of pulled bytes relayed through the "
+                       "daemon RPC path instead of holder-direct bulk"))
         return [
             {"name": name, "type": "gauge", "value": float(value),
              "description": desc,
@@ -2409,6 +2421,7 @@ class NodeManager:
                 # write would race the read.
                 if fut.done():
                     self.transfer_stats["pull_bytes"] += progress[0]
+                    self.transfer_stats["pull_bytes_bulk"] += progress[0]
 
         async def rpc_pump(holder, own: deque):
             from ant_ray_tpu.exceptions import ObjectLostError  # noqa: PLC0415
@@ -2469,6 +2482,7 @@ class NodeManager:
                             f"short read at {off}/{size} from holder")
                     view_at(off, n)[:] = data
                     self.transfer_stats["pull_bytes"] += n
+                    self.transfer_stats["pull_bytes_relayed"] += n
             except BaseException:
                 # In-flight chunks go back for survivors — exactly the
                 # not-yet-completed remainder, never a re-pulled byte.
@@ -2671,9 +2685,16 @@ class NodeManager:
                         if k[0] == object_id]:
                 self._chunk_cache_bytes -= len(self._chunk_cache.pop(key))
 
+    def _pull_relayed_fraction(self) -> float:
+        relayed = self.transfer_stats["pull_bytes_relayed"]
+        total = relayed + self.transfer_stats["pull_bytes_bulk"]
+        return relayed / total if total else 0.0
+
     async def _get_transfer_stats(self, payload):
         stats = dict(self.transfer_stats)
         stats["chunk_cache_bytes"] = self._chunk_cache_bytes
+        stats["object_pull_relayed_fraction"] = \
+            self._pull_relayed_fraction()
         if payload and payload.get("include_read_log"):
             stats["read_log"] = list(self._chunk_read_log)
         return stats

@@ -102,6 +102,26 @@ class BackPressureError(ArtError):
                                     self.retry_after_s))
 
 
+class KVRestoreError(ArtError):
+    """An offloaded LLM session's KV slab could not be restored.
+
+    Raised per-session (the engine loop keeps serving every other
+    session) when the object-plane fetch of an evicted slab fails —
+    e.g. the holder node died mid-restore.  Carries the session id so
+    callers can retry with a fresh session (the token history is gone
+    with the slab)."""
+
+    def __init__(self, message: str = "KV restore failed",
+                 session_id: str = ""):
+        self.session_id = session_id
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (KVRestoreError, (str(self.args[0]) if self.args
+                                 else "KV restore failed",
+                                 self.session_id))
+
+
 class DeadlineExceededError(ArtError, TimeoutError):
     """The request's end-to-end deadline expired.
 

@@ -4,25 +4,43 @@ Capability mirror of the reference's vLLM engine integration (ref:
 llm/_internal/serve/engines/vllm/vllm_engine.py, batch/stages/
 vllm_engine_stage.py) designed for TPU/XLA rather than around CUDA:
 
-* **Static shapes everywhere.** The decode step is one jitted function
-  over a fixed number of slots; prefill lengths are bucketed to powers
-  of two, so the engine compiles O(log max_seq) prefill variants and
-  exactly one decode variant.
+* **Static shapes everywhere.**  The decode step is one jitted function
+  over a fixed number of slots.  Prompt ingestion has two modes: the
+  legacy bucketed prefill (lengths padded to powers of two — O(log
+  max_seq) compiled variants) and **chunked prefill**
+  (``prefill_chunk_tokens``): prompts are ingested in fixed-size chunks
+  through ONE compiled `prefill_chunk` variant (slot/offset/length all
+  traced), interleaved with decode steps at a configurable
+  ``decode_steps_per_chunk`` ratio — a long prompt no longer
+  monopolizes a step, so short-request TTFT stops queueing behind it
+  and resident sessions keep decoding smoothly during ingestion.
 * **Dense per-slot KV slabs** (models/llama.py `init_kv_cache`) instead
   of paged KV: XLA cannot tile dynamic gather-heavy paging the way a
   CUDA kernel can, while dense slabs keep decode attention a plain
   masked matmul on the MXU.  Slot reuse gives the same
   admit-new-work-each-step behavior as paged attention's block reuse.
-* **Continuous batching**: each `step()` admits at most one queued
-  prompt (prefill) and then decodes every active slot in one batched
-  call — the scheduling loop from vLLM reduced to its TPU-friendly
-  core.
+* **Continuous batching**: each `step()` admits queued prompts, runs at
+  most one prefill unit (a full bucketed prompt, or one chunk), then
+  decodes every active slot in one batched call.
+* **Session KV offload** (``session_id=`` + kv_offload.py stores): a
+  finished request's slab stays RESIDENT in its slot for multi-turn
+  reuse; idle sessions are evicted — LRU past ``kv_idle_evict_s`` or on
+  KV-full admission pressure — by device-getting the slab to host and
+  sealing it into a tiered store (object plane: arena → spill tiers),
+  freeing the slot.  The next token for an offloaded session triggers a
+  background-thread fetch (the step loop NEVER blocks on a restore;
+  decode continues and the slab installs when it lands, attributed via
+  the ``llm:restore`` trace span), making resident-session count
+  disk-bounded instead of HBM-bounded.  Round trips are bitwise exact:
+  restored token streams are identical to uninterrupted runs.
 """
 
 from __future__ import annotations
 
 import itertools
 import os
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -40,9 +58,10 @@ class RequestOutput:
     text: str = ""
     finished: bool = False
     finish_reason: str | None = None
+    error: str | None = None
 
 
-@dataclass
+@dataclass(eq=False)
 class _Seq:
     request_id: str
     prompt: list
@@ -50,6 +69,28 @@ class _Seq:
     slot: int = -1
     generated: list = field(default_factory=list)
     rng_key: Any = None
+    session: Any = None           # _Session | None
+    prefill_done: int = 0         # prompt tokens ingested (chunked mode)
+    kv_len: int = 0               # slab tokens written for this slot
+    last_tok: int | None = None   # device-fed token (resume after restore)
+    on_event: Any = None          # callable(dict) | None — streaming sink
+    trace_ctx: Any = None         # TraceContext for llm:restore spans
+
+
+@dataclass(eq=False)
+class _Session:
+    """A logical conversation owning (at most) one KV slot over time."""
+
+    session_id: str
+    state: str = "new"            # new|resident|offloaded|restoring|failed
+    slot: int = -1
+    kv_len: int = 0               # tokens in the (resident or offloaded) slab
+    carry: list = field(default_factory=list)  # final token, KV not written
+    last_used: float = 0.0
+    handle: Any = None            # offload store handle
+    current: _Seq | None = None   # seq owning the slot right now
+    paused: _Seq | None = None    # mid-generation seq parked by eviction
+    pending: list = field(default_factory=list)  # seqs awaiting the slab
 
 
 def _bucket(n: int, cap: int) -> int:
@@ -71,13 +112,33 @@ class LLMEngine:
     def __init__(self, model="tiny", params=None, *, slots: int = 8,
                  max_seq: int | None = None, tokenizer=None,
                  seed: int = 0, tensor_parallel_size: int = 1,
-                 mesh=None, max_waiting: int | None = None):
+                 mesh=None, max_waiting: int | None = None,
+                 prefill_chunk_tokens: int | None = None,
+                 decode_steps_per_chunk: int = 1,
+                 kv_idle_evict_s: float | None = None,
+                 kv_offload_store=None,
+                 kv_evict_on_pressure: bool = True,
+                 profiler=None):
         """``tensor_parallel_size > 1`` makes the ENGINE build a tp mesh
         over this process's local devices and shard params + KV slabs
         itself (ref: vllm_models.py:222 tensor_parallel_size — serving
         an 8B on a slice needs no caller-side sharding).  ``mesh``
         overrides it with a prebuilt mesh (e.g. tp×sp for long-prompt
-        prefill via ring attention — forward() switches on sp>1)."""
+        prefill via ring attention — forward() switches on sp>1).
+
+        ``prefill_chunk_tokens``: enable chunked prefill with this fixed
+        chunk width (None = legacy bucketed prefill).
+        ``decode_steps_per_chunk``: decode steps run between successive
+        prefill chunks while both kinds of work are pending (the
+        TTFT-vs-decode-smoothness budget knob).
+        ``kv_idle_evict_s``: evict a session's slab after this many
+        seconds idle (None disables the LRU sweep; pressure eviction is
+        governed separately by ``kv_evict_on_pressure``).
+        ``kv_offload_store``: a kv_offload.py store (LocalKvStore /
+        ObjectPlaneKvStore); defaults to a LocalKvStore built lazily on
+        first eviction.  ``profiler``: optional StepProfiler — each
+        step() records prefill/decode/restore_install phases.
+        """
         from ant_ray_tpu._private.jax_utils import import_jax
 
         self._jax = jax = import_jax()
@@ -143,6 +204,25 @@ class LLMEngine:
         self._req_counter = itertools.count()
         self._base_key = jax.random.PRNGKey(seed ^ 0x5EED)
 
+        # ---- chunked prefill + session state
+        self._chunk_tokens = prefill_chunk_tokens
+        self._decode_per_chunk = max(1, int(decode_steps_per_chunk))
+        self._decode_since_chunk = self._decode_per_chunk  # 1st chunk runs now
+        self._prefilling: list[_Seq] = []         # chunked-mode ingest queue
+        self._sessions: dict[str, _Session] = {}
+        self._kv_idle_evict_s = kv_idle_evict_s
+        self._kv_evict_on_pressure = kv_evict_on_pressure
+        self._kv_store = kv_offload_store
+        self._restoring: dict[str, dict] = {}     # sid -> ticket
+        self._chunk_rate: float | None = None     # tokens/s EWMA
+        self._last_chunk_t: float | None = None
+        self.profiler = profiler
+        self.stats = {"tokens_generated": 0, "chunks": 0,
+                      "chunk_tokens": 0, "offloads": 0,
+                      "offload_bytes": 0, "restores": 0,
+                      "restore_wait_s": 0.0, "restore_failures": 0,
+                      "pressure_evictions": 0, "idle_evictions": 0}
+
         cfg = self.config
         eng_mesh = self.mesh
 
@@ -150,12 +230,44 @@ class LLMEngine:
             return llama.prefill_into_cache(params, tokens, cache, slot,
                                             length, cfg, mesh=eng_mesh)
 
-        def _decode(params, cache, last_tokens):
-            return llama.decode_step(params, last_tokens, cache, cfg)
+        def _prefill_chunk(params, cache, tokens, slot, start, length):
+            return llama.prefill_chunk_into_cache(
+                params, tokens, cache, slot, start, length, cfg)
 
-        # one compile per prompt bucket (slot/length traced); one decode
+        def _decode(params, cache, last_tokens, active):
+            return llama.decode_step(params, last_tokens, cache, cfg,
+                                     active=active)
+
+        def _extract(cache, slot):
+            from jax import lax  # noqa: PLC0415
+
+            k = lax.dynamic_index_in_dim(cache["k"], slot, axis=1,
+                                         keepdims=False)
+            v = lax.dynamic_index_in_dim(cache["v"], slot, axis=1,
+                                         keepdims=False)
+            return k, v, cache["length"][slot]
+
+        def _install(cache, k, v, length, slot):
+            from jax import lax  # noqa: PLC0415
+
+            slot = jnp.asarray(slot, jnp.int32)
+            return {
+                "k": lax.dynamic_update_slice(
+                    cache["k"], k[:, None], (0, slot, 0, 0, 0)),
+                "v": lax.dynamic_update_slice(
+                    cache["v"], v[:, None], (0, slot, 0, 0, 0)),
+                "length": cache["length"].at[slot].set(length),
+            }
+
+        # one compile per prompt bucket (slot/length traced); ONE chunk
+        # variant (slot/start/length traced); one decode; one extract /
+        # install each (slot traced).
         self._prefill_jit = jax.jit(_prefill, donate_argnums=(1,))
+        self._prefill_chunk_jit = jax.jit(_prefill_chunk,
+                                          donate_argnums=(1,))
         self._decode_jit = jax.jit(_decode, donate_argnums=(1,))
+        self._extract_jit = jax.jit(_extract)
+        self._install_jit = jax.jit(_install, donate_argnums=(0,))
         self._sample_jit = jax.jit(self._sample_batch)
 
     def _shard_state(self):
@@ -188,7 +300,8 @@ class LLMEngine:
 
     def add_request(self, prompt, sampling: SamplingParams | None = None,
                     request_id: str | None = None, *,
-                    admit: bool = True) -> str:
+                    admit: bool = True, session_id: str | None = None,
+                    on_event=None, trace_ctx=None) -> str:
         """prompt: str (tokenized here) or token-id list.
 
         With ``max_waiting`` configured and ``admit=True`` (the serving
@@ -196,18 +309,30 @@ class LLMEngine:
         waiting line is full is REJECTED with
         :class:`~ant_ray_tpu.exceptions.BackPressureError` — admission
         control at the engine boundary, so overload sheds instead of
-        growing an unbounded prompt queue toward OOM.  Offline batch
-        paths (``generate``) pass ``admit=False``: a caller handing the
-        engine a fixed batch wants queueing."""
+        growing an unbounded prompt queue toward OOM.  Before shedding,
+        an idle resident session is evicted to the offload store if one
+        exists (``kv_evict_on_pressure``) — pressure admits new work by
+        spilling cold state instead of refusing.  The retry hint derives
+        from the measured chunk-drain rate.  Offline batch paths
+        (``generate``) pass ``admit=False``: a caller handing the
+        engine a fixed batch wants queueing.
+
+        ``session_id`` attaches the request to a persistent session: its
+        KV slab survives the request (multi-turn reuse; continuations
+        require chunked mode) and may be offloaded/restored.
+        ``on_event`` streams per-token dicts to the caller (EngineLoop's
+        sink); ``trace_ctx`` attributes `llm:restore` spans."""
         if (admit and self._max_waiting is not None
                 and not self._free_slots
-                and len(self._waiting) >= self._max_waiting):
+                and len(self._waiting) >= self._max_waiting
+                and not self._evict_for_pressure()):
             from ant_ray_tpu.exceptions import BackPressureError  # noqa: PLC0415
 
             raise BackPressureError(
                 f"engine at capacity: {self.slots} KV slots busy, "
                 f"{len(self._waiting)} waiting (max_waiting="
-                f"{self._max_waiting})", retry_after_s=0.5)
+                f"{self._max_waiting})",
+                retry_after_s=self.retry_after_hint())
         sampling = sampling or SamplingParams()
         if isinstance(prompt, str):
             token_ids = self.tokenizer.encode(prompt)
@@ -220,6 +345,23 @@ class LLMEngine:
             token_ids = token_ids[-budget:]      # keep the suffix
         rid = request_id or f"req-{next(self._req_counter)}"
         seq = _Seq(rid, token_ids, sampling)
+        seq.on_event = on_event
+        seq.trace_ctx = trace_ctx
+        if session_id is not None:
+            sess = self._sessions.get(session_id)
+            if sess is None or sess.state == "failed":
+                sess = _Session(session_id)
+                self._sessions[session_id] = sess
+            elif self._chunk_tokens is None:
+                # Any reuse, not just kv_len > 0: a continuation queued
+                # while turn 1 is still in flight (kv_len still 0 here)
+                # would otherwise reach _admit with a slab offset the
+                # bucketed kernel cannot append at.
+                raise ValueError(
+                    "session continuation requires chunked prefill "
+                    "(prefill_chunk_tokens=) — bucketed prefill cannot "
+                    "append at a slab offset")
+            seq.session = sess
         seed = sampling.seed
         key = (self._jax.random.PRNGKey(seed) if seed is not None
                else self._jax.random.fold_in(self._base_key, hash(rid)
@@ -229,41 +371,32 @@ class LLMEngine:
         return rid
 
     def has_unfinished(self) -> bool:
-        return bool(self._waiting or self._active)
+        return bool(self._waiting or self._active or self._prefilling
+                    or self._restoring
+                    or any(s.paused or s.pending
+                           for s in self._sessions.values()))
 
     def step(self) -> list[RequestOutput]:
-        """One engine iteration: admit one prompt, decode all active
-        slots, release finished ones.  Returns outputs finished since
-        the last call."""
-        jnp = self._jnp
-        if self._waiting and self._free_slots:
-            seq = self._waiting.pop(0)
-            slot = self._free_slots.pop()
-            seq.slot = slot
-            bucket = _bucket(len(seq.prompt), self.max_seq)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :len(seq.prompt)] = seq.prompt
-            last_logits, self.cache = self._prefill_jit(
-                self.params, self.cache, jnp.asarray(padded), slot,
-                len(seq.prompt))
-            tok = int(self._sample_one(seq, last_logits))
-            self._after_token(seq, tok)
-            if seq.slot >= 0:
-                self._last_np[slot] = tok
-                self._active[slot] = seq
-
-        if self._active:
-            logits, self.cache = self._decode_jit(
-                self.params, self.cache, jnp.asarray(self._last_np))
-            toks = np.asarray(self._sample_all(logits))
-            for slot, seq in list(self._active.items()):
-                tok = int(toks[slot])
-                self._after_token(seq, tok)
-                if seq.slot >= 0:
-                    self._last_np[slot] = tok
-
+        """One engine iteration: land finished restores, admit prompts,
+        run one prefill unit (bucketed prompt or one chunk), decode all
+        active slots, sweep idle sessions.  Returns outputs finished
+        since the last call."""
+        prof = self.profiler
+        if prof is not None:
+            with prof.step():
+                self._step_inner(prof)
+        else:
+            self._step_inner(None)
         done, self._finished = self._finished, []
         return done
+
+    def _step_inner(self, prof):
+        self._poll_restores(prof)
+        self._admit(prof)
+        if self._chunk_tokens is not None:
+            self._maybe_prefill_chunk(prof)
+        self._decode(prof)
+        self._sweep_idle()
 
     def generate(self, prompts, sampling: SamplingParams | None = None,
                  ) -> list[RequestOutput]:
@@ -307,6 +440,436 @@ class LLMEngine:
                "token_ids": list(final.token_ids) if final else [],
                "full_text": final.text if final else ""}
 
+    # -------------------------------------------------- sessions public
+
+    def resident_sessions(self) -> int:
+        """Live sessions the engine is holding KV state for — resident,
+        offloaded, or mid-restore.  Exceeds ``slots`` exactly when
+        offload is doing its job."""
+        return sum(1 for s in self._sessions.values()
+                   if s.state in ("resident", "offloaded", "restoring"))
+
+    def queue_depth(self) -> int:
+        """Requests admitted but not yet generating: waiting for a slot,
+        mid-prefill, or parked behind a session restore."""
+        return (len(self._waiting) + len(self._prefilling)
+                + sum(len(s.pending) + (1 if s.paused else 0)
+                      for s in self._sessions.values()))
+
+    def chunk_drain_rate(self) -> float | None:
+        """Measured prefill-chunk throughput (tokens/s EWMA), the basis
+        for KV-full retry hints.  None until the first two chunks."""
+        return self._chunk_rate
+
+    def retry_after_hint(self) -> float:
+        """BackPressure retry hint: outstanding prompt tokens over the
+        measured chunk-drain rate (legacy fallback: 0.5 s)."""
+        rate = self._chunk_rate
+        if not rate or rate <= 0:
+            return 0.5
+        outstanding = sum(max(0, len(s.prompt) - s.prefill_done)
+                          for s in self._prefilling)
+        outstanding += sum(len(s.prompt) for s in self._waiting)
+        outstanding += self._chunk_tokens or 0   # the admitted request
+        return min(30.0, max(0.05, outstanding / rate + 0.02))
+
+    def evict_session(self, session_id: str, *, force: bool = False
+                      ) -> bool:
+        """Offload one session's slab now.  Idle sessions always
+        qualify; ``force=True`` additionally pauses a mid-GENERATION
+        session (its request resumes after an automatic restore —
+        bit-identically, since the slab round trip is exact).  Sessions
+        mid-prefill are never evictable.  Returns True if evicted."""
+        sess = self._sessions.get(session_id)
+        if sess is None or sess.state != "resident" or sess.slot < 0:
+            return False
+        cur = sess.current
+        if cur is not None:
+            if not force or cur in self._prefilling:
+                return False
+            self._active.pop(cur.slot, None)
+            cur.slot = -1
+            sess.paused = cur
+            sess.current = None
+        self._offload(sess)
+        return True
+
+    def end_session(self, session_id: str) -> bool:
+        """Drop a session: frees its slot (if resident) and deletes its
+        offloaded slab (if any).  In-flight work is not interrupted —
+        call only for idle sessions."""
+        sess = self._sessions.pop(session_id, None)
+        if sess is None:
+            return False
+        if sess.slot >= 0 and sess.current is None:
+            self._free_slots.append(sess.slot)
+            sess.slot = -1
+        if sess.handle is not None and self._kv_store is not None:
+            try:
+                self._kv_store.delete(sess.handle)
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+        return True
+
+    def has_evictable(self) -> bool:
+        """True if admission pressure could free a slot by evicting an
+        idle resident session (the submit-side gate's cheap probe)."""
+        return any(s.state == "resident" and s.slot >= 0
+                   and s.current is None and s.paused is None
+                   for s in self._sessions.values())
+
+    # ---------------------------------------------------- step phases
+
+    def _admit(self, prof=None):
+        """Route waiting requests: park session continuations behind
+        restores, assign free (or pressure-evicted) slots, and in
+        legacy mode run at most one full bucketed prefill per step —
+        the budget covers BOTH the resident-idle-session branch and the
+        fresh-slot branch."""
+        # Sessions parked with work but offloaded: ensure a restore is
+        # in flight (covers forced mid-generation eviction).
+        for sess in self._sessions.values():
+            if sess.state == "offloaded" and (sess.paused or sess.pending):
+                self._start_restore(sess)
+        admitted_prefill = False
+        i = 0
+        while i < len(self._waiting):
+            seq = self._waiting[i]
+            sess = seq.session
+            if sess is not None and sess.state in ("offloaded",
+                                                   "restoring"):
+                self._waiting.pop(i)
+                sess.pending.append(seq)
+                if sess.state == "offloaded":
+                    self._start_restore(sess)
+                continue
+            if sess is not None and sess.slot >= 0 and (
+                    sess.current is not None or sess.paused is not None):
+                self._waiting.pop(i)          # session busy: park
+                sess.pending.append(seq)
+                continue
+            if sess is not None and sess.slot >= 0:
+                if self._chunk_tokens is None and admitted_prefill:
+                    break                     # legacy: ≤1 prefill/step
+                self._waiting.pop(i)          # resident idle: append
+                self._begin_ingest(seq, sess.slot, sess.kv_len, prof)
+                admitted_prefill = True
+                continue
+            if not self._free_slots and not self._evict_for_pressure():
+                i += 1
+                continue
+            if self._chunk_tokens is None and admitted_prefill:
+                break                         # legacy: ≤1 prefill/step
+            slot = self._free_slots.pop()
+            self._waiting.pop(i)
+            if sess is not None:
+                sess.slot = slot
+                sess.state = "resident"
+            self._begin_ingest(seq, slot, sess.kv_len if sess else 0,
+                               prof)
+            admitted_prefill = True
+
+    def _begin_ingest(self, seq: _Seq, slot: int, start: int, prof=None):
+        jnp = self._jnp
+        sess = seq.session
+        if self._chunk_tokens is None and start != 0:
+            # add_request rejects bucketed session continuations, so
+            # this is a backstop: fail the one seq typed (the session
+            # keeps its resident slot, idle) — raising mid-step would
+            # leave the seq in no queue and wedge its caller's wait().
+            self._fail_seq(seq, ValueError(
+                "bucketed prefill cannot continue a session at offset "
+                f"{start}; configure prefill_chunk_tokens"))
+            return
+        if sess is not None:
+            sess.current = seq
+            sess.last_used = time.monotonic()
+            if sess.carry:
+                seq.prompt = sess.carry + seq.prompt
+                sess.carry = []
+        seq.slot = slot
+        seq.kv_len = start
+        if self._chunk_tokens is not None:
+            self._prefilling.append(seq)
+            return
+        bucket = _bucket(len(seq.prompt), self.max_seq)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(seq.prompt)] = seq.prompt
+        timer = prof.phase("prefill") if prof is not None else _NOOP_TIMER
+        with timer:
+            last_logits, self.cache = self._prefill_jit(
+                self.params, self.cache, jnp.asarray(padded), slot,
+                len(seq.prompt))
+        seq.kv_len = len(seq.prompt)
+        tok = int(self._sample_one(seq, last_logits))
+        self._after_token(seq, tok)
+        if seq.slot >= 0:
+            seq.last_tok = tok
+            self._last_np[slot] = tok
+            self._active[slot] = seq
+
+    def _maybe_prefill_chunk(self, prof=None):
+        """Run ONE chunk of ONE pending prompt — but only once
+        ``decode_steps_per_chunk`` decode steps have run since the last
+        chunk (decode for resident sessions stays smooth while a long
+        prompt trickles in).
+
+        Selection is shortest-remaining-prompt-first (FIFO tiebreak):
+        a short interactive prompt's single chunk jumps ahead of a
+        long ingest's remaining hundreds, so short TTFT stays flat
+        under long-prompt interference.  Long prompts cannot starve —
+        they absorb every chunk slot no short is contending for — but
+        a sustained flood of short prompts will stall them; that is
+        the intended bias for an interactive serving tier."""
+        if not self._prefilling:
+            return
+        if self._active and \
+                self._decode_since_chunk < self._decode_per_chunk:
+            return
+        jnp = self._jnp
+        idx = min(range(len(self._prefilling)),
+                  key=lambda i: (len(self._prefilling[i].prompt)
+                                 - self._prefilling[i].prefill_done, i))
+        seq = self._prefilling.pop(idx)
+        chunk = self._chunk_tokens
+        part = seq.prompt[seq.prefill_done:seq.prefill_done + chunk]
+        buf = np.zeros((chunk,), np.int32)
+        buf[:len(part)] = part
+        timer = prof.phase("prefill") if prof is not None else _NOOP_TIMER
+        with timer:
+            logits, self.cache = self._prefill_chunk_jit(
+                self.params, self.cache, jnp.asarray(buf), seq.slot,
+                seq.kv_len, len(part))
+        seq.prefill_done += len(part)
+        seq.kv_len += len(part)
+        self._note_chunk(len(part))
+        self._decode_since_chunk = 0
+        if seq.prefill_done < len(seq.prompt):
+            self._prefilling.append(seq)
+            return
+        tok = int(self._sample_one(seq, logits))
+        self._after_token(seq, tok)
+        if seq.slot >= 0:
+            seq.last_tok = tok
+            self._last_np[seq.slot] = tok
+            self._active[seq.slot] = seq
+
+    def _decode(self, prof=None):
+        if not self._active:
+            return
+        jnp = self._jnp
+        mask = np.zeros((self.slots,), bool)
+        mask[list(self._active)] = True
+        timer = prof.phase("decode") if prof is not None else _NOOP_TIMER
+        with timer:
+            logits, self.cache = self._decode_jit(
+                self.params, self.cache, jnp.asarray(self._last_np),
+                jnp.asarray(mask))
+            toks = np.asarray(self._sample_all(logits))
+        self._decode_since_chunk += 1
+        for slot, seq in list(self._active.items()):
+            # this call wrote seq.last_tok's K/V at position kv_len
+            seq.kv_len = min(seq.kv_len + 1, self.max_seq)
+            tok = int(toks[slot])
+            self.stats["tokens_generated"] += 1
+            self._after_token(seq, tok)
+            if seq.slot >= 0:
+                seq.last_tok = tok
+                self._last_np[slot] = tok
+
+    def _note_chunk(self, n: int):
+        self.stats["chunks"] += 1
+        self.stats["chunk_tokens"] += n
+        now = time.monotonic()
+        if self._last_chunk_t is not None:
+            dt = max(now - self._last_chunk_t, 1e-6)
+            inst = n / dt
+            self._chunk_rate = (inst if self._chunk_rate is None
+                                else 0.8 * self._chunk_rate + 0.2 * inst)
+        self._last_chunk_t = now
+
+    # ------------------------------------------------- offload/restore
+
+    def _store(self):
+        if self._kv_store is None:
+            from ant_ray_tpu.llm.kv_offload import LocalKvStore  # noqa: PLC0415
+
+            self._kv_store = LocalKvStore()
+        return self._kv_store
+
+    def _evict_for_pressure(self) -> bool:
+        """Free one slot by offloading the least-recently-used IDLE
+        resident session.  Admission pressure spills cold state instead
+        of shedding new work."""
+        if not self._kv_evict_on_pressure:
+            return False
+        idle = [s for s in self._sessions.values()
+                if s.state == "resident" and s.slot >= 0
+                and s.current is None and s.paused is None]
+        if not idle:
+            return False
+        victim = min(idle, key=lambda s: s.last_used)
+        self._offload(victim)
+        self.stats["pressure_evictions"] += 1
+        return True
+
+    def _sweep_idle(self):
+        if self._kv_idle_evict_s is None:
+            return
+        cutoff = time.monotonic() - self._kv_idle_evict_s
+        for sess in list(self._sessions.values()):
+            if (sess.state == "resident" and sess.slot >= 0
+                    and sess.current is None and sess.paused is None
+                    and sess.last_used < cutoff):
+                self._offload(sess)
+                self.stats["idle_evictions"] += 1
+
+    def _offload(self, sess: _Session):
+        """Device-get the session's slab and seal it into the offload
+        store; the slot returns to the free pool.  The slab is NOT
+        zeroed — stale bytes past a future occupant's length are masked
+        exactly like reused slots always were."""
+        slot = sess.slot
+        k, v, ln = self._extract_jit(self.cache, slot)
+        slab = (np.asarray(k), np.asarray(v), int(ln))
+        sess.handle = self._store().put(sess.session_id, slab)
+        sess.kv_len = int(ln)
+        sess.slot = -1
+        sess.state = "offloaded"
+        self._free_slots.append(slot)
+        self.stats["offloads"] += 1
+        self.stats["offload_bytes"] += (slab[0].nbytes + slab[1].nbytes)
+
+    def _start_restore(self, sess: _Session):
+        if sess.state != "offloaded":
+            return
+        sess.state = "restoring"
+        ticket = {"done": False, "result": None, "error": None,
+                  "t0": time.monotonic(), "wall0": time.time()}
+        self._restoring[sess.session_id] = ticket
+        store, handle = self._store(), sess.handle
+
+        def fetch():
+            try:
+                ticket["result"] = store.get(handle)
+            except BaseException as exc:  # noqa: BLE001 — typed below
+                ticket["error"] = exc
+            finally:
+                ticket["done"] = True
+
+        threading.Thread(target=fetch, daemon=True,
+                         name=f"kv-restore-{sess.session_id}").start()
+
+    def _poll_restores(self, prof=None):
+        """Land finished restore fetches: install the slab into a free
+        (or pressure-evicted) slot and resume the session's work.  Never
+        blocks — unfinished fetches stay in flight while decode
+        proceeds; a landed fetch with no slot available retries next
+        step."""
+        if not self._restoring:
+            return
+        jnp = self._jnp
+        for sid, ticket in list(self._restoring.items()):
+            if not ticket["done"]:
+                continue
+            sess = self._sessions.get(sid)
+            if sess is None:
+                del self._restoring[sid]
+                continue
+            if ticket["error"] is not None:
+                del self._restoring[sid]
+                self._fail_session(sess, ticket["error"], ticket)
+                continue
+            if not self._free_slots and not self._evict_for_pressure():
+                continue                     # retry next step
+            slot = self._free_slots.pop()
+            del self._restoring[sid]
+            k, v, ln = ticket["result"]
+            timer = (prof.phase("restore_install") if prof is not None
+                     else _NOOP_TIMER)
+            with timer:
+                self.cache = self._install_jit(
+                    self.cache, jnp.asarray(k), jnp.asarray(v),
+                    jnp.int32(ln), slot)
+            dur = time.monotonic() - ticket["t0"]
+            self.stats["restores"] += 1
+            self.stats["restore_wait_s"] += dur
+            self._record_restore_span(sess, ticket, dur,
+                                      k.nbytes + v.nbytes)
+            sess.slot = slot
+            sess.state = "resident"
+            sess.kv_len = int(ln)
+            sess.last_used = time.monotonic()
+            if sess.paused is not None:
+                seq = sess.paused
+                sess.paused = None
+                sess.current = seq
+                seq.slot = slot
+                self._last_np[slot] = seq.last_tok
+                self._active[slot] = seq
+            elif sess.pending:
+                self._begin_ingest(sess.pending.pop(0), slot,
+                                   sess.kv_len, prof)
+
+    def _record_restore_span(self, sess: _Session, ticket: dict,
+                             dur: float, nbytes: int):
+        """Attribute the restore to the request that paid for it via the
+        PR 8 trace plane (`llm:restore`), on whichever seq carries a
+        trace context."""
+        seq = sess.paused or (sess.pending[0] if sess.pending else None)
+        ctx = seq.trace_ctx if seq is not None else None
+        if ctx is None:
+            return
+        try:
+            from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+            tracing_plane.record_span(
+                ctx, "llm:restore", ts=ticket["wall0"], dur_s=dur,
+                attrs={"session": sess.session_id, "bytes": nbytes})
+        except Exception:  # noqa: BLE001 — tracing is best-effort
+            pass
+
+    def _fail_session(self, sess: _Session, exc, ticket: dict):
+        """A restore failed (e.g. holder died mid-pull): fail THIS
+        session's requests typed and reset the session record; every
+        other slot keeps decoding — the loop never wedges."""
+        from ant_ray_tpu.exceptions import KVRestoreError  # noqa: PLC0415
+
+        self.stats["restore_failures"] += 1
+        err = KVRestoreError(
+            f"session {sess.session_id!r}: KV restore failed: {exc!r}",
+            session_id=sess.session_id)
+        seqs = ([sess.paused] if sess.paused else []) + sess.pending
+        sess.paused = None
+        sess.pending = []
+        sess.state = "failed"
+        sess.handle = None
+        sess.kv_len = 0
+        seq0 = seqs[0] if seqs else None
+        if seq0 is not None and seq0.trace_ctx is not None:
+            try:
+                from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+                tracing_plane.record_span(
+                    seq0.trace_ctx, "llm:restore", ts=ticket["wall0"],
+                    dur_s=time.monotonic() - ticket["t0"], error=True,
+                    attrs={"session": sess.session_id,
+                           "error": repr(exc)})
+            except Exception:  # noqa: BLE001
+                pass
+        for seq in seqs:
+            self._fail_seq(seq, err)
+
+    def _fail_seq(self, seq: _Seq, err):
+        out = RequestOutput(
+            request_id=seq.request_id, prompt_token_ids=seq.prompt,
+            token_ids=list(seq.generated),
+            text=self.tokenizer.decode(seq.generated),
+            finished=True, finish_reason="error", error=str(err))
+        self._finished.append(out)
+        if seq.on_event is not None:
+            seq.on_event({"type": "error", "error": err, "output": out})
+
     # ----------------------------------------------------------- private
 
     def _after_token(self, seq: _Seq, tok: int):
@@ -322,26 +885,48 @@ class LLMEngine:
             reason = "stop"
         elif len(seq.generated) >= s.max_tokens:
             reason = "length"
-        elif len(seq.prompt) + len(seq.generated) >= self.max_seq:
+        elif seq.kv_len + 1 >= self.max_seq:
             reason = "length"
+        if seq.on_event is not None and reason != "stop":
+            seq.on_event({"type": "token", "token_id": tok})
         if reason is not None:
             self._release(seq, reason)
 
     def _release(self, seq: _Seq, reason: str):
         out_ids = (seq.generated[:-1] if reason == "stop"
                    else seq.generated)
-        self._finished.append(RequestOutput(
+        out = RequestOutput(
             request_id=seq.request_id,
             prompt_token_ids=seq.prompt,
             token_ids=list(out_ids),
             text=self.tokenizer.decode(out_ids),
             finished=True,
             finish_reason=reason,
-        ))
+        )
+        self._finished.append(out)
+        sess = seq.session
         if seq.slot >= 0:
             self._active.pop(seq.slot, None)
-            self._free_slots.append(seq.slot)
+            if sess is None:
+                self._free_slots.append(seq.slot)
+            else:
+                # Slot stays with the session (multi-turn KV reuse).
+                # The final token's K/V was never written — carry it
+                # into the next turn's ingest.
+                sess.kv_len = seq.kv_len
+                sess.carry = list(seq.generated[-1:])
+                sess.current = None
+                sess.last_used = time.monotonic()
             seq.slot = -1
+        elif sess is not None and sess.current is seq:
+            sess.current = None
+            sess.last_used = time.monotonic()
+        if sess is not None and sess.pending and sess.slot >= 0 \
+                and sess.current is None and sess.paused is None:
+            # Next turn already queued: put it at the head of the line.
+            self._waiting.insert(0, sess.pending.pop(0))
+        if seq.on_event is not None:
+            seq.on_event({"type": "final", "output": out})
 
     def _sample_one(self, seq: _Seq, logits):
         seq.rng_key, sub = self._jax.random.split(seq.rng_key)
@@ -394,3 +979,274 @@ class LLMEngine:
         sampled = jax.vmap(
             lambda k, lg: jax.random.categorical(k, lg))(keys, masked)
         return jnp.where(temps > 0, sampled, greedy).astype(jnp.int32)
+
+
+class _NoopTimer:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_TIMER = _NoopTimer()
+
+
+class _LoopHandle:
+    """Per-request handle returned by :meth:`EngineLoop.submit`: an
+    event queue for streaming plus a wait() for the final output."""
+
+    def __init__(self, request_id: str):
+        import queue as _q  # noqa: PLC0415
+
+        self.request_id = request_id
+        self.events = _q.Queue()
+        self.submit_ts = time.monotonic()
+        self.first_token_ts: float | None = None
+        self._final: RequestOutput | None = None
+        self._error: BaseException | None = None
+        self._done = threading.Event()
+
+    # engine-loop side ------------------------------------------------
+    def _on_event(self, ev: dict):
+        if ev["type"] == "token" and self.first_token_ts is None:
+            self.first_token_ts = time.monotonic()
+        if ev["type"] == "final":
+            self._final = ev["output"]
+        elif ev["type"] == "error":
+            self._error = ev["error"]
+            self._final = ev.get("output")
+        self.events.put(ev)
+        if ev["type"] in ("final", "error"):
+            self._done.set()
+
+    def _fail(self, exc: BaseException):
+        self._on_event({"type": "error", "error": exc, "output": None})
+
+    # caller side -----------------------------------------------------
+    def wait(self, timeout: float | None = None) -> RequestOutput:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request_id} not finished in {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._final
+
+    def ttft_s(self) -> float | None:
+        if self.first_token_ts is None:
+            return None
+        return self.first_token_ts - self.submit_ts
+
+    def __iter__(self):
+        """Yield events until (and including) the final/error event."""
+        while True:
+            ev = self.events.get()
+            yield ev
+            if ev["type"] in ("final", "error"):
+                return
+
+
+class EngineLoop:
+    """Background stepper that OWNS an engine: requests are submitted
+    from any thread; one loop thread interleaves chunked prefill,
+    decode, and restore landing, and streams tokens to per-request
+    sinks.  This replaces the old request-holds-the-engine-lock serving
+    model — TTFT isolation requires concurrent requests to share steps,
+    not serialize whole generations.
+
+    The loop also publishes the serve-autoscaling load gauges
+    (``art_llm_tokens_per_s``, ``art_llm_queue_depth``,
+    ``art_llm_resident_sessions``) and exposes them via
+    :meth:`load_signals` for controller polling."""
+
+    METRIC_NAMES = ("art_llm_tokens_per_s", "art_llm_queue_depth",
+                    "art_llm_resident_sessions")
+
+    def __init__(self, engine: LLMEngine, *,
+                 max_waiting: int | None = None,
+                 deployment: str = "llm",
+                 metrics_interval_s: float = 2.0,
+                 idle_sleep_s: float = 0.01):
+        self._engine = engine
+        self._max_waiting = (max_waiting if max_waiting is not None
+                             else engine._max_waiting)
+        self._deployment = deployment
+        self._metrics_interval = metrics_interval_s
+        self._idle_sleep = idle_sleep_s
+        self._inbox: list = []
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._stop = False
+        self._tokens_per_s = 0.0
+        self._last_tick = time.monotonic()
+        self._last_tokens = 0
+        self._gauges = None
+        self._snapshot = self._loop_snapshot(engine)
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="llm-engine-loop")
+        self._thread.start()
+
+    # ------------------------------------------------------- submission
+
+    def submit(self, prompt, sampling: SamplingParams | None = None, *,
+               session_id: str | None = None,
+               request_id: str | None = None,
+               trace_ctx=None) -> _LoopHandle:
+        """Admission-gate and enqueue one request; returns its handle.
+
+        Sheds typed BackPressureError when the engine is KV-full (no
+        free slot, nothing evictable) and the waiting line is at
+        ``max_waiting`` — with the retry hint derived from the measured
+        chunk-drain rate."""
+        eng = self._engine
+        if self._max_waiting is not None:
+            with self._lock:
+                inbox_n = len(self._inbox)
+            # Requests waiting for a SLOT (mid-prefill seqs hold theirs
+            # already and don't count against the line).  List len()
+            # reads are GIL-atomic, so _waiting/_free_slots stay live;
+            # the SESSION-map walks (parked count, evictability) come
+            # from the loop-published snapshot — iterating _sessions
+            # from this thread could blow up mid-resize.  Snapshot
+            # staleness costs at most a spurious/missed 429 for one
+            # request, never corruption.
+            snap = self._snapshot
+            waiting = inbox_n + len(eng._waiting) + snap["parked"]
+            if (waiting >= self._max_waiting and not eng._free_slots
+                    and not snap["evictable"]):
+                from ant_ray_tpu.exceptions import BackPressureError  # noqa: PLC0415
+
+                raise BackPressureError(
+                    f"llm engine at capacity: {eng.slots} KV slots "
+                    f"busy, {waiting} waiting (max_waiting="
+                    f"{self._max_waiting})",
+                    retry_after_s=eng.retry_after_hint())
+        rid = request_id or f"req-{next(eng._req_counter)}"
+        handle = _LoopHandle(rid)
+        with self._lock:
+            self._inbox.append((prompt, sampling, rid, session_id,
+                                trace_ctx, handle))
+        self._wake.set()
+        return handle
+
+    def _call_on_loop(self, fn, timeout: float = 30.0):
+        """Run ``fn(engine)`` on the loop thread and return its result
+        (None on timeout).  Every mutation of the engine's session /
+        slot maps must go through here — the loop thread owns them."""
+        done = threading.Event()
+        res = {}
+
+        def op(eng):
+            try:
+                res["val"] = fn(eng)
+            finally:
+                done.set()
+
+        with self._lock:
+            self._inbox.append(("__op__", op, None, None, None, None))
+        self._wake.set()
+        done.wait(timeout)
+        return res.get("val")
+
+    def evict_session(self, session_id: str, *, force: bool = False
+                      ) -> bool:
+        """Thread-safe wrapper: the eviction runs on the loop thread."""
+        return bool(self._call_on_loop(
+            lambda eng: eng.evict_session(session_id, force=force)))
+
+    def end_session(self, session_id: str) -> bool:
+        """Thread-safe wrapper: the teardown runs on the loop thread —
+        end_session frees slots and drops session records, which would
+        race the stepper if called from a replica/request thread."""
+        return bool(self._call_on_loop(
+            lambda eng: eng.end_session(session_id)))
+
+    # ---------------------------------------------------------- signals
+
+    @staticmethod
+    def _loop_snapshot(eng: LLMEngine) -> dict:
+        """Admission/load counters as one fresh dict, published by the
+        loop thread each iteration: submit() and stats() read THIS
+        instead of walking the live engine structures (which the loop
+        mutates concurrently — cross-thread iteration can blow up
+        mid-resize).  At worst one step stale: a bounded gauge blip."""
+        return {
+            "parked": sum(len(s.pending) + (1 if s.paused else 0)
+                          for s in eng._sessions.values()),
+            "evictable": eng.has_evictable(),
+            "queue_depth": eng.queue_depth(),
+            "resident_sessions": eng.resident_sessions(),
+        }
+
+    def stats(self) -> dict:
+        snap = self._snapshot
+        return {
+            "art_llm_tokens_per_s": self._tokens_per_s,
+            "art_llm_queue_depth": float(snap["queue_depth"]),
+            "art_llm_resident_sessions":
+                float(snap["resident_sessions"]),
+        }
+
+    load_signals = stats
+
+    def shutdown(self, timeout: float = 5.0):
+        self._stop = True
+        self._wake.set()
+        self._thread.join(timeout)
+
+    # ------------------------------------------------------------- loop
+
+    def _drain_inbox(self, eng):
+        with self._lock:
+            items, self._inbox = self._inbox, []
+        for prompt, sampling, rid, session_id, trace_ctx, handle in items:
+            if prompt == "__op__":
+                sampling(eng)             # an injected loop-thread op
+                continue
+            try:
+                eng.add_request(prompt, sampling, rid, admit=False,
+                                session_id=session_id,
+                                on_event=handle._on_event,
+                                trace_ctx=trace_ctx)
+            except BaseException as exc:  # noqa: BLE001 — typed to caller
+                handle._fail(exc)
+
+    def _run(self):
+        eng = self._engine
+        while not self._stop:
+            self._drain_inbox(eng)
+            if eng.has_unfinished():
+                try:
+                    eng.step()
+                except Exception:  # noqa: BLE001 — keep the loop alive
+                    import logging  # noqa: PLC0415
+
+                    logging.getLogger(__name__).exception(
+                        "llm engine step failed")
+                    time.sleep(0.05)
+            else:
+                self._wake.wait(self._idle_sleep)
+                self._wake.clear()
+            self._snapshot = self._loop_snapshot(eng)
+            now = time.monotonic()
+            if now - self._last_tick >= self._metrics_interval:
+                self._tick_metrics(eng, now)
+
+    def _tick_metrics(self, eng, now: float):
+        tokens = eng.stats["tokens_generated"]
+        dt = max(now - self._last_tick, 1e-6)
+        self._tokens_per_s = (tokens - self._last_tokens) / dt
+        self._last_tokens = tokens
+        self._last_tick = now
+        try:
+            if self._gauges is None:
+                from ant_ray_tpu.util.metrics import Gauge  # noqa: PLC0415
+
+                self._gauges = {
+                    name: Gauge(name, tag_keys=("deployment",))
+                    for name in self.METRIC_NAMES}
+            tags = {"deployment": self._deployment}
+            for name, value in self.stats().items():
+                self._gauges[name].set(value, tags)
+        except Exception:  # noqa: BLE001 — metrics are best-effort
+            pass

@@ -3,74 +3,83 @@ reference's OpenAI-compatible serving layer, ref: llm/_internal/serve/
 deployments/ + serve/llm/).
 
 ``build_llm_deployment`` returns a serve Application; each replica owns
-one engine and drains it per request batch.  The request/response dicts
-follow the OpenAI completions shape (``prompt`` → ``choices[].text``)
-so a client of the reference's `ray.serve.llm` finds the same surface.
+one engine driven by a background :class:`EngineLoop` — concurrent
+requests SHARE engine steps (chunked prefill interleaved with decode)
+instead of serializing whole generations behind a lock, which is what
+gives short requests TTFT isolation from long prompts.  The
+request/response dicts follow the OpenAI completions shape (``prompt``
+→ ``choices[].text``) so a client of the reference's `ray.serve.llm`
+finds the same surface.
+
+Session affinity: a request carrying ``session_id`` keeps its KV slab
+across turns on THIS replica (idle slabs offload to the tiered object
+store and restore transparently).  Multi-replica session routing rides
+the future owner-direct call plane (ROADMAP item 2) — until then, pin
+sessions to a replica via handle affinity or num_replicas=1.
 """
 
 from __future__ import annotations
 
-from ant_ray_tpu.llm.engine import LLMEngine
+import time
+
+from ant_ray_tpu.llm.engine import EngineLoop, LLMEngine
 from ant_ray_tpu.llm.sampling import SamplingParams
 
 
 class LLMServer:
-    """Replica class: one engine per replica."""
+    """Replica class: one engine + one background engine loop."""
 
     def __init__(self, model="tiny", *, slots: int = 8,
                  max_seq: int | None = None, tokenizer_name: str | None =
                  None, seed: int = 0, tensor_parallel_size: int = 1,
-                 max_waiting: int | None = None):
-        import threading  # noqa: PLC0415
-
+                 max_waiting: int | None = None,
+                 prefill_chunk_tokens: int | None = 64,
+                 decode_steps_per_chunk: int = 1,
+                 kv_idle_evict_s: float | None = None,
+                 kv_offload="auto"):
         from ant_ray_tpu.llm.tokenizer import get_tokenizer  # noqa: PLC0415
 
+        store = self._resolve_store(kv_offload)
         self.engine = LLMEngine(
             model, slots=slots, max_seq=max_seq,
             tokenizer=get_tokenizer(tokenizer_name), seed=seed,
             tensor_parallel_size=tensor_parallel_size,
-            max_waiting=max_waiting)
-        # The engine mutates shared slot/cache state; replicas may run
-        # requests on overlapping threads (max_concurrency > 1), so all
-        # engine access serializes here.  Because of that serialization
-        # the LOCK QUEUE is the serving-path prompt line: `max_waiting`
-        # bounds it in _acquire_engine (the engine's own add_request
-        # gate covers direct engine users).
-        self._engine_lock = threading.Lock()
-        self._max_waiting = max_waiting
-        self._lock_waiters = 0
-        self._waiters_lock = threading.Lock()
+            max_waiting=max_waiting,
+            prefill_chunk_tokens=prefill_chunk_tokens,
+            decode_steps_per_chunk=decode_steps_per_chunk,
+            kv_idle_evict_s=kv_idle_evict_s,
+            kv_offload_store=store)
+        self._loop = EngineLoop(self.engine, max_waiting=max_waiting)
 
-    def _acquire_engine(self) -> None:
-        """Admission at the engine boundary: with the engine busy, at
-        most ``max_waiting`` requests may line up for the lock — excess
-        sheds a typed :class:`BackPressureError` (429 at the ingress)
-        instead of piling up blocked replica threads without bound."""
-        from ant_ray_tpu.exceptions import BackPressureError  # noqa: PLC0415
+    @staticmethod
+    def _resolve_store(kv_offload):
+        """"auto" → object plane when this process is a cluster worker,
+        host-local otherwise; "object"/"local" force a tier; a store
+        instance passes through; None lets the engine default apply."""
+        if kv_offload is None or not isinstance(kv_offload, str):
+            return kv_offload
+        from ant_ray_tpu.llm import kv_offload as kvo  # noqa: PLC0415
 
-        if self._engine_lock.acquire(blocking=False):
-            return
-        with self._waiters_lock:
-            if (self._max_waiting is not None
-                    and self._lock_waiters >= self._max_waiting):
-                raise BackPressureError(
-                    f"llm engine busy: {self._lock_waiters} requests "
-                    f"already waiting (max_waiting={self._max_waiting})",
-                    retry_after_s=0.5)
-            self._lock_waiters += 1
-        try:
-            self._engine_lock.acquire()
-        finally:
-            with self._waiters_lock:
-                self._lock_waiters -= 1
+        if kv_offload == "local":
+            return kvo.LocalKvStore()
+        if kv_offload == "object":
+            return kvo.ObjectPlaneKvStore()
+        if kv_offload == "auto":
+            try:
+                from ant_ray_tpu._private.worker import global_worker  # noqa: PLC0415
+
+                if global_worker.connected:
+                    return kvo.ObjectPlaneKvStore()
+            except Exception:  # noqa: BLE001 — no runtime: local tier
+                pass
+            return kvo.LocalKvStore()
+        raise ValueError(f"unknown kv_offload mode {kv_offload!r}")
 
     @staticmethod
     def _check_deadline(where: str) -> None:
         """Shed a request whose end-to-end deadline (stamped by the
         serve ingress/handle) already expired — generating tokens
-        nobody is waiting for would hold the engine lock for nothing."""
-        import time  # noqa: PLC0415
-
+        nobody is waiting for would burn engine steps for nothing."""
         from ant_ray_tpu.exceptions import DeadlineExceededError  # noqa: PLC0415
         from ant_ray_tpu.serve.api import get_request_deadline  # noqa: PLC0415
 
@@ -81,16 +90,48 @@ class LLMServer:
                 "not executed")
 
     @staticmethod
+    def _deadline_timeout() -> float | None:
+        from ant_ray_tpu.serve.api import get_request_deadline  # noqa: PLC0415
+
+        deadline_ts = get_request_deadline()
+        if deadline_ts is None:
+            return None
+        return max(0.0, deadline_ts - time.time())
+
+    @staticmethod
     def _is_chat(request: dict) -> bool:
         path = request.get("__route_path__", "")
         return "messages" in request or path.endswith("/chat/completions")
+
+    def _submit(self, prompt, sampling, session_id=None):
+        """Admission (typed shed inside the `llm:admission` span) +
+        enqueue to the engine loop."""
+        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
+
+        ctx = tracing_plane.current()
+        with tracing_plane.span("llm:admission"):
+            return self._loop.submit(prompt, sampling,
+                                     session_id=session_id,
+                                     trace_ctx=ctx)
+
+    def _wait(self, handle, where: str):
+        from ant_ray_tpu.exceptions import DeadlineExceededError  # noqa: PLC0415
+
+        timeout = self._deadline_timeout()
+        try:
+            return handle.wait(timeout)
+        except TimeoutError as exc:
+            raise DeadlineExceededError(
+                f"request deadline expired during {where}") from exc
 
     def __call__(self, request: dict) -> dict:
         """OpenAI-shaped request.  Completions: {"prompt": ...} →
         choices[].text.  Chat (/v1/chat/completions or a "messages"
         key): templated through the tokenizer's chat template →
         choices[].message (ref: the OpenAI-compatible serving surface,
-        llm/_internal/serve/deployments/llm/llm_server.py)."""
+        llm/_internal/serve/deployments/llm/llm_server.py).  An
+        optional ``session_id`` pins the request to a persistent KV
+        session (multi-turn reuse + tiered offload)."""
         if self._is_chat(request):
             return self._chat(request)
         prompts = request.get("prompt", "")
@@ -98,20 +139,17 @@ class LLMServer:
             prompts[0], int)
         batch = prompts if many else [prompts]
         sampling = self._sampling(request)
+        session_id = request.get("session_id")
         from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
 
         self._check_deadline("generation")
-        with tracing_plane.span("llm:admission"):
-            self._acquire_engine()
-        try:
-            self._check_deadline("generation")  # lock wait can expire it
-            with tracing_plane.span(
-                    "llm:generate",
-                    {"prompts": len(batch),
-                     "max_tokens": sampling.max_tokens}):
-                outs = self.engine.generate(batch, sampling)
-        finally:
-            self._engine_lock.release()
+        with tracing_plane.span(
+                "llm:generate",
+                {"prompts": len(batch),
+                 "max_tokens": sampling.max_tokens}):
+            handles = [self._submit(p, sampling, session_id=session_id)
+                       for p in batch]
+            outs = [self._wait(h, "generation") for h in handles]
         return {
             "object": "text_completion",
             "choices": [
@@ -124,23 +162,18 @@ class LLMServer:
 
     def _chat(self, request: dict) -> dict:
         from ant_ray_tpu.llm.chat import render_chat  # noqa: PLC0415
+        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
 
         token_ids = render_chat(self.engine.tokenizer,
                                 request.get("messages", []))
         sampling = self._sampling(request)
-        from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
-
         self._check_deadline("generation")
-        with tracing_plane.span("llm:admission"):
-            self._acquire_engine()
-        try:
-            self._check_deadline("generation")  # lock wait can expire it
-            with tracing_plane.span(
-                    "llm:generate",
-                    {"max_tokens": sampling.max_tokens, "chat": True}):
-                out = self.engine.generate([token_ids], sampling)[0]
-        finally:
-            self._engine_lock.release()
+        with tracing_plane.span(
+                "llm:generate",
+                {"max_tokens": sampling.max_tokens, "chat": True}):
+            handle = self._submit(token_ids, sampling,
+                                  session_id=request.get("session_id"))
+            out = self._wait(handle, "generation")
         return {
             "object": "chat.completion",
             "choices": [{
@@ -172,7 +205,8 @@ class LLMServer:
         dicts, consumed through the object plane as a streaming actor
         call (num_returns="streaming") and exposed over SSE by the HTTP
         proxy (ref: serve streaming responses, serve/_private/replica.py
-        streaming path)."""
+        streaming path).  Tokens stream as the loop produces them —
+        other requests keep decoding in the same engine steps."""
         chat = self._is_chat(request)
         if chat:
             from ant_ray_tpu.llm.chat import render_chat  # noqa: PLC0415
@@ -187,25 +221,33 @@ class LLMServer:
         from ant_ray_tpu.observability import tracing_plane  # noqa: PLC0415
 
         self._check_deadline("streaming generation")
-        # The lock spans the generator's whole life (tokens must stream
-        # while generation runs, and no other request may touch the
-        # engine mid-stream); the finally releases it even if the
-        # consumer abandons the generator (GeneratorExit).
-        with tracing_plane.span("llm:admission"):
-            self._acquire_engine()
-        try:
-            self._check_deadline("streaming generation")  # lock wait
-            with tracing_plane.span(
-                    "llm:stream",
-                    {"max_tokens": sampling.max_tokens, "chat": chat}):
-                deltas = self.engine.stream(prompt, sampling)
-                yield from (self._chat_chunks(deltas) if chat
-                            else self._chunks(deltas))
-        finally:
-            self._engine_lock.release()
+        with tracing_plane.span(
+                "llm:stream",
+                {"max_tokens": sampling.max_tokens, "chat": chat}):
+            handle = self._submit(prompt, sampling,
+                                  session_id=request.get("session_id"))
+            yield from (self._chat_chunks(handle) if chat
+                        else self._chunks(handle))
 
-    def _chunks(self, deltas):
-        for delta in deltas:
+    def _events(self, handle):
+        """Handle events → the engine-stream delta shape."""
+        decode = self.engine.tokenizer.decode
+        for ev in handle:
+            if ev["type"] == "token":
+                tok = ev["token_id"]
+                yield {"token_id": tok, "text": decode([tok]),
+                       "finished": False, "finish_reason": None}
+            elif ev["type"] == "error":
+                raise ev["error"]
+            else:
+                out = ev["output"]
+                yield {"token_id": None, "text": "", "finished": True,
+                       "finish_reason": out.finish_reason,
+                       "token_ids": list(out.token_ids),
+                       "full_text": out.text}
+
+    def _chunks(self, handle):
+        for delta in self._events(handle):
             if delta["finished"]:
                 yield {"object": "text_completion.chunk",
                        "choices": [{"index": 0, "text": "",
@@ -219,8 +261,8 @@ class LLMServer:
                                     "finish_reason": None}],
                        "done": False}
 
-    def _chat_chunks(self, deltas):
-        for delta in deltas:
+    def _chat_chunks(self, handle):
+        for delta in self._events(handle):
             if delta["finished"]:
                 yield {"object": "chat.completion.chunk",
                        "choices": [{"index": 0, "delta": {},
@@ -235,8 +277,24 @@ class LLMServer:
                                     "finish_reason": None}],
                        "done": False}
 
+    def end_session(self, session_id: str) -> bool:
+        """Drop a session's KV state (slot + offloaded slab).  Routed
+        through the engine loop so the teardown runs on the loop thread
+        — never concurrently with a step mutating the same slot maps."""
+        return self._loop.end_session(session_id)
+
+    def load_signals(self) -> dict:
+        """Engine load gauges for signal-targeted autoscaling
+        (`AutoscalingConfig.target_signal`): art_llm_tokens_per_s,
+        art_llm_queue_depth, art_llm_resident_sessions."""
+        return self._loop.stats()
+
     def health(self):
         return "ok"
+
+    def shutdown(self) -> None:
+        """Stop the engine loop thread (replica teardown / tests)."""
+        self._loop.shutdown()
 
 
 def build_llm_deployment(model="tiny", *, name: str = "llm",
@@ -248,7 +306,12 @@ def build_llm_deployment(model="tiny", *, name: str = "llm",
                          max_ongoing_requests: int | None = None,
                          max_queued_requests: int = 0,
                          request_timeout_s: float | None = None,
-                         max_waiting: int | None = None):
+                         max_waiting: int | None = None,
+                         autoscaling_config=None,
+                         prefill_chunk_tokens: int | None = 64,
+                         decode_steps_per_chunk: int = 1,
+                         kv_idle_evict_s: float | None = None,
+                         kv_offload="auto"):
     """Application for ``serve.run`` exposing the engine under the
     OpenAI surface: POST /v1/completions and /v1/chat/completions
     (+ streaming via {"stream": true}).
@@ -257,7 +320,15 @@ def build_llm_deployment(model="tiny", *, name: str = "llm",
     ``max_queued_requests`` bound the replica's request gate,
     ``request_timeout_s`` stamps the default end-to-end deadline, and
     ``max_waiting`` bounds the ENGINE's prompt line once every KV slot
-    is busy — all sheds surface as 429/RESOURCE_EXHAUSTED."""
+    is busy — all sheds surface as 429/RESOURCE_EXHAUSTED with the
+    retry hint derived from the measured chunk-drain rate.
+
+    Serving enables chunked prefill by default
+    (``prefill_chunk_tokens=64``); ``kv_idle_evict_s`` turns on
+    idle-session offload through ``kv_offload`` ("auto" picks the
+    object plane inside a cluster).  ``autoscaling_config`` may target
+    the engine's published load signals (see
+    `AutoscalingConfig.target_signal`)."""
     from ant_ray_tpu import serve  # noqa: PLC0415
 
     dep = serve.deployment(
@@ -265,8 +336,13 @@ def build_llm_deployment(model="tiny", *, name: str = "llm",
         route_prefix=route_prefix,
         max_ongoing_requests=max_ongoing_requests,
         max_queued_requests=max_queued_requests,
-        request_timeout_s=request_timeout_s)
+        request_timeout_s=request_timeout_s,
+        autoscaling_config=autoscaling_config)
     return dep.bind(model, slots=slots, max_seq=max_seq,
                     tokenizer_name=tokenizer_name,
                     tensor_parallel_size=tensor_parallel_size,
-                    max_waiting=max_waiting)
+                    max_waiting=max_waiting,
+                    prefill_chunk_tokens=prefill_chunk_tokens,
+                    decode_steps_per_chunk=decode_steps_per_chunk,
+                    kv_idle_evict_s=kv_idle_evict_s,
+                    kv_offload=kv_offload)

@@ -485,17 +485,115 @@ def prefill_into_cache(params: dict, tokens, cache: dict, slot,
     return logits[0], cache
 
 
+def prefill_chunk_into_cache(params: dict, tokens, cache: dict, slot,
+                             start, chunk_len, config: LlamaConfig):
+    """Ingest ONE fixed-size chunk of a prompt into ``slot``.
+
+    tokens: (chunk,) int32 — ``chunk_len`` real tokens, zero-padded to
+    the engine's fixed chunk width.  ``slot``, ``start`` (absolute
+    offset of the chunk in the slab) and ``chunk_len`` are all traced
+    scalars, so a single compiled variant covers every chunk of every
+    prompt — the chunked-prefill replacement for the O(log max_seq)
+    bucketed `prefill_into_cache` variants.
+
+    Chunk queries attend against the slot's FULL slab (earlier chunks'
+    K/V plus this chunk's own, causally masked), mirroring
+    `decode_step`'s masked-slab attention so the dense-slab static-shape
+    discipline holds.  Pad positions write nothing: their scatter
+    indices are pushed out of bounds and dropped, and the returned
+    logits are taken at the chunk's last REAL token.
+
+    Returns (logits (vocab,) fp32, new cache with slot length set to
+    ``start + chunk_len``).
+    """
+    c = config
+    chunk = tokens.shape[0]
+    max_seq = cache["k"].shape[2]
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta,
+                                jnp.float32)
+    group = c.n_heads // c.n_kv_heads
+    slot = jnp.asarray(slot, jnp.int32)
+    start = jnp.asarray(start, jnp.int32)
+    chunk_len = jnp.asarray(chunk_len, jnp.int32)
+    offs = jnp.arange(chunk, dtype=jnp.int32)
+    pos = start + offs                           # (chunk,) absolute
+    real = offs < chunk_len                      # pad mask
+    # Pad tokens' writes land at max_seq → dropped by the scatter; rope
+    # positions are clamped only to keep the gather in range (their
+    # values never reach the slab or the masked attention).
+    write_pos = jnp.where(real, pos, jnp.int32(max_seq))
+    rope_pos = jnp.minimum(pos, jnp.int32(c.max_seq - 1))
+    pc = cos[rope_pos][:, None, :]               # (chunk, 1, hd/2)
+    ps = sin[rope_pos][:, None, :]
+
+    def block(x, scanned):
+        layer, ck_all, cv_all = scanned          # (slots, ms, kvh, hd)
+        h = rmsnorm(x, layer["ln_attn"], c.norm_eps)
+        xq = (h @ layer["wq"]).reshape(chunk, c.n_heads, c.head_dim)
+        xk = (h @ layer["wk"]).reshape(chunk, c.n_kv_heads, c.head_dim)
+        xv = (h @ layer["wv"]).reshape(chunk, c.n_kv_heads, c.head_dim)
+        xq = _rope_one(xq, pc, ps)
+        xk = _rope_one(xk, pc, ps)
+        ck = lax.dynamic_index_in_dim(ck_all, slot, axis=0,
+                                      keepdims=False)  # (ms, kvh, hd)
+        cv = lax.dynamic_index_in_dim(cv_all, slot, axis=0,
+                                      keepdims=False)
+        ck = ck.at[write_pos].set(xk.astype(ck.dtype))
+        cv = cv.at[write_pos].set(xv.astype(cv.dtype))
+        q = xq.reshape(chunk, c.n_kv_heads, group, c.head_dim)
+        scores = jnp.einsum("ckgd,tkd->ckgt", q, ck,
+                            preferred_element_type=jnp.float32)
+        scores = scores / jnp.sqrt(jnp.float32(c.head_dim))
+        valid = jnp.arange(max_seq)[None, :] <= pos[:, None]  # (chunk, ms)
+        scores = jnp.where(valid[:, None, None, :], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("ckgt,tkd->ckgd", probs.astype(ck.dtype), cv,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(chunk, c.n_heads * c.head_dim).astype(x.dtype)
+        x = x + (out @ layer["wo"]).astype(x.dtype)
+        h = rmsnorm(x, layer["ln_mlp"], c.norm_eps)
+        gated = jax.nn.silu(h @ layer["w_gate"]) * (h @ layer["w_up"])
+        x = x + (gated @ layer["w_down"]).astype(x.dtype)
+        ck_all = lax.dynamic_update_slice(ck_all, ck[None],
+                                          (slot, 0, 0, 0))
+        cv_all = lax.dynamic_update_slice(cv_all, cv[None],
+                                          (slot, 0, 0, 0))
+        return x, (ck_all, cv_all)
+
+    x = params["embed"][tokens].astype(c.dtype)  # (chunk, dim)
+    x, (new_k, new_v) = lax.scan(
+        block, x, (params["layers"], cache["k"], cache["v"]))
+    x = rmsnorm(x, params["norm_f"], c.norm_eps)
+    x_last = jnp.take(x, jnp.maximum(chunk_len - 1, 0), axis=0)
+    head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
+    logits = (x_last @ head.astype(c.dtype)).astype(jnp.float32)
+    cache = {"k": new_k, "v": new_v,
+             "length": cache["length"].at[slot].set(start + chunk_len)}
+    return logits, cache
+
+
 def decode_step(params: dict, last_tokens, cache: dict,
-                config: LlamaConfig):
+                config: LlamaConfig, active=None):
     """One token for every slot, attending against the KV cache.
 
     last_tokens: (slots,) int32 — the most recent token per slot.
+    ``active`` ((slots,) bool, optional): slots marked False neither
+    write K/V nor advance their length — required once idle slots can
+    hold a RESIDENT session's slab (session KV must stay bit-exact
+    while the slot sits out decode steps).  ``active=None`` keeps the
+    legacy everything-steps behavior.
     Returns (logits (slots, vocab) fp32, new cache with +1 lengths).
     """
     c = config
     slots = last_tokens.shape[0]
     max_seq = cache["k"].shape[2]
     pos = cache["length"]                       # (slots,) write position
+    if active is not None:
+        # Inactive slots' scatter writes are pushed out of bounds (and
+        # dropped); their lengths hold still below.
+        write_pos = jnp.where(active, pos, jnp.int32(max_seq))
+    else:
+        write_pos = pos
     cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta,
                                 jnp.float32)
     group = c.n_heads // c.n_kv_heads
@@ -511,8 +609,8 @@ def decode_step(params: dict, last_tokens, cache: dict,
         ps = sin[pos][:, None, :]
         xq = _rope_one(xq, pc, ps)
         xk = _rope_one(xk, pc, ps)
-        ck = ck.at[jnp.arange(slots), pos].set(xk.astype(ck.dtype))
-        cv = cv.at[jnp.arange(slots), pos].set(xv.astype(cv.dtype))
+        ck = ck.at[jnp.arange(slots), write_pos].set(xk.astype(ck.dtype))
+        cv = cv.at[jnp.arange(slots), write_pos].set(xv.astype(cv.dtype))
         # GQA attention against the slab, masked beyond each length.
         # bf16 inputs with fp32 accumulation keep the matmuls at full
         # MXU rate without an fp32 copy of the slab (see ops/attention).
@@ -539,8 +637,12 @@ def decode_step(params: dict, last_tokens, cache: dict,
     head = (params["embed"].T if c.tie_embeddings else params["lm_head"])
     logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
     # Clamp so idle slots (which keep stepping) never index past the
-    # slab; their scatter writes drop out of bounds harmlessly.
+    # slab; their scatter writes drop out of bounds harmlessly.  With an
+    # ``active`` mask, inactive slots' lengths hold perfectly still so a
+    # resident session's slab stays byte-stable across steps.
     new_len = jnp.minimum(cache["length"] + 1, jnp.int32(max_seq))
+    if active is not None:
+        new_len = jnp.where(active, new_len, cache["length"])
     cache = {"k": new_k, "v": new_v, "length": new_len}
     return logits, cache
 

@@ -155,6 +155,16 @@ class AutoscalingConfig:
     # Consecutive low-load intervals required before scaling down
     # (downscale damping, ref: downscale_delay_s).
     downscale_patience: int = 4
+    # Signal-targeted scaling: when set, the controller ALSO polls each
+    # replica's ``load_signals()`` dict (e.g. the LLM engine loop's
+    # art_llm_tokens_per_s / art_llm_queue_depth /
+    # art_llm_resident_sessions gauges) and sizes the deployment so
+    # sum(signal) / target_value replicas carry the load; the final
+    # desired count is the max of the ongoing-based and signal-based
+    # answers — queue depth still protects against a signal going
+    # stale.  Replicas without a load_signals() method contribute 0.
+    target_signal: str | None = None
+    target_value: float = 1.0
 
 
 @dataclass(frozen=True)
@@ -1408,6 +1418,20 @@ class Replica:
         the router must see."""
         return self._running + len(self._waiters)
 
+    def load_signals(self) -> dict:
+        """Deployment-defined load gauges for signal-targeted
+        autoscaling (`AutoscalingConfig.target_signal`): delegates to
+        the wrapped instance's ``load_signals()`` if it has one (the
+        LLM engine loop publishes tokens/s, queue depth, and resident
+        sessions this way)."""
+        fn = getattr(self._instance, "load_signals", None)
+        if callable(fn):
+            try:
+                return dict(fn())
+            except Exception:  # noqa: BLE001 — a gauge blip isn't fatal
+                return {}
+        return {}
+
     def health(self):
         return "ok"
 
@@ -1727,6 +1751,29 @@ class ServeController:
         _emit("suspect", n_suspect, {"deployment": name})
         return counts
 
+    def _poll_signal_total(self, replicas: list,
+                           signal: str) -> "float | None":
+        """Sum one named load signal across a deployment's replicas
+        (signal-targeted autoscaling).  A replica that fails to answer
+        contributes 0; None only when EVERY poll failed (no basis for a
+        decision — the ongoing-based desired stands alone)."""
+        art = _art()
+        refs = [r.load_signals.remote() for r in replicas]
+        try:
+            art.wait(refs, num_returns=len(refs),
+                     timeout=_POLL_TIMEOUT_S)
+        except Exception:  # noqa: BLE001 — control plane blip
+            return None
+        total, answered = 0.0, 0
+        for ref in refs:
+            try:
+                signals = art.get(ref, timeout=0)
+                answered += 1
+                total += float(signals.get(signal, 0.0))
+            except Exception:  # noqa: BLE001 — wedged replica
+                continue
+        return total if answered else None
+
     def _scale_loop(self):
         while not self._stopping:
             time.sleep(0.25)
@@ -1754,6 +1801,14 @@ class ServeController:
                     entry["last_decision"] = time.monotonic()
                 desired = math.ceil(
                     sum(counts) / max(cfg.target_ongoing_requests, 1e-9))
+                if cfg.target_signal:
+                    total = self._poll_signal_total(
+                        replicas, cfg.target_signal)
+                    if total is not None:
+                        _emit(cfg.target_signal, total,
+                              {"deployment": name})
+                        desired = max(desired, math.ceil(
+                            total / max(cfg.target_value, 1e-9)))
                 desired = max(cfg.min_replicas,
                               min(cfg.max_replicas, desired))
                 if desired > len(replicas):

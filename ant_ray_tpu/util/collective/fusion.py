@@ -200,6 +200,28 @@ def plan_buckets(leaves, bucket_bytes: int = DEFAULT_BUCKET_BYTES,
                                transport_dtype, reverse)
 
 
+def plan_buckets_per_level(leaves, topo, bucket_bytes: int =
+                           DEFAULT_BUCKET_BYTES,
+                           transport_dtype: str | None = None, *,
+                           reverse: bool = False) -> dict:
+    """Per-level plans for a hierarchical reduction: the intra-slice
+    (ICI) hop packs at ``topo.ici_bucket_bytes`` and the cross-slice
+    (DCN) leader exchange at ``topo.dcn_bucket_bytes`` (each defaulting
+    to ``bucket_bytes``).  The ICI plan is the wire plan — pack/unpack
+    layout and per-bucket launch granularity — while the DCN plan
+    bounds how many ICI buckets the leader hop may batch per exchange
+    (typically fewer, larger buckets: DCN round trips cost more than
+    they stream)."""
+    ici_bytes, dcn_bytes = topo.per_level_bucket_bytes(bucket_bytes)
+    signature = leaf_signature(leaves)
+    return {
+        "ici": _plan_for_signature(signature, int(ici_bytes),
+                                   transport_dtype, reverse),
+        "dcn": _plan_for_signature(signature, int(dcn_bytes),
+                                   transport_dtype, reverse),
+    }
+
+
 def plan_cache_info():
     return _plan_for_signature.cache_info()
 
@@ -484,7 +506,21 @@ def run_coalesced(tensors, opts, *, transfer_fn, collective_fn,
         return []
     transport = effective_transport(opts)
     hits_before = _plan_for_signature.cache_info().hits
-    plan = plan_buckets(tensors, opts.bucket_bytes, transport)
+    topo = getattr(opts, "hierarchy", None)
+    level_buckets = None
+    if topo is not None and (topo.ici_bucket_bytes
+                             or topo.dcn_bucket_bytes):
+        # Hierarchical with per-level budgets: the ICI plan IS the
+        # wire plan (pack layout + intra-slice launch granularity);
+        # the coarser DCN plan is recorded so the leader hop's
+        # batching headroom is visible in stats.
+        levels = plan_buckets_per_level(tensors, topo,
+                                        opts.bucket_bytes, transport)
+        plan = levels["ici"]
+        level_buckets = {"ici": len(levels["ici"].buckets),
+                         "dcn": len(levels["dcn"].buckets)}
+    else:
+        plan = plan_buckets(tensors, opts.bucket_bytes, transport)
     plan_hit = _plan_for_signature.cache_info().hits > hits_before
 
     timings = {"pack_s": 0.0, "transfer_s": 0.0, "collective_s": 0.0}
@@ -535,6 +571,8 @@ def run_coalesced(tensors, opts, *, transfer_fn, collective_fn,
             "unpack_s": unpack_s,
             **timings,
         }
+        if level_buckets is not None:
+            last["level_buckets"] = level_buckets
         stats.calls += 1
         stats.tensors += plan.n_leaves
         stats.buckets += len(plan.buckets)

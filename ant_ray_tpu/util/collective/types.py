@@ -19,9 +19,18 @@ class SliceTopology:
     message count scales with ``num_slices``, not world size.
 
     Hashable (tuples all the way down) so it can key compile caches.
+
+    ``ici_bucket_bytes`` / ``dcn_bucket_bytes`` optionally override the
+    fusion bucket budget per level: the intra-slice (ICI) hop is
+    launch-bound, so smaller buckets pipeline better there, while the
+    latency-dominated cross-slice (DCN) hop amortizes its round trips
+    over larger buckets.  ``None`` inherits the caller's flat
+    ``bucket_bytes``.
     """
 
     slices: tuple                        # tuple[tuple[int, ...], ...]
+    ici_bucket_bytes: "int | None" = None
+    dcn_bucket_bytes: "int | None" = None
 
     @property
     def num_slices(self) -> int:
@@ -71,6 +80,20 @@ class SliceTopology:
 
     def leaders(self) -> tuple:
         return tuple(self.leader(s) for s in range(self.num_slices))
+
+    def per_level_bucket_bytes(self, default: int) -> tuple:
+        """(ici, dcn) bucket budgets with ``default`` filling unset
+        levels — the pair the fusion planner consumes."""
+        return (self.ici_bucket_bytes or int(default),
+                self.dcn_bucket_bytes or int(default))
+
+    def with_bucket_bytes(self, ici: "int | None" = None,
+                          dcn: "int | None" = None) -> "SliceTopology":
+        """Copy with per-level fusion budgets attached (frozen
+        dataclass — returns a new topology)."""
+        from dataclasses import replace  # noqa: PLC0415
+
+        return replace(self, ici_bucket_bytes=ici, dcn_bucket_bytes=dcn)
 
 
 class Backend:

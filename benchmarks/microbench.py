@@ -803,6 +803,95 @@ def main() -> None:
                           "bench_error":
                           f"serve overload bench failed: {e!r}"[:300]}))
 
+    # ---- LLM serving plane (PR 18): chunked-prefill TTFT isolation +
+    # session-offload capacity, via the committed multi-client load
+    # generator (benchmarks/llm_loadgen.py).  Both TTFT arms run the
+    # SAME offered load — 2 closed-loop long-prompt ingesters (896
+    # tokens) interfering with 2 short-prompt clients (8 tokens) — so
+    # `llm_ttft_chunked_improvement_x` (unchunked p99 / chunked p99) is
+    # the PR's >= 5x acceptance ratio and `llm_ttft_short_p50/p99_us`
+    # guard the chunked arm absolutely.  The session leg runs 6 pausing
+    # sessions against 2 KV slots with an idle sweep:
+    # `llm_resident_sessions` > slots means offload is doing its job
+    # (every session completes, none shed).
+    try:
+        import sys as _sys  # noqa: PLC0415
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import jax as _jax  # noqa: PLC0415
+        import jax.numpy as _jnp  # noqa: PLC0415
+        from llm_loadgen import ClientSpec, LoadGen  # noqa: PLC0415
+
+        from ant_ray_tpu.llm import (  # noqa: PLC0415
+            LLMEngine,
+            SamplingParams,
+        )
+        from ant_ray_tpu.llm.engine import EngineLoop  # noqa: PLC0415
+        from ant_ray_tpu.models import llama  # noqa: PLC0415
+
+        # Big enough that a full-prompt prefill costs ~12x one chunk
+        # (dispatch overhead would mask the contrast on the tiny cfg).
+        llm_cfg = llama.LlamaConfig(
+            vocab_size=256, dim=128, n_layers=2, n_heads=4,
+            n_kv_heads=2, mlp_dim=256, max_seq=1024,
+            dtype=_jnp.float32)
+        llm_params = llama.init_params(llm_cfg, _jax.random.PRNGKey(7))
+        duration = max(4.0, 10 * scale)
+
+        def ttft_arm(chunk_tokens):
+            eng = LLMEngine(llm_cfg, llm_params, slots=4, max_seq=1024,
+                            prefill_chunk_tokens=chunk_tokens)
+            loop = EngineLoop(eng, metrics_interval_s=3600.0)
+            # Compile outside the measured window (long bucket/chunk,
+            # short bucket, decode).
+            for p in ([3] * 896, [4] * 8):
+                loop.submit(list(p), SamplingParams(
+                    temperature=0.0, max_tokens=2)).wait(timeout=600)
+            # 3 long ingesters + 1 short interactive client fills the
+            # 4 KV slots exactly (no slot-wait noise in either arm);
+            # the short's TTFT then measures pure prefill interference.
+            rep = LoadGen(loop, seed=18).run(
+                [ClientSpec("long", 896, 2, count=3),
+                 ClientSpec("short", 8, 8, count=1,
+                            think_time_s=0.01)], duration)
+            loop.shutdown()
+            assert rep.failed == 0, rep.errors[:3]
+            assert rep.ttft_us.get("short"), "no short TTFT samples"
+            return rep
+
+        chunked = ttft_arm(16)
+        unchunked = ttft_arm(None)
+        emit("llm_tokens_per_s", chunked.tokens_per_s(), "tokens/s")
+        emit("llm_ttft_short_p50_us",
+             chunked.percentile("short", 50), "us")
+        emit("llm_ttft_short_p99_us",
+             chunked.percentile("short", 99), "us")
+        emit("llm_ttft_short_unchunked_p99_us",
+             unchunked.percentile("short", 99), "us")
+        emit("llm_ttft_chunked_improvement_x",
+             unchunked.percentile("short", 99)
+             / chunked.percentile("short", 99), "x")
+
+        sess_eng = LLMEngine("tiny", slots=2, max_seq=128,
+                             prefill_chunk_tokens=16,
+                             kv_idle_evict_s=0.05)
+        sess_loop = EngineLoop(sess_eng, metrics_interval_s=3600.0)
+        sess_rep = LoadGen(sess_loop, seed=18).run(
+            [ClientSpec("session", 12, 4, count=6, session=True,
+                        pause_s=0.15, turns=3)],
+            max(6.0, 12 * scale))
+        sess_loop.shutdown()
+        assert sess_rep.failed == 0, sess_rep.errors[:3]
+        assert sess_rep.finished == 18, sess_rep
+        emit("llm_resident_sessions",
+             float(sess_eng.resident_sessions()), "sessions")
+        emit("llm_session_restores",
+             float(sess_eng.stats["restores"]), "restores")
+    except Exception as e:  # noqa: BLE001 — bench must not die here
+        print(json.dumps({"metric": "bench_error",
+                          "bench_error":
+                          f"llm serving bench failed: {e!r}"[:300]}))
+
     # ---- regression guard vs the committed control file
     import sys  # noqa: PLC0415
 

@@ -491,6 +491,47 @@ def test_slice_topology_accessors_and_validation():
     assert hash(topo) == hash(col.SliceTopology.regular(8, 2))
 
 
+def test_per_level_bucket_plans_differ_as_configured():
+    """ISSUE 18 satellite: (ici_bucket_bytes, dcn_bucket_bytes) on
+    SliceTopology yields distinct per-level plans — small ICI buckets
+    (more, pipeline-friendly) vs large DCN buckets (fewer, round-trip
+    amortizing) — and the ICI plan is the wire plan run_coalesced
+    packs with."""
+    topo = col.SliceTopology.regular(4, 2).with_bucket_bytes(
+        ici=400, dcn=4 << 20)
+    assert topo.per_level_bucket_bytes(1 << 20) == (400, 4 << 20)
+    # unset levels inherit the caller's flat budget
+    half = col.SliceTopology.regular(4, 2).with_bucket_bytes(ici=400)
+    assert half.per_level_bucket_bytes(1 << 20) == (400, 1 << 20)
+
+    leaves = [np.ones((80,), np.float32) for _ in range(6)]
+    levels = fusion.plan_buckets_per_level(leaves, topo,
+                                           bucket_bytes=1 << 20)
+    # 80 f32 = 320 B per leaf: ICI budget of 400 B → one leaf per
+    # bucket; 4 MiB DCN budget → everything in one bucket.
+    assert len(levels["ici"].buckets) == 6
+    assert len(levels["dcn"].buckets) == 1
+    assert levels["ici"].total_bytes == levels["dcn"].total_bytes
+
+    # fields ride the hashable compile-cache key without breaking it
+    assert hash(topo) != hash(col.SliceTopology.regular(4, 2))
+
+
+def test_per_level_buckets_drive_wire_plan_world1(gloo_group):
+    """With per-level budgets set, run_coalesced packs at the ICI
+    budget and surfaces both level bucket counts in stats.last."""
+    topo = col.SliceTopology.regular(1, 1).with_bucket_bytes(
+        ici=400, dcn=4 << 20)
+    tensors = [np.arange(80, dtype=np.float32) + k for k in range(6)]
+    out = col.allreduce_coalesced(tensors, group_name=gloo_group,
+                                  hierarchy=topo)
+    for got, want in zip(out, tensors):
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-6)
+    last = col.fusion_stats(gloo_group)["last"]
+    assert last["buckets"] == 6                     # packed at ICI budget
+    assert last["level_buckets"] == {"ici": 6, "dcn": 1}
+
+
 def test_slice_topology_from_labels():
     topo = col.SliceTopology.from_labels(
         ["pod-a", "pod-b", "pod-a", "pod-b"])

@@ -212,6 +212,39 @@ def serve_replica_count(name):
     return info[name]["num_replicas"]
 
 
+def test_autoscaling_scales_on_target_signal(cluster):
+    """`AutoscalingConfig(target_signal=...)` sizes the deployment from
+    the replicas' load_signals() gauges (the LLM engine loop publishes
+    art_llm_* this way) — here the signal demands 3 replicas while
+    ongoing-request load is zero."""
+    import time as _time
+
+    from ant_ray_tpu import serve
+
+    @serve.deployment(name="siggy",
+                      autoscaling_config={
+                          "min_replicas": 1, "max_replicas": 3,
+                          "target_ongoing_requests": 100.0,
+                          "interval_s": 0.3,
+                          "target_signal": "art_llm_queue_depth",
+                          "target_value": 2.0})
+    class Siggy:
+        def __call__(self, x):
+            return x
+
+        def load_signals(self):
+            return {"art_llm_queue_depth": 5.0}
+
+    serve.run(Siggy.bind())
+    # One replica reports 5.0 → ceil(5/2) = 3 > ongoing-based 0.
+    deadline = _time.monotonic() + 30
+    while _time.monotonic() < deadline and \
+            serve_replica_count("siggy") < 3:
+        _time.sleep(0.25)
+    assert serve_replica_count("siggy") == 3
+    serve.shutdown()
+
+
 def test_model_multiplexing(cluster):
     """Multiplexed models: per-replica LRU loading + model->replica
     affinity routing (ref: serve/_private/multiplex.py,

@@ -669,23 +669,31 @@ def test_error_serialization_stays_jax_free():
     assert proc.returncode == 0 and "OK" in proc.stdout, proc.stderr
 
 
-def test_llm_server_max_waiting_bounds_lock_queue():
-    """The serving path realizes `max_waiting` at the engine-lock
-    boundary: with the engine busy and the line full, a request sheds
-    typed BackPressureError instead of blocking a replica thread
+def test_llm_server_max_waiting_bounds_loop_queue():
+    """The serving path realizes `max_waiting` at the engine-loop
+    submit boundary: with the lone KV slot busy and the line full, a
+    request sheds typed BackPressureError (retry hint from the
+    measured chunk-drain rate) instead of parking a replica thread
     without bound."""
+    from ant_ray_tpu.llm import SamplingParams
     from ant_ray_tpu.llm.serve_llm import LLMServer
 
-    srv = LLMServer(slots=1, max_seq=64, max_waiting=0)
-    srv._engine_lock.acquire()          # engine busy, line: 0/0
-    try:
-        with pytest.raises(BackPressureError) as err:
-            srv({"prompt": "hi", "max_tokens": 1})
-        assert err.value.retry_after_s > 0
-    finally:
-        srv._engine_lock.release()
-    out = srv({"prompt": "hi", "max_tokens": 1})  # engine free again
+    srv = LLMServer(slots=1, max_seq=64, max_waiting=0,
+                    kv_offload="local")
+    # Pin the slot: a long generation submitted straight to the loop.
+    pin = srv._loop.submit([1, 2, 3], SamplingParams(temperature=0.0,
+                                                     max_tokens=40))
+    deadline = time.monotonic() + 60
+    while pin.first_token_ts is None and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert pin.first_token_ts is not None, "pin request never started"
+    with pytest.raises(BackPressureError) as err:
+        srv({"prompt": "hi", "max_tokens": 1})
+    assert err.value.retry_after_s > 0
+    pin.wait(timeout=120)
+    out = srv({"prompt": "hi", "max_tokens": 1})  # slot free again
     assert out["choices"]
+    srv.shutdown()
 
 
 # --------------------------------------------------------- overload soak
