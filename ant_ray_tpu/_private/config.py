@@ -187,6 +187,15 @@ class Config:
     # Node heartbeat period and the number of missed beats before death.
     heartbeat_period_s: float = 0.5
     num_heartbeats_timeout: int = 10
+    # Stagger each node's heartbeat phase by a hash of its node id
+    # within heartbeat_period_s, so N daemons booted together cannot
+    # synchronize into ingest storms at the GCS.
+    heartbeat_jitter: bool = True
+    # Cap on the exponential backoff a daemon applies between CONSECUTIVE
+    # failed heartbeat sends (a flapping GCS link must not busy-spin);
+    # kept well under the death timeout so one recovered beat still
+    # lands in time.
+    heartbeat_backoff_cap_s: float = 2.0
     # A node daemon whose GCS has been unreachable this long exits
     # (fail-stop for orphans; GCS FT restarts return well inside it).
     # 0 disables.
@@ -203,6 +212,12 @@ class Config:
     # utilization passes this, then spread (ref:
     # hybrid_scheduling_policy.h spread_threshold).
     hybrid_pack_threshold: float = 0.5
+    # Sticky pack-pick cache in the GCS scheduler: reuse the last grant
+    # target per plain scheduling shape (revalidated against live state)
+    # instead of an O(nodes) feasibility scan per lease — the worst
+    # measured cliff in the 500-node scale harness.  Off restores the
+    # full-scan-per-lease behaviour (the harness's "before" arm).
+    sched_pick_cache: bool = True
 
     # Node-side virtual-cluster fencing verdicts are cached this long
     # before re-checking with the GCS (ant ref: virtual-cluster GC/TTL

@@ -9,6 +9,7 @@ aiohttp process colocated with the head node.  Endpoints:
     GET  /api/nodes | /api/actors | /api/placement_groups | /api/objects
     GET  /api/tasks | /api/tasks/summary | /api/memory
     GET  /api/cluster_status | /api/export_events | /api/ha
+    GET  /api/scale                       per-subsystem head cost counters
     GET  /metrics                         (Prometheus text format)
     POST /api/profile                     {node_id?, duration_s} → XLA trace
     POST /api/jobs                        {entrypoint, runtime_env, ...}
@@ -334,6 +335,23 @@ def create_app(gcs_address: str, session_dir: str):
         per-follower replication lag, last failover timestamp."""
         return web.json_response(await _call(_ha_view))
 
+    async def scale(_req):
+        """Scale observatory: the head's per-subsystem cost counters
+        (GetScaleStats — per-method handle time, scheduler scan width,
+        heartbeat ingest, table/ring occupancy, io-loop duty), with
+        the handle counters pre-ranked for direct rendering."""
+        def build():
+            stats = gcs.call("GetScaleStats", retries=3)
+            stats["handle_ranked"] = [
+                {"method": m, "calls": c,
+                 "total_ms": round(ns / 1e6, 2),
+                 "us_per_call": round(ns / c / 1e3, 2) if c else None}
+                for m, (c, ns) in sorted(
+                    stats.get("handle", {}).items(),
+                    key=lambda kv: -kv[1][1])]
+            return stats
+        return web.json_response(await _call(build))
+
     async def insight(_req):
         def build():
             from ant_ray_tpu.util.insight import build_call_graph  # noqa: PLC0415
@@ -645,6 +663,7 @@ def create_app(gcs_address: str, session_dir: str):
     app.router.add_get("/api/memory", memory)
     app.router.add_get("/api/cluster_status", cluster_status)
     app.router.add_get("/api/ha", ha)
+    app.router.add_get("/api/scale", scale)
     app.router.add_get("/api/insight", insight)
     app.router.add_get("/api/export_events", export_events)
     app.router.add_get("/api/timeline", timeline)

@@ -168,6 +168,19 @@ class GcsServer:
         # Unfulfilled scheduling demands (autoscaler input): canonical
         # (resources, selector) -> {count, first_seen, last_seen}.
         self._demands: dict[str, dict] = {}
+        # ---- scale observatory counters (benchmarks/scale_harness.py
+        # reads these back via GetScaleStats to decompose control-plane
+        # cost per node by subsystem) ----
+        self._init_sched_observatory()
+        # Heartbeat ingest: beats handled and versioned views applied.
+        self._hb_stats = {"beats": 0, "views_applied": 0,
+                          "unknown_node": 0}
+        # Long-pollers currently parked in _sub_poll (subscriber gauge).
+        self._sub_pollers = 0
+        # io-loop duty cursor: (io_samples, io_idle_samples) at the
+        # last _io_loop_duty() reading, so each reading is a window
+        # fraction instead of a since-boot average.
+        self._io_duty_cursor = (0, 0)
         # None until the first heartbeat — 0.0 would read as "recently
         # seen" on a host whose monotonic clock is near boot.
         self._autoscaler_seen: float | None = None
@@ -183,6 +196,8 @@ class GcsServer:
             "RegisterNode": self._register_node,
             "Heartbeat": self._heartbeat,
             "GetAllNodes": self._get_all_nodes,
+            "ListNodes": self._list_nodes,
+            "GetScaleStats": self._get_scale_stats,
             "DrainNode": self._drain_node,
             "KVPut": self._kv_put,
             "KVGet": self._kv_get,
@@ -629,23 +644,29 @@ class GcsServer:
             cursor = self._pub_seq
         timeout = min(float(payload.get("timeout", 25.0)), 25.0)
         deadline = time.monotonic() + timeout
-        while True:
-            events = [(seq, ch, data)
-                      for seq, ch, data in self._pub_events
-                      if seq > cursor and (not channels or ch in channels)]
-            latest = (self._pub_events[-1][0]
-                      if self._pub_events else cursor)
-            if events:
-                return {"cursor": max(cursor, latest), "events": events}
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                return {"cursor": max(cursor, latest), "events": []}
-            async with self._pub_cond:
-                try:
-                    await asyncio.wait_for(self._pub_cond.wait(),
-                                           remaining)
-                except asyncio.TimeoutError:
-                    pass
+        self._sub_pollers += 1
+        try:
+            while True:
+                events = [(seq, ch, data)
+                          for seq, ch, data in self._pub_events
+                          if seq > cursor
+                          and (not channels or ch in channels)]
+                latest = (self._pub_events[-1][0]
+                          if self._pub_events else cursor)
+                if events:
+                    return {"cursor": max(cursor, latest),
+                            "events": events}
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return {"cursor": max(cursor, latest), "events": []}
+                async with self._pub_cond:
+                    try:
+                        await asyncio.wait_for(self._pub_cond.wait(),
+                                               remaining)
+                    except asyncio.TimeoutError:
+                        pass
+        finally:
+            self._sub_pollers -= 1
 
     # ------------------------------------------------------------- nodes
 
@@ -671,8 +692,10 @@ class GcsServer:
         resending.  After a GCS restart our version table is empty —
         the ``resync`` command tells the node to send a full view."""
         node_id = payload["node_id"]
+        self._hb_stats["beats"] += 1
         info = self._nodes.get(node_id)
         if info is None:
+            self._hb_stats["unknown_node"] += 1
             return {"unknown_node": True}  # node must re-register
         self._last_heartbeat[node_id] = time.monotonic()
         reply: dict = {}
@@ -680,6 +703,7 @@ class GcsServer:
         if view is not None:
             version = view.get("version", 0)
             if version > self._node_view_versions.get(node_id, -1):
+                self._hb_stats["views_applied"] += 1
                 info.available_resources = view["available_resources"]
                 info.disk_full = view.get("disk_full", False)
                 # Drain state is STICKY here: the daemon's view can set
@@ -701,6 +725,156 @@ class GcsServer:
 
     async def _get_all_nodes(self, _payload):
         return dict(self._nodes)
+
+    @staticmethod
+    def _node_state(info: NodeInfo) -> str:
+        if not info.alive:
+            return "DEAD"
+        if getattr(info, "draining", False):
+            return "DRAINING"
+        return "ALIVE"
+
+    async def _list_nodes(self, payload):
+        """Paginated node listing — the ListTasks cursor idiom applied
+        to the node table (the unpaged GetAllNodes reply falls over at
+        hundreds of nodes).  Pages walk node-id order; the token is the
+        last returned node's hex id, so a node dying (or registering)
+        between pages can neither shift nor duplicate the cursor.
+        ``state`` filters ALIVE / DEAD / DRAINING server-side."""
+        payload = payload or {}
+        limit = max(1, int(payload.get("limit", 1000)))
+        state = payload.get("state")
+        if state is not None:
+            state = str(state).upper()
+        token = payload.get("token")
+        records = []
+        next_token = None
+        total = matched = 0
+        for node_id in sorted(self._nodes, key=lambda n: n.hex()):
+            total += 1
+            info = self._nodes[node_id]
+            node_state = self._node_state(info)
+            if state is not None and node_state != state:
+                continue
+            matched += 1
+            if token is not None and node_id.hex() <= token:
+                continue
+            if len(records) >= limit:
+                next_token = records[-1]["node_id"]
+                break
+            records.append({
+                "node_id": node_id.hex(),
+                "address": info.address,
+                "state": node_state,
+                "alive": info.alive,
+                "draining": bool(getattr(info, "draining", False)),
+                "drain_reason": getattr(info, "drain_reason", ""),
+                "disk_full": bool(getattr(info, "disk_full", False)),
+                "labels": dict(info.labels or {}),
+                "total_resources": dict(info.total_resources),
+                "available_resources": dict(info.available_resources),
+            })
+        return {"nodes": records, "next_token": next_token,
+                "total": total, "matched": matched}
+
+    # ------------------------------------------- scale observatory
+    # (benchmarks/scale_harness.py + /api/scale + `scale-report`: the
+    # per-subsystem cost decomposition that turns "cost per node" from
+    # one opaque number into attributable curves)
+
+    def _io_loop_duty(self) -> float | None:
+        """Busy fraction of the io thread over the window since the
+        last call, derived from the always-on CPU profiler's folded
+        stacks: an io-thread sample whose leaf is the selector wait is
+        idle; anything else is the loop doing work.  None when the
+        profiling plane is off or no io samples landed yet."""
+        prof = getattr(self, "_cpu_profiler", None)
+        if prof is None:
+            return None
+        total = idle = 0
+        for key, count in prof.snapshot().items():
+            parts = key.split(";")
+            if len(parts) < 3 or parts[1] != "art-io":
+                continue
+            total += count
+            leaf = parts[-1]
+            if ":select" in leaf or ":poll" in leaf:
+                idle += count
+        last_total, last_idle = self._io_duty_cursor
+        self._io_duty_cursor = (total, idle)
+        window = total - last_total
+        if window <= 0:
+            return None
+        return 1.0 - (idle - last_idle) / window
+
+    def _scale_stats(self) -> dict:
+        from ant_ray_tpu._private import protocol  # noqa: PLC0415
+
+        return {
+            "table_rows": {
+                "nodes": len(self._nodes),
+                "actors": len(self._actors),
+                "jobs": len(self._jobs),
+                "objects": len(self._object_locations),
+                "placement_groups": len(self._placement_groups),
+                "metrics": len(self._metrics),
+                "kv": len(self._kv),
+                "tasks": self._task_state.stats().get("num_records", 0),
+                "virtual_clusters": len(self._virtual_clusters),
+            },
+            "rings": {
+                "task_events": len(self._task_events),
+                "step_events": len(self._step_events),
+                "span_events": len(self._span_events),
+                "cpu_profile": len(self._cpu_profile),
+                "pub_events": len(self._pub_events),
+                "insight_events": len(self._insight_events),
+            },
+            "subscribers": self._sub_pollers,
+            "sched": dict(self._sched_stats),
+            "heartbeat": dict(self._hb_stats),
+            # method -> [calls, handle_ns]: this process's server-side
+            # dispatch→reply cost per RPC method (protocol.py).
+            "handle": {m: list(v) for m, v in
+                       protocol.handle_counters.items()},
+            "io_loop_duty": self._io_loop_duty(),
+        }
+
+    async def _get_scale_stats(self, _payload):
+        return self._scale_stats()
+
+    async def _publish_self_metrics(self) -> None:
+        """Fold the scale-stats snapshot into the metrics table as the
+        ``art_gcs_*`` gauge set (scrapeable via /metrics like any other
+        series).  Runs on the health-loop cadence; ~20 gauge upserts."""
+        stats = self._scale_stats()
+        node = (f"gcs-{self._ha.replica_id}"
+                if self._ha is not None else "gcs")
+        for table, rows in stats["table_rows"].items():
+            await self._metric_record({
+                "name": "art_gcs_table_rows", "type": "gauge",
+                "value": float(rows),
+                "tags": {"table": table, "node_id": node},
+                "description": "GCS cluster-table row counts"})
+        for ring, occupancy in stats["rings"].items():
+            await self._metric_record({
+                "name": "art_gcs_ring_len", "type": "gauge",
+                "value": float(occupancy),
+                "tags": {"ring": ring, "node_id": node},
+                "description": "GCS bounded event-ring occupancy"})
+        await self._metric_record({
+            "name": "art_gcs_subscribers", "type": "gauge",
+            "value": float(stats["subscribers"]),
+            "tags": {"node_id": node},
+            "description": "Parked pubsub long-pollers"})
+        duty = stats["io_loop_duty"]
+        if duty is not None:
+            await self._metric_record({
+                "name": "art_gcs_io_loop_duty", "type": "gauge",
+                "value": round(duty, 4),
+                "tags": {"node_id": node},
+                "description": "GCS io-loop busy fraction (profiler-"
+                               "derived, current window)"})
 
     # ------------------------------------------------------------- drain
     # (ref: the reference's DrainNode RPC + autoscaler drain protocol,
@@ -746,8 +920,16 @@ class GcsServer:
         cfg = global_config()
         period = cfg.heartbeat_period_s
         timeout = cfg.heartbeat_period_s * cfg.num_heartbeats_timeout
+        self_metrics_every = max(1, int(round(2.0 / period)))
+        ticks = 0
         while True:
             await asyncio.sleep(period)
+            ticks += 1
+            if ticks % self_metrics_every == 0:
+                try:  # observability must never stall liveness judging
+                    await self._publish_self_metrics()
+                except Exception:  # noqa: BLE001 — best-effort gauges
+                    pass
             if not self._leading():
                 continue    # standbys observe, only the leader judges
             now = time.monotonic()
@@ -1400,27 +1582,53 @@ class GcsServer:
             return True
         return all(info.labels.get(k) == v for k, v in selector.items())
 
+    def _init_sched_observatory(self) -> None:
+        """Scheduler-scope observatory state.  Called from __init__,
+        and lazily from _pick_node so scheduling-policy unit tests can
+        exercise a bare ``object.__new__(GcsServer)`` with just
+        ``_nodes`` populated."""
+        # Scheduler scan width: how many node records each feasibility
+        # scan walked — THE number that says lease cost is O(nodes).
+        self._sched_stats = {"scans": 0, "scanned_nodes": 0,
+                             "picks": 0, "pick_cache_hits": 0}
+        # Sticky pack-pick cache: (resources, by_available) -> node_id
+        # of the last grant target, re-VALIDATED against live state
+        # before reuse (never trusted stale) — see _pick_node.
+        self._pick_cache: dict[tuple, NodeID] = {}
+
     def _feasible_nodes(self, resources: dict[str, float],
                         by_available: bool,
                         allowed: set | None,
                         label_selector: dict | None) -> list[NodeInfo]:
         out = []
+        self._sched_stats["scans"] += 1
+        self._sched_stats["scanned_nodes"] += len(self._nodes)
         for info in self._nodes.values():
-            if not info.alive:
-                continue
-            if getattr(info, "disk_full", False):
-                continue  # out-of-disk nodes take no new work
-            if getattr(info, "draining", False):
-                continue  # announced departures take no new work
-            if allowed is not None and info.node_id not in allowed:
-                continue
-            if not self._labels_match(info, label_selector):
-                continue
-            view = (info.available_resources if by_available
-                    else info.total_resources)
-            if all(view.get(k, 0.0) >= v for k, v in resources.items()):
+            if self._node_feasible(info, resources, by_available,
+                                   allowed, label_selector):
                 out.append(info)
         return out
+
+    def _node_feasible(self, info: NodeInfo,
+                       resources: dict[str, float],
+                       by_available: bool,
+                       allowed: set | None,
+                       label_selector: dict | None) -> bool:
+        """The per-node grantability predicate — one place, shared by
+        the full feasibility scan and the pick-cache revalidation."""
+        if not info.alive:
+            return False
+        if getattr(info, "disk_full", False):
+            return False  # out-of-disk nodes take no new work
+        if getattr(info, "draining", False):
+            return False  # announced departures take no new work
+        if allowed is not None and info.node_id not in allowed:
+            return False
+        if not self._labels_match(info, label_selector):
+            return False
+        view = (info.available_resources if by_available
+                else info.total_resources)
+        return all(view.get(k, 0.0) >= v for k, v in resources.items())
 
     @staticmethod
     def _utilization(info: NodeInfo) -> float:
@@ -1447,18 +1655,57 @@ class GcsServer:
         ``allowed`` restricts candidates (virtual-cluster membership);
         ``label_selector`` restricts to nodes advertising those labels
         (TPU generation / pod / worker-id).
+
+        Scale fix (measured by benchmarks/scale_harness.py — the worst
+        cliff at N=500 was O(nodes) feasibility scans per lease): the
+        last pick per plain scheduling shape is cached and REVALIDATED
+        against live state before reuse.  Packing semantics make the
+        sticky pick natural — consecutive leases WANT the same busiest
+        under-threshold node, and the GCS availability view only moves
+        on heartbeats anyway, so a fresh scan in between returns the
+        same node at O(nodes) cost.  The cache never serves a dead,
+        draining, full, or over-threshold node (the revalidation is the
+        same predicate the scan uses on that one node); shapes with a
+        virtual-cluster or label restriction always take the full scan.
+        Config-gated (``sched_pick_cache``) so the harness can measure
+        the before/after curve.
         """
+        try:
+            self._sched_stats["picks"] += 1
+        except AttributeError:  # bare unit-test construction
+            self._init_sched_observatory()
+            self._sched_stats["picks"] += 1
+        cfg = global_config()
+        threshold = cfg.hybrid_pack_threshold
+        cache_key = None
+        if cfg.sched_pick_cache and allowed is None \
+                and not label_selector:
+            cache_key = (tuple(sorted(resources.items())), by_available)
+            cached_id = self._pick_cache.get(cache_key)
+            if cached_id is not None:
+                info = self._nodes.get(cached_id)
+                if info is not None \
+                        and self._node_feasible(info, resources,
+                                                by_available, None, None) \
+                        and self._utilization(info) <= threshold:
+                    self._sched_stats["pick_cache_hits"] += 1
+                    return info
+                self._pick_cache.pop(cache_key, None)
         candidates = self._feasible_nodes(resources, by_available,
                                           allowed, label_selector)
         if not candidates:
             return None
-        threshold = global_config().hybrid_pack_threshold
         under = [n for n in candidates
                  if self._utilization(n) <= threshold]
         if under:
             # Pack: busiest first; node id tie-break for determinism.
-            return max(under, key=lambda n: (self._utilization(n),
+            pick = max(under, key=lambda n: (self._utilization(n),
                                              n.node_id.hex()))
+            if cache_key is not None:
+                if len(self._pick_cache) >= 64:  # bounded: shapes churn
+                    self._pick_cache.clear()
+                self._pick_cache[cache_key] = pick.node_id
+            return pick
         # All hot: spread to the least-utilized.
         return min(candidates, key=lambda n: (self._utilization(n),
                                               n.node_id.hex()))

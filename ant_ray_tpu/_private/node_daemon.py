@@ -168,7 +168,7 @@ class NodeManager:
         self._next_pin_token = 1
         # Versioned-sync observability + early-send wakeup (see
         # _heartbeat_loop; ref: ray_syncer resource-view component).
-        self.sync_stats = {"beats": 0, "views_sent": 0}
+        self.sync_stats = {"beats": 0, "views_sent": 0, "failures": 0}
         # In-flight lease-dep prefetch pulls, coalesced per object.
         self._prefetching: dict[ObjectID, asyncio.Task] = {}
         self._sync_wakeup = asyncio.Event()
@@ -663,6 +663,9 @@ class NodeManager:
              "registered workers"),
             ("art_node_read_pins", len(self._pin_leases),
              "objects held by read pins"),
+            ("art_node_heartbeat_failures_total",
+             self.sync_stats["failures"],
+             "heartbeat sends that failed (flapping GCS link)"),
         ]
         try:
             load1 = os.getloadavg()[0]
@@ -720,11 +723,19 @@ class NodeManager:
         from instrumenting every mutation site, so a missed wakeup can
         delay a delta by at most one period, never lose it."""
         gcs = self._clients.get(self._gcs_address)
-        period = global_config().heartbeat_period_s
+        cfg = global_config()
+        period = cfg.heartbeat_period_s
+        if cfg.heartbeat_jitter and period > 0:
+            # Phase-stagger by a hash of the node id: N daemons booted
+            # together spread their beats across the period instead of
+            # slamming the GCS io loop in lockstep every period.
+            phase = (int(self.node_id.hex()[:8], 16) % 997) / 997.0
+            await asyncio.sleep(phase * period)
         last_snap = None
         version = 0
         acked = -1
         last_gcs_ok = time.monotonic()
+        consecutive_failures = 0
         while not self._stopping:
             snap = (tuple(sorted(self._available.items())),
                     self._disk_full, self._draining)
@@ -756,8 +767,16 @@ class NodeManager:
                 if "view" in payload:
                     self.sync_stats["views_sent"] += 1
                 last_gcs_ok = time.monotonic()
+                consecutive_failures = 0
             except Exception as e:  # noqa: BLE001 — head may be restarting
                 logger.debug("heartbeat failed: %s", e)
+                # A flapping link must be VISIBLE (counter surfaces as
+                # art_node_heartbeat_failures_total) and must not
+                # busy-spin: consecutive failures back the loop off
+                # exponentially, capped well under the death timeout so
+                # one recovered beat still lands in time.
+                self.sync_stats["failures"] += 1
+                consecutive_failures += 1
                 # Fail-stop on a permanently-gone head: GCS restarts
                 # (FT) come back within seconds; a daemon orphaned by a
                 # dead cluster must not linger burning CPU forever.
@@ -769,9 +788,14 @@ class NodeManager:
                         "exiting", time.monotonic() - last_gcs_ok)
                     os._exit(1)
             self._reap_expired_pins()
+            wait = period
+            if consecutive_failures > 1:
+                wait = max(period, min(
+                    period * (2 ** (consecutive_failures - 1)),
+                    global_config().heartbeat_backoff_cap_s))
             self._sync_wakeup.clear()
             try:
-                await asyncio.wait_for(self._sync_wakeup.wait(), period)
+                await asyncio.wait_for(self._sync_wakeup.wait(), wait)
             except asyncio.TimeoutError:
                 pass
 

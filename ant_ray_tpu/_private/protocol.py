@@ -288,6 +288,39 @@ def wire_deltas() -> dict:
     return out
 
 
+# Server-side handle time, the other half of the cost picture: wire
+# accounting says what a method moves, handle accounting says what it
+# COSTS the serving loop — dispatch→reply-encoded ns per method (async
+# routes) or the sync handler call itself (fast routes, where chasing
+# Future completion would tax the PushTask hot path with a callback).
+# Same lock-free module-global idiom as wire_counters above.
+
+handle_counters: dict = {}
+_handle_published: dict = {}
+
+
+def _handle_account(method: str, handle_ns: int) -> None:
+    entry = handle_counters.get(method)
+    if entry is None:
+        entry = handle_counters.setdefault(method, [0, 0])
+    entry[0] += 1
+    entry[1] += handle_ns
+
+
+def handle_deltas() -> dict:
+    """method -> (calls, handle_ns) accumulated since the previous
+    call.  Single-consumer cursor, like :func:`wire_deltas`."""
+    out = {}
+    for method, entry in list(handle_counters.items()):
+        totals = (entry[0], entry[1])
+        last = _handle_published.get(method, (0, 0))
+        delta = (totals[0] - last[0], totals[1] - last[1])
+        if any(delta):
+            out[method] = delta
+            _handle_published[method] = totals
+    return out
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> Any:
     """One frame off the wire: ``(kind, msg_id, method, payload,
     nbytes)`` — nbytes is the full on-wire size (header included), the
@@ -478,9 +511,15 @@ class RpcServer:
     def _dispatch_fast(self, writer, write_lock, kind, msg_id, method,
                        payload, handler):
         """Task-free dispatch for sync handlers: the reply is written by
-        a future callback (or inline for immediate values)."""
+        a future callback (or inline for immediate values).  Handle
+        accounting times only the sync handler call — for a handler
+        that returns a Future the queue/execute tail is the worker's
+        cost, not this io loop's, and chasing completion would add a
+        callback to the hottest path on the wire."""
+        h0 = time.perf_counter_ns()
         try:
             result = handler(payload)
+            _handle_account(method, time.perf_counter_ns() - h0)
         except Exception as e:  # noqa: BLE001 — forwarded to caller
             if kind != _ONEWAY:
                 self._write_reply(writer, write_lock,
@@ -651,11 +690,13 @@ class RpcServer:
     async def _dispatch(self, writer, write_lock, kind, msg_id, method,
                         payload):
         handler = self._routes.get(method)
+        h0 = time.perf_counter_ns()
         try:
             if handler is None:
                 raise RpcError(f"no route for method {method!r}")
             result = await handler(payload)
             if kind == _ONEWAY:
+                _handle_account(method, time.perf_counter_ns() - h0)
                 if isinstance(result, RawReply):
                     result.done()
                 return
@@ -663,13 +704,15 @@ class RpcServer:
                 # NOTE: an await boundary separates the handler from
                 # this write, so async raw replies must carry bytes
                 # (not live arena views — those are fast-route only).
+                _handle_account(method, time.perf_counter_ns() - h0)
                 self._write_reply(writer, write_lock,
                                   (_REP, msg_id, method, result))
                 return
             t0 = time.perf_counter_ns()
             frame = _encode_frame((_REP, msg_id, method, result))
-            _wire_account(method, "send", len(frame),
-                          time.perf_counter_ns() - t0)
+            t1 = time.perf_counter_ns()
+            _wire_account(method, "send", len(frame), t1 - t0)
+            _handle_account(method, t1 - h0)
         except Exception as e:  # noqa: BLE001 — forwarded to caller
             if kind == _ONEWAY:
                 logger.exception("oneway handler %s failed", method)
@@ -679,6 +722,7 @@ class RpcServer:
             except Exception:
                 frame = _encode_frame((_ERR, msg_id, method, RpcError(repr(e))))
             _wire_account(method, "send", len(frame))
+            _handle_account(method, time.perf_counter_ns() - h0)
         try:
             # Fast path mirrors RpcClient._write_frame: plain write when
             # the transport buffer is shallow, locked drain only under

@@ -39,6 +39,17 @@ METHODS: dict[str, dict] = {
     "Heartbeat": _m("gcs", "{node_id, view_version?, view?}",
                     "{resync?, commands?}"),
     "GetAllNodes": _m("gcs", "{}", "{node_id: NodeInfo}"),
+    "ListNodes": _m("gcs", "{limit?, token?, state?}",
+                    "{nodes: [dict], next_token?, total, matched} "
+                    "(server-side page + state filter; the ListTasks "
+                    "cursor idiom over the node table)"),
+    "GetScaleStats": _m("gcs", "{}",
+                        "{table_rows, rings, subscribers, sched, "
+                        "heartbeat, handle, io_loop_duty} (the scale "
+                        "observatory's per-subsystem cost counters — "
+                        "LOCAL introspection: any replica serves its "
+                        "own process's view, so per-replica cost is "
+                        "separable under HA)"),
     "DrainNode": _m("gcs", "{node_id, reason?, deadline?}",
                     "bool (node enters DRAINING: schedulers skip it, "
                     "Serve/Train migrate off it)"),
@@ -299,13 +310,13 @@ METHODS: dict[str, dict] = {
 # sets, so the split cannot drift between server and router.
 
 GCS_FOLLOWER_READS = frozenset({
-    "GetAllNodes", "ClusterResources", "AvailableResources",
-    "KVGet", "KVKeys",
+    "GetAllNodes", "ListNodes", "ClusterResources",
+    "AvailableResources", "KVGet", "KVKeys",
     "ListActors", "ListObjects", "ListPlacementGroups",
     "ListVirtualClusters", "ListJobs",
     "MetricsGet", "InsightGet",
     "TaskEventsGet", "StepEventsGet", "SpanEventsGet",
-    "CpuProfileGet",
+    "CpuProfileGet", "GetScaleStats",
     "ListTasks", "GetTask", "SummarizeTasks",
     "GetHaView",
 })

@@ -229,7 +229,9 @@ def cmd_list(client: StateClient, args) -> int:
         reply = client.call("ListTasks", {
             "state": args.state, "name": args.name, "job_id": args.job,
             "actor_id": args.actor, "node_id": args.node,
-            "limit": args.limit, "token": args.token})
+            "limit": args.limit,
+            "token": int(args.token) if args.token is not None
+            else None})
 
         def render(p):
             for t in p["tasks"]:
@@ -293,20 +295,28 @@ def cmd_list(client: StateClient, args) -> int:
         _emit(args, objects, render)
         return 0
     if kind == "nodes":
-        infos = client.call("GetAllNodes")
-        rows = [{
-            "node_id": i.node_id.hex(), "node": i.node_id.hex()[:12],
-            "address": i.address, "alive": i.alive,
-            "draining": bool(getattr(i, "draining", False)),
-            "resources": i.total_resources, "labels": i.labels,
-        } for i in infos.values()]
+        # Server-side page + state filter (the ListTasks cursor idiom):
+        # a 1000-node listing no longer ships the whole node table per
+        # call, and `--state DEAD` filters at the source.
+        reply = client.call("ListNodes", {
+            "limit": args.limit, "token": args.token,
+            "state": args.state})
 
-        def render(r):
-            _table(r, [("node", "NODE"), ("address", "ADDRESS"),
-                       ("alive", "ALIVE"), ("draining", "DRAINING"),
-                       ("resources", "RESOURCES"), ("labels", "LABELS")])
+        def render(p):
+            for n in p["nodes"]:
+                n["node"] = n["node_id"][:12]
+                n["resources"] = n["total_resources"]
+            _table(p["nodes"],
+                   [("node", "NODE"), ("address", "ADDRESS"),
+                    ("state", "STATE"), ("resources", "RESOURCES"),
+                    ("labels", "LABELS")])
+            if p.get("next_token"):
+                print(f"... more — continue with --token "
+                      f"{p['next_token']}")
+            print(f"({len(p['nodes'])} shown, {p['matched']} matched, "
+                  f"{p['total']} total)")
 
-        _emit(args, rows, render)
+        _emit(args, reply, render)
         return 0
     if kind == "placement-groups":
         pgs = client.call("ListPlacementGroups")
@@ -332,6 +342,85 @@ def cmd_list(client: StateClient, args) -> int:
         return 0
     print(f"error: unknown list kind {kind!r}", file=sys.stderr)
     return 2
+
+
+def cmd_scale_report(args) -> int:
+    """Control-plane cost curves: the committed sweep
+    (BENCH_scale.json, written by benchmarks/scale_harness.py) plus —
+    when a cluster is reachable — the live GetScaleStats attribution
+    snapshot from the head."""
+    report = None
+    if args.file and os.path.exists(args.file):
+        with open(args.file) as f:
+            report = json.load(f)
+    live = None
+    address = args.address or os.environ.get("ART_ADDRESS")
+    if address:
+        try:
+            client = StateClient(address)
+            try:
+                live = client.call("GetScaleStats", timeout=10)
+            finally:
+                client.pool.close_all()
+        except Exception as e:  # noqa: BLE001 — report works offline
+            print(f"(no live cluster at {address}: {e})",
+                  file=sys.stderr)
+    if report is None and live is None:
+        print(f"error: no sweep file at {args.file!r} and no "
+              "reachable cluster — run benchmarks/scale_harness.py "
+              "or pass --address", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"sweep": report, "live": live}, indent=2,
+                         default=str))
+        return 0
+    if report is not None:
+        print("== scale sweep "
+              f"({report.get('generated_at', 'uncommitted')}, "
+              f"{report['config'].get('cpu_count')} cpu) ==")
+        rows = [{
+            "nodes": r["nodes"],
+            "leases_s": r.get("leases_per_s"),
+            "hb_cpu_ms_100n": r.get("heartbeat_cpu_ms_per_s_per_100n"),
+            "duty": r.get("gcs_io_loop_duty_loaded"),
+            "scan_w": r.get("sched_scanned_nodes_per_pick"),
+            "hit": r.get("pick_cache_hit_rate"),
+            "failover_s": r.get("failover_s"),
+        } for r in report.get("sweep", [])]
+        _table(rows, [("nodes", "NODES"), ("leases_s", "LEASES/S"),
+                      ("hb_cpu_ms_100n", "HB_CPU_MS/S/100N"),
+                      ("duty", "IO_DUTY"), ("scan_w", "SCAN_WIDTH"),
+                      ("hit", "CACHE_HIT"),
+                      ("failover_s", "FAILOVER_S")])
+        fix = report.get("cliff_fix") or {}
+        if fix.get("nocache_sweep"):
+            print(f"\n-- cliff fix: {fix.get('name')} "
+                  f"({fix.get('flag')}=0 arm) --")
+            _table([{"nodes": r["nodes"],
+                     "leases_s": r.get("leases_per_s"),
+                     "scan_w": r.get("sched_scanned_nodes_per_pick")}
+                    for r in fix["nocache_sweep"]],
+                   [("nodes", "NODES"), ("leases_s", "LEASES/S"),
+                    ("scan_w", "SCAN_WIDTH")])
+    if live is not None:
+        print("\n== live head ==")
+        print(f"table rows  {live['table_rows']}")
+        print(f"rings       {live['rings']}")
+        print(f"subscribers {live['subscribers']}   "
+              f"io-loop duty {live.get('io_loop_duty')}")
+        print(f"scheduler   {live['sched']}")
+        print(f"heartbeat   {live['heartbeat']}")
+        handle = sorted(live.get("handle", {}).items(),
+                        key=lambda kv: -kv[1][1])[:args.top]
+        rows = [{"method": m, "calls": c,
+                 "total_ms": round(ns / 1e6, 2),
+                 "us_per_call": round(ns / c / 1e3, 2) if c else None}
+                for m, (c, ns) in handle]
+        print(f"\n-- top {len(rows)} methods by server handle time --")
+        _table(rows, [("method", "METHOD"), ("calls", "CALLS"),
+                      ("total_ms", "TOTAL_MS"),
+                      ("us_per_call", "US/CALL")])
+    return 0
 
 
 def cmd_summary(client: StateClient, args) -> int:
@@ -595,7 +684,8 @@ def build_parser() -> argparse.ArgumentParser:
         "tasks", "actors", "objects", "nodes", "placement-groups",
         "jobs"])
     p_list.add_argument("--state", default=None,
-                        help="filter by state (tasks/actors)")
+                        help="filter by state (tasks/actors/nodes; "
+                             "nodes: ALIVE|DEAD|DRAINING)")
     p_list.add_argument("--name", default=None,
                         help="filter tasks by function name")
     p_list.add_argument("--job", default=None,
@@ -605,9 +695,9 @@ def build_parser() -> argparse.ArgumentParser:
     p_list.add_argument("--node", default=None,
                         help="filter by node id prefix")
     p_list.add_argument("--limit", type=int, default=100)
-    p_list.add_argument("--token", type=int, default=None,
+    p_list.add_argument("--token", default=None,
                         help="continuation token from the previous "
-                             "page (tasks)")
+                             "page (tasks: int; nodes: node-id hex)")
 
     p_summary = sub.add_parser("summary", help="server-side rollups")
     p_summary.add_argument("kind", choices=["tasks"])
@@ -648,6 +738,16 @@ def build_parser() -> argparse.ArgumentParser:
                            help="rank frames by self-time delta "
                                 "between two captures (no cluster "
                                 "needed)")
+
+    p_scale = sub.add_parser(
+        "scale-report", help="control-plane cost curves (committed "
+                             "BENCH_scale.json + live GetScaleStats)")
+    p_scale.add_argument("--file", default="BENCH_scale.json",
+                         help="sweep JSON from "
+                              "benchmarks/scale_harness.py")
+    p_scale.add_argument("--top", type=int, default=12,
+                         help="methods shown in the handle-time "
+                              "ranking")
     return parser
 
 
@@ -655,6 +755,8 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "profile" and args.diff:
         return cmd_profile_diff(args)  # purely local — no cluster
+    if args.command == "scale-report":
+        return cmd_scale_report(args)  # works offline from the file
     client = StateClient(_resolve_address(args))
     try:
         if args.command == "status":

@@ -274,6 +274,20 @@ class CpuProfiler:
                         "tags": {"method": method, "node_id": node},
                         "description": "Wire frames moved per RPC "
                                        "method"})
+        for method, (calls, handle_ns) in \
+                protocol.handle_deltas().items():
+            if handle_ns:
+                metric({"name": "art_rpc_handle_seconds_total",
+                        "type": "counter", "value": handle_ns / 1e9,
+                        "tags": {"method": method, "node_id": node},
+                        "description": "Server-side dispatch-to-reply "
+                                       "time per RPC method"})
+            if calls:
+                metric({"name": "art_rpc_handled_total",
+                        "type": "counter", "value": float(calls),
+                        "tags": {"method": method, "node_id": node},
+                        "description": "Server-side dispatches per "
+                                       "RPC method"})
 
     # --------------------------------------------------------- reading
 

@@ -523,7 +523,8 @@ def record_rpc(method: str, stages: dict, trace_id: str = "") -> None:
 RPC_METHOD_PLANES: dict[str, str] = {
     # ---- GCS control plane
     "RegisterNode": "control", "Heartbeat": "control",
-    "GetAllNodes": "control", "DrainNode": "control",
+    "GetAllNodes": "control", "ListNodes": "control",
+    "GetScaleStats": "observability", "DrainNode": "control",
     "KVPut": "control", "KVGet": "control", "KVDel": "control",
     "KVTake": "control", "KVKeys": "control",
     "RegisterJob": "control", "CreateActor": "control",

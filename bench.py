@@ -267,6 +267,18 @@ _GUARDED_METRICS = {
     "llm_ttft_short_p99_us": "lower",
     "llm_ttft_chunked_improvement_x": "higher",
     "llm_resident_sessions": "higher",
+    # Scale observatory (PR 19): control-plane cost at 100 stub nodes
+    # (benchmarks/scale_harness.py — real wire protocol, no workers).
+    # Lease throughput through SelectNode → LeaseWorker → ReturnWorker
+    # ("higher" — the sticky pack-pick cache's before/after headline),
+    # GCS CPU per second per 100 heartbeating nodes ("lower" — the
+    # steady-state tax every idle node levies on the head), and the
+    # head io-loop busy fraction under combined lease + task-event +
+    # heartbeat load ("lower" — duty creeping toward 1.0 is the
+    # saturation cliff the sweep exists to see coming).
+    "sched_leases_per_s_100n": "higher",
+    "heartbeat_cpu_ms_per_100n": "lower",
+    "gcs_loop_duty_at_100n": "lower",
 }
 
 

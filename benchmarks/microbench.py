@@ -892,6 +892,34 @@ def main() -> None:
                           "bench_error":
                           f"llm serving bench failed: {e!r}"[:300]}))
 
+    # ---- scale observatory (benchmarks/scale_harness.py): control-
+    # plane cost at N=100 stub nodes over the real wire protocol —
+    # lease throughput (SelectNode → LeaseWorker → ReturnWorker, with
+    # the sticky pack-pick cache on), GCS CPU per second per 100
+    # heartbeating nodes, and the head's io-loop busy fraction under
+    # combined heartbeat + lease + task-event load.  The full
+    # BENCH_scale.json sweep runs these at many N; this is the guarded
+    # N=100 point.
+    try:
+        import sys as _sys  # noqa: PLC0415
+
+        _sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from scale_harness import measure_point  # noqa: PLC0415
+
+        row = measure_point(100, window_s=3.0, ha_standbys=0,
+                            measure_failover=False)
+        emit("sched_leases_per_s_100n", row["leases_per_s"],
+             "leases/s")
+        emit("heartbeat_cpu_ms_per_100n",
+             row["heartbeat_cpu_ms_per_s_per_100n"], "ms/s")
+        duty = row.get("gcs_io_loop_duty_loaded")
+        if duty is not None:
+            emit("gcs_loop_duty_at_100n", duty, "fraction")
+    except Exception as e:  # noqa: BLE001 — bench must not die here
+        print(json.dumps({"metric": "bench_error",
+                          "bench_error":
+                          f"scale bench failed: {e!r}"[:300]}))
+
     # ---- regression guard vs the committed control file
     import sys  # noqa: PLC0415
 

@@ -696,7 +696,9 @@ def test_cli_smoke_json(observatory_cluster):
     assert memory["totals"]["objects"] >= 1
 
     nodes = run("list", "nodes")
-    assert nodes and nodes[0]["alive"]
+    # Paged ListNodes reply (PR 19): {nodes, next_token, total, matched}.
+    assert nodes["nodes"] and nodes["nodes"][0]["alive"]
+    assert nodes["total"] >= 1 and nodes["next_token"] is None
 
     jobs = run("list", "jobs")
     assert jobs and jobs[0]["job_id"]
